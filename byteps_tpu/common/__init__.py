@@ -1,0 +1,13 @@
+"""Core runtime: config, logging, tracing, partitioning, scheduling.
+
+TPU-native equivalent of the reference's ``byteps/common/`` C++ layer
+(``global.cc``, ``operations.cc``, ``core_loops.cc``, ``scheduled_queue.cc``).
+On TPU there is one process per host (not per device), so the reference's
+unix-socket intra-node control plane (``communicator.cc``) collapses into
+in-process data structures, and NCCL management (``nccl_manager.cc``) is
+replaced by XLA collectives over the ICI mesh.
+"""
+
+from byteps_tpu.common.config import Config, get_config, reset_config  # noqa: F401
+from byteps_tpu.common.logging import get_logger  # noqa: F401
+from byteps_tpu.common.tracing import TraceRecorder, get_tracer  # noqa: F401

@@ -1,0 +1,158 @@
+"""Typed runtime configuration fed by ``DMLC_*`` / ``BYTEPS_*`` env vars.
+
+The reference configures everything through environment variables (SURVEY
+§5.6; reference ``docs/env.md``, parsed in ``byteps/common/global.cc`` and
+``ps-lite include/ps/internal/env.h``). We keep the same names so reference
+user scripts and launch wrappers work unchanged, but back them with a typed
+``Config`` object used everywhere internally.
+
+Two namespaces:
+
+* ``DMLC_*`` — cluster topology (role, counts, rendezvous address). Consumed
+  by the launcher, the DCN parameter-server tier, and ``jax.distributed``
+  initialization.
+* ``BYTEPS_*`` — runtime tuning (partition bytes, scheduling credit, async
+  mode, tracing, log level).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return int(v)
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return v.strip().lower() in ("1", "true", "on", "yes", "y")
+
+
+def _env_str(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+# Partition size default mirrors the reference's BYTEPS_PARTITION_BYTES
+# default of 4096000 bytes (byteps/common/global.cc).
+DEFAULT_PARTITION_BYTES = 4096000
+# Reference BYTEPS_SCHEDULING_CREDIT default (byteps/common/scheduled_queue.cc).
+DEFAULT_SCHEDULING_CREDIT = 4
+# Reference BYTEPS_NCCL_GROUP_SIZE default: number of ready partitions batched
+# into one NCCL group call. Our analog: partitions batched per collective
+# dispatch group.
+DEFAULT_GROUP_SIZE = 4
+DEFAULT_SERVER_ENGINE_THREADS = 4
+
+
+@dataclasses.dataclass
+class Config:
+    """Process-wide runtime configuration (reference: ``BytePSGlobal``)."""
+
+    # --- DMLC_* cluster topology -------------------------------------------
+    role: str = "worker"  # scheduler | server | worker | joint
+    num_worker: int = 1
+    num_server: int = 0
+    ps_root_uri: str = "127.0.0.1"
+    ps_root_port: int = 9000
+    worker_id: int = 0
+    interface: str = ""
+
+    # --- BYTEPS_* runtime tuning -------------------------------------------
+    local_rank: int = 0
+    local_size: int = 1
+    partition_bytes: int = DEFAULT_PARTITION_BYTES
+    scheduling_credit: int = DEFAULT_SCHEDULING_CREDIT
+    group_size: int = DEFAULT_GROUP_SIZE
+    force_distributed: bool = False
+    enable_async: bool = False
+    enable_ipc: bool = False
+    server_engine_threads: int = DEFAULT_SERVER_ENGINE_THREADS
+    log_level: str = "INFO"
+    # compression: compress only partitions >= this many bytes (reference
+    # BYTEPS_MIN_COMPRESS_BYTES semantics: tiny tensors aren't worth it).
+    min_compress_bytes: int = 65536
+
+    # --- tracing (SURVEY §5.1) ---------------------------------------------
+    trace_on: bool = False
+    trace_dir: str = "./traces"
+    trace_start_step: int = 1
+    trace_end_step: int = 30
+
+    # --- auto-tuner (ByteScheduler, SURVEY §2.6) ---------------------------
+    auto_tune: bool = False
+
+    # --- TPU-specific knobs (no reference analog; documented in docs/env.md)
+    # Name of the data-parallel mesh axis used by push_pull collectives.
+    dp_axis: str = "dp"
+    # Reduce dtype on the aggregation tier. The reference PS sums in fp32.
+    reduce_dtype: str = "float32"
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        c = cls(
+            role=_env_str("DMLC_ROLE", "worker"),
+            num_worker=_env_int("DMLC_NUM_WORKER", 1),
+            num_server=_env_int("DMLC_NUM_SERVER", 0),
+            ps_root_uri=_env_str("DMLC_PS_ROOT_URI", "127.0.0.1"),
+            ps_root_port=_env_int("DMLC_PS_ROOT_PORT", 9000),
+            worker_id=_env_int("DMLC_WORKER_ID", 0),
+            interface=_env_str("DMLC_INTERFACE", ""),
+            local_rank=_env_int("BYTEPS_LOCAL_RANK", 0),
+            local_size=_env_int("BYTEPS_LOCAL_SIZE", 1),
+            partition_bytes=_env_int("BYTEPS_PARTITION_BYTES", DEFAULT_PARTITION_BYTES),
+            scheduling_credit=_env_int("BYTEPS_SCHEDULING_CREDIT", DEFAULT_SCHEDULING_CREDIT),
+            group_size=_env_int("BYTEPS_GROUP_SIZE", _env_int("BYTEPS_NCCL_GROUP_SIZE", DEFAULT_GROUP_SIZE)),
+            force_distributed=_env_bool("BYTEPS_FORCE_DISTRIBUTED"),
+            enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),
+            enable_ipc=_env_bool("BYTEPS_ENABLE_IPC"),
+            server_engine_threads=_env_int("BYTEPS_SERVER_ENGINE_THREAD", DEFAULT_SERVER_ENGINE_THREADS),
+            log_level=_env_str("BYTEPS_LOG_LEVEL", "INFO").upper(),
+            min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES", 65536),
+            trace_on=_env_bool("BYTEPS_TRACE_ON"),
+            trace_dir=_env_str("BYTEPS_TRACE_DIR", "./traces"),
+            trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 1),
+            trace_end_step=_env_int("BYTEPS_TRACE_END_STEP", 30),
+            auto_tune=_env_bool("BYTEPS_AUTO_TUNE"),
+            dp_axis=_env_str("BYTEPS_DP_AXIS", "dp"),
+            reduce_dtype=_env_str("BYTEPS_REDUCE_DTYPE", "float32"),
+        )
+        return c
+
+    @property
+    def is_distributed(self) -> bool:
+        """Multi-host (DCN tier involved) vs single-host ICI-only.
+
+        Mirrors the reference's distinction between the NCCL-only single
+        machine fast path and the hybrid-PS distributed path
+        (``byteps/common/operations.cc`` queue-list construction).
+        """
+        return self.num_worker > 1 or self.force_distributed
+
+
+_config: Optional[Config] = None
+
+
+def get_config() -> Config:
+    global _config
+    if _config is None:
+        _config = Config.from_env()
+    return _config
+
+
+def set_config(cfg: Config) -> None:
+    global _config
+    _config = cfg
+
+
+def reset_config() -> None:
+    """Drop the cached config (tests mutate env vars)."""
+    global _config
+    _config = None

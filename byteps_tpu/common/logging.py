@@ -1,0 +1,61 @@
+"""Logging — equivalent of the reference's ``BPS_LOG`` / ``BPS_CHECK``
+macros (``byteps/common/logging.{h,cc}``), honoring ``BYTEPS_LOG_LEVEL``
+(trace/debug/info/warning/fatal).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LEVELS = {
+    "TRACE": 5,
+    "DEBUG": logging.DEBUG,
+    "INFO": logging.INFO,
+    "WARNING": logging.WARNING,
+    "ERROR": logging.ERROR,
+    "FATAL": logging.CRITICAL,
+}
+
+logging.addLevelName(5, "TRACE")
+
+_configured = False
+
+
+def _configure_root() -> None:
+    global _configured
+    if _configured:
+        return
+    # Config.log_level is the source of truth (itself fed by
+    # BYTEPS_LOG_LEVEL); fall back to the raw env var if config import
+    # is not possible yet.
+    try:
+        from byteps_tpu.common.config import get_config
+
+        level_name = get_config().log_level
+    except Exception:
+        level_name = os.environ.get("BYTEPS_LOG_LEVEL", "INFO").upper()
+    level = _LEVELS.get(level_name, logging.INFO)
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(
+        logging.Formatter("[%(asctime)s] %(name)s %(levelname)s: %(message)s")
+    )
+    root = logging.getLogger("byteps_tpu")
+    root.setLevel(level)
+    root.addHandler(handler)
+    root.propagate = False
+    _configured = True
+
+
+def get_logger(name: str = "byteps_tpu") -> logging.Logger:
+    _configure_root()
+    if not name.startswith("byteps_tpu"):
+        name = "byteps_tpu." + name
+    return logging.getLogger(name)
+
+
+def bps_check(cond: bool, msg: str = "") -> None:
+    """``BPS_CHECK``-style invariant assertion."""
+    if not cond:
+        raise RuntimeError(f"BPS_CHECK failed: {msg}")

@@ -1,0 +1,281 @@
+"""Credit-based priority scheduler — the ByteScheduler core.
+
+TPU-native equivalent of ``byteps/common/scheduled_queue.cc`` +
+``byteps/common/core_loops.cc``. The reference runs ~12 background threads,
+one per pipeline stage (COORDINATE_REDUCE → REDUCE → COPYD2H → ... → PUSH →
+PULL → ... → BROADCAST), each popping the highest-priority ready partition
+from a per-stage ``BytePSScheduledQueue``; the PUSH stage additionally
+enforces a **credit** budget (at most ``BYTEPS_SCHEDULING_CREDIT`` partitions
+in flight).
+
+On TPU the picture simplifies: XLA owns device-side ordering within a stream,
+and JAX dispatch is already async. What must be preserved is the *semantics*
+that made BytePS fast (SURVEY §3.2 — "the single most important behavior to
+preserve"):
+
+* partitions are issued **in priority order** (priority = -declaration
+  order, ties broken by key), regardless of arrival order;
+* at most ``credit`` partitions are in flight at once, so a late-arriving
+  high-priority partition can still jump ahead of queued low-priority ones
+  instead of sitting behind a fully-committed queue;
+* completion frees a credit and immediately pumps the queue.
+
+The scheduler is stage-generic: a ``Pipeline`` is a list of named stages,
+each with a dispatch function (sync or async). Per-partition per-stage
+chrome-trace events are emitted (SURVEY §5.1), giving dPRO-style timelines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from byteps_tpu.common.logging import get_logger
+from byteps_tpu.common.partition import Partition
+from byteps_tpu.common.tracing import TraceRecorder
+
+log = get_logger("scheduler")
+
+
+class Handle:
+    """Completion handle for one enqueued tensor (all its partitions).
+
+    Reference analog: the int handle from ``HandleManager``
+    (byteps/torch/handle_manager.cc); ``wait()`` is ``wait_and_clear``.
+    """
+
+    def __init__(self, name: str, num_partitions: int) -> None:
+        self.name = name
+        self._remaining = num_partitions
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._error: Optional[BaseException] = None
+        self.results: Dict[int, Any] = {}  # part_idx -> stage-pipeline output
+
+    def _partition_done(self, part_idx: int, result: Any) -> None:
+        with self._lock:
+            self.results[part_idx] = result
+            self._remaining -= 1
+            if self._remaining <= 0:
+                self._event.set()
+
+    def _partition_failed(self, exc: BaseException) -> None:
+        with self._lock:
+            self._error = exc
+            self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> Dict[int, Any]:
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"handle '{self.name}' not done within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self.results
+
+
+@dataclasses.dataclass
+class Stage:
+    """One pipeline stage (reference analog: one QueueType + its core loop).
+
+    ``fn(task) -> result`` runs the stage. If ``credited`` the stage draws
+    from the scheduler's credit budget while the task occupies it (the
+    reference applies credits at PUSH). ``pool_size`` > 1 lets slow blocking
+    stages (e.g. DCN push/pull waiting on sockets) overlap across partitions.
+    """
+
+    name: str
+    fn: Callable[["PartitionTask"], Any]
+    credited: bool = False
+    pool_size: int = 1
+
+
+@dataclasses.dataclass
+class PartitionTask:
+    """A partition moving through the pipeline (reference: TensorTableEntry)."""
+
+    partition: Partition
+    name: str
+    handle: Handle
+    payload: Any = None        # stage functions read/replace this
+    stage_idx: int = 0
+    context: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def sort_key(self):
+        # Max-priority first; ties by key (reference sorts by (priority, key)).
+        return (-self.partition.priority, self.partition.key)
+
+
+class _StageQueue:
+    """Priority queue for one stage (reference: BytePSScheduledQueue)."""
+
+    def __init__(self) -> None:
+        self._heap: List = []
+        self._counter = 0
+
+    def push(self, task: PartitionTask) -> None:
+        self._counter += 1
+        heapq.heappush(self._heap, (task.sort_key, self._counter, task))
+
+    def pop(self) -> Optional[PartitionTask]:
+        if not self._heap:
+            return None
+        return heapq.heappop(self._heap)[2]
+
+    def peek(self) -> Optional[PartitionTask]:
+        if not self._heap:
+            return None
+        return self._heap[0][2]
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+
+class PipelineScheduler:
+    """Drives PartitionTasks through stages in priority order under credits.
+
+    One instance per process (the reference had one set of queues+loops per
+    GPU process; on TPU one process drives all local devices).
+    """
+
+    def __init__(
+        self,
+        stages: Sequence[Stage],
+        credit: int = 4,
+        tracer: Optional[TraceRecorder] = None,
+    ) -> None:
+        self.stages = list(stages)
+        self._queues = [_StageQueue() for _ in self.stages]
+        self._credit_total = max(1, credit)
+        self._credits = self._credit_total
+        self._lock = threading.Lock()
+        self._tracer = tracer
+        self._pools: List[ThreadPoolExecutor] = [
+            ThreadPoolExecutor(
+                max_workers=s.pool_size, thread_name_prefix=f"bps-{s.name}"
+            )
+            for s in self.stages
+        ]
+        self._busy = [0] * len(self.stages)
+        self._shutdown = False
+        self._inflight = 0
+        self._idle = threading.Condition(self._lock)
+
+    # -- public API ---------------------------------------------------------
+    def enqueue(self, tasks: Sequence[PartitionTask]) -> None:
+        if self._shutdown:
+            raise RuntimeError("PipelineScheduler is shut down")
+        with self._lock:
+            for t in tasks:
+                self._inflight += 1
+                self._queues[t.stage_idx].push(t)
+        self._pump()
+
+    def set_credit(self, credit: int) -> None:
+        """Adjust total credit (auto-tuner hook); takes effect as credits recycle."""
+        with self._lock:
+            delta = max(1, credit) - self._credit_total
+            self._credit_total = max(1, credit)
+            self._credits += delta
+        self._pump()
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        with self._idle:
+            if not self._idle.wait_for(lambda: self._inflight == 0, timeout):
+                raise TimeoutError("scheduler drain timed out")
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        for p in self._pools:
+            p.shutdown(wait=False)
+
+    # -- internals ----------------------------------------------------------
+    def _pump(self) -> None:
+        """Issue as many ready tasks as credits/pools allow, priority first."""
+        while True:
+            issued = None
+            with self._lock:
+                if self._shutdown:
+                    return
+                for si, stage in enumerate(self.stages):
+                    q = self._queues[si]
+                    if not len(q):
+                        continue
+                    if self._busy[si] >= self.stages[si].pool_size:
+                        continue
+                    # A task acquires at most one credit for its whole
+                    # lifetime (reference: credit held from PUSH until the
+                    # partition completes); one already holding a credit
+                    # passes later credited stages freely.
+                    head = q.peek()
+                    needs_credit = (
+                        stage.credited and not head.context.get("_holds_credit")
+                    )
+                    if needs_credit and self._credits <= 0:
+                        continue
+                    task = q.pop()
+                    if needs_credit:
+                        self._credits -= 1
+                        task.context["_holds_credit"] = True
+                    self._busy[si] += 1
+                    issued = (si, task)
+                    break
+            if issued is None:
+                return
+            si, task = issued
+            self._pools[si].submit(self._run_stage, si, task)
+
+    def _run_stage(self, si: int, task: PartitionTask) -> None:
+        stage = self.stages[si]
+        t0 = self._tracer._now_us() if self._tracer else 0.0
+        try:
+            result = stage.fn(task)
+            task.payload = result
+            failed = None
+        except BaseException as e:  # noqa: BLE001 - propagate via handle
+            failed = e
+            log.error("stage %s failed for %s.%d: %s",
+                      stage.name, task.name, task.partition.part_idx, e)
+        if self._tracer:
+            self._tracer.complete_event(
+                name=f"{task.name}.p{task.partition.part_idx}",
+                stage=stage.name,
+                start_us=t0,
+                dur_us=self._tracer._now_us() - t0,
+                args={
+                    "key": task.partition.key,
+                    "priority": task.partition.priority,
+                    "length": task.partition.length,
+                },
+            )
+        with self._lock:
+            self._busy[si] -= 1
+        if failed is not None:
+            self._finish(task, error=failed)
+        elif si + 1 < len(self.stages):
+            task.stage_idx = si + 1
+            with self._lock:
+                self._queues[si + 1].push(task)
+            self._pump()
+        else:
+            self._finish(task)
+
+    def _finish(self, task: PartitionTask, error: Optional[BaseException] = None) -> None:
+        """Reference analog: FinishOrProceed's terminal arm."""
+        with self._lock:
+            if task.context.pop("_holds_credit", False):
+                self._credits = min(self._credits + 1, self._credit_total)
+            self._inflight -= 1
+        if error is not None:
+            task.handle._partition_failed(error)
+        else:
+            task.handle._partition_done(task.partition.part_idx, task.payload)
+        with self._idle:
+            if self._inflight == 0:
+                self._idle.notify_all()
+        self._pump()

@@ -1,0 +1,171 @@
+"""Chrome trace-event recorder (SURVEY §5.1).
+
+The reference collects per-tensor, per-queue-stage timestamps in its core
+loops and dumps Chrome trace-event JSON per worker, controlled by
+``BYTEPS_TRACE_ON`` / ``BYTEPS_TRACE_DIR`` / ``BYTEPS_TRACE_START_STEP`` /
+``BYTEPS_TRACE_END_STEP`` (reference ``docs/timeline.md``; the joapolarbear
+fork exists largely to feed these traces to dPRO). We reproduce the same
+schema: one ``X`` (complete) event per partition per pipeline stage, with
+``pid`` = worker rank, ``tid`` = stage name, and args carrying key/partition
+metadata, so dPRO-style per-stage attribution works on the TPU build.
+
+Device-side work is additionally coverable by ``jax.profiler`` XLA traces;
+this recorder is the framework-level (scheduler/transport) view.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from byteps_tpu.common.config import get_config
+from byteps_tpu.common.logging import get_logger
+
+log = get_logger("tracing")
+
+
+class TraceRecorder:
+    """Collects chrome trace events; thread-safe; dumps per-worker JSON."""
+
+    def __init__(
+        self,
+        enabled: bool = False,
+        trace_dir: str = "./traces",
+        start_step: int = 1,
+        end_step: int = 30,
+        rank: int = 0,
+    ) -> None:
+        self.enabled = enabled
+        self.trace_dir = trace_dir
+        self.start_step = start_step
+        self.end_step = end_step
+        self.rank = rank
+        self._events: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._step = 0
+        self._origin = time.perf_counter_ns()
+        self._dumped = False
+
+    # -- step lifecycle -----------------------------------------------------
+    def step(self) -> None:
+        """Advance the step counter; auto-dump once past end_step."""
+        self._step += 1
+        if self.enabled and self._step > self.end_step:
+            self.dump()
+
+    @property
+    def active(self) -> bool:
+        return (
+            self.enabled
+            and self.start_step <= self._step <= self.end_step
+        )
+
+    def _now_us(self) -> float:
+        return (time.perf_counter_ns() - self._origin) / 1e3
+
+    # -- event emission -----------------------------------------------------
+    def complete_event(
+        self,
+        name: str,
+        stage: str,
+        start_us: float,
+        dur_us: float,
+        args: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        if not self.active:
+            return
+        ev = {
+            "name": name,
+            "cat": "byteps",
+            "ph": "X",
+            "ts": start_us,
+            "dur": dur_us,
+            "pid": self.rank,
+            "tid": stage,
+            "args": args or {},
+        }
+        with self._lock:
+            self._events.append(ev)
+
+    def span(self, name: str, stage: str, args: Optional[Dict[str, Any]] = None):
+        """Context manager emitting one complete event."""
+        return _Span(self, name, stage, args)
+
+    def instant(self, name: str, stage: str, args: Optional[Dict[str, Any]] = None) -> None:
+        if not self.active:
+            return
+        ev = {
+            "name": name,
+            "cat": "byteps",
+            "ph": "i",
+            "ts": self._now_us(),
+            "s": "t",
+            "pid": self.rank,
+            "tid": stage,
+            "args": args or {},
+        }
+        with self._lock:
+            self._events.append(ev)
+
+    # -- output -------------------------------------------------------------
+    def dump(self, path: Optional[str] = None) -> Optional[str]:
+        if self._dumped or not self._events:
+            return None
+        self._dumped = True
+        if path is None:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            path = os.path.join(self.trace_dir, f"trace_rank{self.rank}.json")
+        with self._lock:
+            doc = {
+                "traceEvents": self._events,
+                "displayTimeUnit": "ms",
+                "metadata": {"rank": self.rank, "framework": "byteps_tpu"},
+            }
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        log.info("dumped %d trace events to %s", len(self._events), path)
+        return path
+
+
+class _Span:
+    def __init__(self, rec: TraceRecorder, name: str, stage: str, args):
+        self.rec = rec
+        self.name = name
+        self.stage = stage
+        self.args = args
+        self.t0 = 0.0
+
+    def __enter__(self):
+        self.t0 = self.rec._now_us()
+        return self
+
+    def __exit__(self, *exc):
+        self.rec.complete_event(
+            self.name, self.stage, self.t0, self.rec._now_us() - self.t0, self.args
+        )
+        return False
+
+
+_tracer: Optional[TraceRecorder] = None
+
+
+def get_tracer() -> TraceRecorder:
+    global _tracer
+    if _tracer is None:
+        cfg = get_config()
+        _tracer = TraceRecorder(
+            enabled=cfg.trace_on,
+            trace_dir=cfg.trace_dir,
+            start_step=cfg.trace_start_step,
+            end_step=cfg.trace_end_step,
+            rank=cfg.worker_id,
+        )
+    return _tracer
+
+
+def reset_tracer() -> None:
+    global _tracer
+    _tracer = None

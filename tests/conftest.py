@@ -1,0 +1,29 @@
+"""Test harness config.
+
+Multi-device is faked on CPU (SURVEY §4 rebuild guidance): 8 virtual CPU
+devices substitute for a TPU slice, mirroring how the reference fakes
+multi-node with multi-process on localhost.
+
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_config(monkeypatch):
+    """Each test sees a fresh Config parsed from (possibly monkeypatched) env."""
+    from byteps_tpu.common import config as config_mod
+
+    config_mod.reset_config()
+    yield
+    config_mod.reset_config()

@@ -1,0 +1,54 @@
+"""Config parsing from DMLC_*/BYTEPS_* env (SURVEY §5.6)."""
+
+from byteps_tpu.common.config import Config, get_config
+
+
+def test_defaults():
+    cfg = Config.from_env()
+    assert cfg.role == "worker"
+    assert cfg.partition_bytes == 4096000
+    assert cfg.scheduling_credit == 4
+    assert not cfg.is_distributed
+
+
+def test_env_parsing(monkeypatch):
+    monkeypatch.setenv("DMLC_ROLE", "server")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "4")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "2")
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "10.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", "1234")
+    monkeypatch.setenv("BYTEPS_PARTITION_BYTES", "1024")
+    monkeypatch.setenv("BYTEPS_SCHEDULING_CREDIT", "8")
+    monkeypatch.setenv("BYTEPS_ENABLE_ASYNC", "1")
+    monkeypatch.setenv("BYTEPS_LOG_LEVEL", "debug")
+    cfg = Config.from_env()
+    assert cfg.role == "server"
+    assert cfg.num_worker == 4
+    assert cfg.num_server == 2
+    assert cfg.ps_root_uri == "10.0.0.1"
+    assert cfg.ps_root_port == 1234
+    assert cfg.partition_bytes == 1024
+    assert cfg.scheduling_credit == 8
+    assert cfg.enable_async
+    assert cfg.log_level == "DEBUG"
+    assert cfg.is_distributed
+
+
+def test_force_distributed(monkeypatch):
+    monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+    assert Config.from_env().is_distributed
+
+
+def test_get_config_caches(monkeypatch):
+    monkeypatch.setenv("BYTEPS_PARTITION_BYTES", "2048")
+    a = get_config()
+    b = get_config()
+    assert a is b
+    assert a.partition_bytes == 2048
+
+
+def test_env_bool_no_means_false(monkeypatch):
+    monkeypatch.setenv("BYTEPS_TRACE_ON", "no")
+    assert not Config.from_env().trace_on
+    monkeypatch.setenv("BYTEPS_TRACE_ON", "yes")
+    assert Config.from_env().trace_on

@@ -1,0 +1,68 @@
+"""Partitioning + declaration semantics (reference: global.cc DeclareTensor,
+operations.cc key-list construction)."""
+
+import numpy as np
+import pytest
+
+from byteps_tpu.common.partition import (
+    MAX_PARTS_PER_TENSOR,
+    TensorRegistry,
+    make_partitions,
+    partition_length,
+)
+
+
+def test_partition_length():
+    # 4MB default, fp32: 1024000 elements
+    assert partition_length(4, 4096000) == 1024000
+    assert partition_length(8, 4) == 1  # never zero
+
+
+def test_make_partitions_covers_exactly():
+    parts = make_partitions(tensor_id=3, num_elements=1000, itemsize=4, partition_bytes=1024)
+    # 256 elements per partition
+    assert parts[0].length == 256
+    assert sum(p.length for p in parts) == 1000
+    # contiguous, ordered
+    off = 0
+    for i, p in enumerate(parts):
+        assert p.offset == off
+        assert p.part_idx == i
+        assert p.tensor_id == 3
+        assert p.priority == -3
+        assert p.key == 3 * MAX_PARTS_PER_TENSOR + i
+        off += p.length
+
+
+def test_single_partition_small_tensor():
+    parts = make_partitions(0, 10, 4, 4096000)
+    assert len(parts) == 1
+    assert parts[0].length == 10
+
+
+def test_registry_declaration_order_sets_priority():
+    reg = TensorRegistry(partition_bytes=4096000)
+    a = reg.declare("grad/layer2", (128, 128), np.float32)
+    b = reg.declare("grad/layer1", (64,), np.float32)
+    assert a.tensor_id == 0 and a.priority == 0
+    assert b.tensor_id == 1 and b.priority == -1
+    # idempotent
+    a2 = reg.declare("grad/layer2", (128, 128), np.float32)
+    assert a2 is a
+    assert len(reg) == 2
+
+
+def test_registry_rejects_shape_change():
+    reg = TensorRegistry()
+    reg.declare("t", (4,), np.float32)
+    with pytest.raises(RuntimeError):
+        reg.declare("t", (5,), np.float32)
+
+
+def test_repartition():
+    reg = TensorRegistry(partition_bytes=4096000)
+    ctx = reg.declare("big", (1 << 20,), np.float32)  # 4 MiB
+    assert len(ctx.partitions) == 2  # 4 MiB > 4096000 bytes
+    reg.repartition(1 << 20)
+    assert len(ctx.partitions) == 4
+    assert sum(p.length for p in ctx.partitions) == 1 << 20

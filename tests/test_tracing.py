@@ -1,0 +1,45 @@
+"""Chrome-trace recorder (SURVEY §5.1; reference docs/timeline.md)."""
+
+import json
+
+from byteps_tpu.common.tracing import TraceRecorder
+
+
+def test_disabled_recorder_collects_nothing(tmp_path):
+    rec = TraceRecorder(enabled=False, trace_dir=str(tmp_path))
+    rec.step()
+    with rec.span("t0.p0", "PUSH"):
+        pass
+    assert rec.dump() is None
+
+
+def test_records_and_dumps_chrome_format(tmp_path):
+    rec = TraceRecorder(enabled=True, trace_dir=str(tmp_path), start_step=1, end_step=2, rank=3)
+    rec.step()  # step 1 -> active
+    with rec.span("grad.p0", "PUSH", args={"key": 7}):
+        pass
+    rec.instant("credit_exhausted", "SCHED")
+    path = rec.dump()
+    doc = json.load(open(path))
+    evs = doc["traceEvents"]
+    assert len(evs) == 2
+    x = [e for e in evs if e["ph"] == "X"][0]
+    assert x["name"] == "grad.p0"
+    assert x["tid"] == "PUSH"
+    assert x["pid"] == 3
+    assert x["args"]["key"] == 7
+    assert x["dur"] >= 0
+
+
+def test_step_window_gating(tmp_path):
+    rec = TraceRecorder(enabled=True, trace_dir=str(tmp_path), start_step=2, end_step=2)
+    rec.step()  # step 1: inactive
+    with rec.span("a", "S"):
+        pass
+    rec.step()  # step 2: active
+    with rec.span("b", "S"):
+        pass
+    rec.step()  # step 3 -> past end, auto-dumps
+    assert rec._dumped
+    names = [e["name"] for e in rec._events]
+    assert names == ["b"]
