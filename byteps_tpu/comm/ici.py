@@ -1,0 +1,262 @@
+"""ICI-tier collectives: the TPU replacement for the reference's NCCL +
+PS data plane on the intra-pod path (SURVEY §5.8).
+
+Layout convention for the "eager" (per-tensor push_pull) path: a gradient
+set lives as an array of shape ``(N, L)`` sharded over the mesh's dp axis on
+axis 0 — row d is device d's local gradient, the analog of one reference
+worker-process's GPU buffer. Collectives run inside ``shard_map`` and return
+a replicated ``(L,)`` result.
+
+The compressed all-reduce reproduces the reference's hybrid-PS dataflow
+(worker compress → server decompress → fp32 sum → server recompress →
+worker decompress; ``core_loops.cc`` COMPRESS/PUSH/PULL/DECOMPRESS stages +
+``server.cc`` ``SumRecvBuff``) with devices as both workers and "servers":
+device j owns segment j of every chunk (the analog of key→server hashing),
+receives peers' compressed segments over ``all_to_all``, decompresses, sums
+in fp32, recompresses, and ``all_gather``s the result. Wire bytes per
+direction are (N−1)/N · compressed_size — the same ratio the reference's
+colocated-server topology achieves.
+
+Compressors whose payloads sum positionally (seed-synced randomk) skip the
+decompress/recompress round trip entirely — the positional-sum fast path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from byteps_tpu.compression.base import Compressor
+
+
+def _segment(g: jnp.ndarray, n_dev: int):
+    """Pad a flat (L,) vector and view as (n_dev, seg) owner-major segments."""
+    L = g.shape[0]
+    seg = -(-L // n_dev)
+    gp = jnp.pad(g, (0, seg * n_dev - L))
+    return gp.reshape(n_dev, seg), seg
+
+
+@functools.partial(jax.jit, static_argnames=("axis", "average", "mesh"))
+def _allreduce_impl(x, *, mesh: Mesh, axis: str, average: bool):
+    n = mesh.shape[axis]
+
+    def inner(blk):
+        s = jax.lax.psum(blk[0], axis)
+        return s / n if average else s
+
+    return jax.shard_map(inner, mesh=mesh, in_specs=P(axis), out_specs=P())(x)
+
+
+def allreduce_flat(
+    x: jnp.ndarray, mesh: Mesh, axis: Optional[str] = None, average: bool = True
+) -> jnp.ndarray:
+    """Uncompressed all-reduce of (N, L) → (L,): one fused psum."""
+    axis = axis or mesh.axis_names[0]
+    return _allreduce_impl(x, mesh=mesh, axis=axis, average=average)
+
+
+@functools.partial(jax.jit, static_argnames=("axis", "root", "mesh"))
+def _broadcast_impl(x, *, mesh: Mesh, axis: str, root: int):
+    def inner(blk):
+        mine = blk[0]
+        idx = jax.lax.axis_index(axis)
+        contrib = jnp.where(idx == root, mine, jnp.zeros_like(mine))
+        return jax.lax.psum(contrib, axis)
+
+    return jax.shard_map(inner, mesh=mesh, in_specs=P(axis), out_specs=P())(x)
+
+
+def broadcast_flat(
+    x: jnp.ndarray, mesh: Mesh, root: int = 0, axis: Optional[str] = None
+) -> jnp.ndarray:
+    """Row ``root`` of (N, L) → replicated (L,).
+
+    Implemented as zero-on-non-root + psum, exactly how the reference
+    implements ``broadcast_parameters`` (byteps/torch/__init__.py).
+    """
+    axis = axis or mesh.axis_names[0]
+    return _broadcast_impl(x, mesh=mesh, axis=axis, root=root)
+
+
+def compressed_allreduce_local(
+    g: jnp.ndarray,
+    rng: jnp.ndarray,
+    compressor: Compressor,
+    axis: str,
+    n: int,
+    average: bool = True,
+    two_way: bool = True,
+    ef_residual: Optional[jnp.ndarray] = None,
+):
+    """Per-device body of the compressed all-reduce.
+
+    Call **inside** shard_map/pmap with mesh axis ``axis`` of size ``n``;
+    ``g`` is this device's flat (L,) gradient chunk, ``rng`` a key
+    replicated across devices. Used directly by the fused
+    ``DistributedOptimizer`` path and wrapped by
+    :func:`compressed_allreduce_flat` for the eager path.
+
+    If ``ef_residual`` is given, error feedback is applied: the compressed
+    input is ``g + ef_residual`` and the return value is a tuple
+    ``(out, new_residual)`` with ``new_residual = input − D(C(input))``
+    (reference ``FastUpdateError``; the own-payload decompress costs one
+    extra local decompress, no second compression).
+    """
+    L = g.shape[0]
+    g = g.astype(jnp.float32)
+    if ef_residual is not None:
+        g = g + ef_residual
+    segs, seg = _segment(g, n)      # (n, seg): row j goes to owner j
+    # Per-segment rng keys must agree across devices (randomk index
+    # agreement, reference's synchronized-seed requirement): derive from
+    # the replicated base key + segment id only.
+    seg_keys = jax.vmap(lambda j: jax.random.fold_in(rng, j))(jnp.arange(n))
+    payload = jax.vmap(compressor.compress)(segs, seg_keys)
+
+    # COMPRESS → "PUSH": owner j receives every peer's segment j.
+    recv = jax.tree.map(
+        lambda a: jax.lax.all_to_all(a, axis, 0, 0, tiled=True), payload
+    )
+    my_id = jax.lax.axis_index(axis)
+    my_key = jax.random.fold_in(rng, my_id)
+
+    if compressor.presummable:
+        # positional-sum fast path: sum payloads, one decompress at end
+        out_payload = jax.tree.map(lambda a: a.sum(axis=0), recv)
+    else:
+        # server path: decompress each peer's segment, fp32 sum
+        dec = jax.vmap(
+            lambda p: compressor.decompress(p, seg, jnp.float32, my_key)
+        )(recv)
+        s = dec.sum(axis=0)
+        if two_way:
+            # recompress the sum for the "PULL" direction
+            out_payload = compressor.compress(s, my_key)
+        else:
+            out_payload = {"dense": s}
+
+    # "PULL": broadcast owner results to everyone.
+    gathered = jax.tree.map(
+        lambda a: jax.lax.all_gather(a, axis, axis=0, tiled=False), out_payload
+    )
+    if compressor.presummable or two_way:
+        all_keys = jax.vmap(lambda j: jax.random.fold_in(rng, j))(jnp.arange(n))
+        out_segs = jax.vmap(
+            lambda p, k: compressor.decompress(p, seg, jnp.float32, k)
+        )(gathered, all_keys)
+    else:
+        out_segs = gathered["dense"]
+    out = out_segs.reshape(-1)[:L]
+    out = out / n if average else out
+    if ef_residual is None:
+        return out
+    local_approx = jax.vmap(
+        lambda p, k: compressor.decompress(p, seg, jnp.float32, k)
+    )(payload, seg_keys)
+    new_residual = g - local_approx.reshape(-1)[:L]
+    return out, new_residual
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("compressor", "axis", "average", "mesh", "two_way"),
+)
+def _compressed_allreduce_impl(
+    x,
+    base_rng,
+    *,
+    compressor: Compressor,
+    mesh: Mesh,
+    axis: str,
+    average: bool,
+    two_way: bool,
+):
+    n = mesh.shape[axis]
+
+    def inner(blk, rng):
+        return compressed_allreduce_local(
+            blk[0], rng, compressor, axis, n, average=average, two_way=two_way
+        )
+
+    # check_vma=False: the output IS replicated (it ends in an all_gather of
+    # owner segments identical on every device), but the static
+    # varying-mesh-axes analysis can't prove that through the tree_map'd
+    # collectives.
+    return jax.shard_map(
+        inner, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(),
+        check_vma=False,
+    )(x, base_rng)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("compressor", "axis", "average", "mesh", "two_way"),
+)
+def _compressed_allreduce_ef_impl(
+    x,
+    ef,
+    base_rng,
+    *,
+    compressor: Compressor,
+    mesh: Mesh,
+    axis: str,
+    average: bool,
+    two_way: bool,
+):
+    n = mesh.shape[axis]
+
+    def inner(blk, eblk, rng):
+        out, new_e = compressed_allreduce_local(
+            blk[0], rng, compressor, axis, n,
+            average=average, two_way=two_way, ef_residual=eblk[0],
+        )
+        return out, new_e[None]
+
+    return jax.shard_map(
+        inner, mesh=mesh, in_specs=(P(axis), P(axis), P()),
+        out_specs=(P(), P(axis)), check_vma=False,
+    )(x, ef, base_rng)
+
+
+def compressed_allreduce_flat(
+    x: jnp.ndarray,
+    compressor: Compressor,
+    mesh: Mesh,
+    axis: Optional[str] = None,
+    average: bool = True,
+    rng: Optional[jnp.ndarray] = None,
+    two_way: bool = True,
+    ef_residual: Optional[jnp.ndarray] = None,
+):
+    """Compressed all-reduce of (N, L) → (L,).
+
+    ``two_way=True`` compresses both directions (reference: server
+    recompresses before answering pulls — lossier, max wire savings);
+    ``two_way=False`` returns the exact fp32 segment sums (compress on push
+    only). ``rng`` must be identical on all callers (it is, under the
+    single-controller model); stochastic compressors require it.
+
+    With ``ef_residual`` (an (N, L) per-device residual), error feedback is
+    applied and ``(out, new_residual)`` is returned.
+    """
+    axis = axis or mesh.axis_names[0]
+    if rng is None:
+        if compressor.stochastic:
+            raise ValueError(
+                f"{compressor.name} requires an rng key advancing every step"
+            )
+        rng = jax.random.PRNGKey(0)
+    if ef_residual is not None:
+        return _compressed_allreduce_ef_impl(
+            x, ef_residual, rng, compressor=compressor, mesh=mesh, axis=axis,
+            average=average, two_way=two_way,
+        )
+    return _compressed_allreduce_impl(
+        x, rng, compressor=compressor, mesh=mesh, axis=axis,
+        average=average, two_way=two_way,
+    )

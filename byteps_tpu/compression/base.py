@@ -1,0 +1,106 @@
+"""Compressor interface + registry.
+
+Reference analog: ``byteps/common/compressor/compressor.h`` (abstract
+``Compressor`` with ``Compress``/``Decompress``/``FastUpdateError``) and
+``compressor_registry.cc`` (string-keyed factories instantiated per tensor
+from string kwargs).
+
+Contract (all jit/vmap-safe, static shapes):
+
+* ``compress(x, rng=None) -> payload`` — ``x`` is a 1-D array; ``payload``
+  is a dict of arrays whose shapes depend only on ``x.shape``/config.
+* ``decompress(payload, n, dtype, rng=None) -> x_hat`` — inverse map to a
+  dense 1-D array of length ``n``.
+* ``compressed_bytes(n, itemsize)`` — wire size, for scheduling/accounting.
+* Stochastic compressors take an explicit ``rng`` (threefry key). Compressors
+  whose *placement* must agree across workers (randomk) derive it only from
+  caller-supplied keys, never from device identity.
+
+The aggregation tier does decompress → fp32 sum → recompress, exactly like
+the reference server (``byteps/server/server.cc`` decompress-sum path).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+
+Payload = Dict[str, jnp.ndarray]
+
+
+class Compressor:
+    """Base compressor; identity by default."""
+
+    name = "identity"
+    # True if payloads from different workers can be summed positionally
+    # without decompressing (all workers emit the same support/encoding —
+    # e.g. randomk with synchronized seeds, or identity). The aggregation
+    # tier then skips decompress-sum-recompress, like the reference server's
+    # positional-sum fast path for seed-synced randomk.
+    presummable = True
+    # True if compress/decompress REQUIRE an rng key (randomk placement,
+    # dithering's stochastic rounding). Callers must then provide a key that
+    # advances every step — a constant key silently freezes the sample.
+    stochastic = False
+
+    def compress(self, x: jnp.ndarray, rng: Optional[jnp.ndarray] = None) -> Payload:
+        return {"values": x}
+
+    def decompress(
+        self,
+        payload: Payload,
+        n: int,
+        dtype=jnp.float32,
+        rng: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        return payload["values"].astype(dtype)
+
+    def compressed_bytes(self, n: int, itemsize: int = 4) -> int:
+        return n * itemsize
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"<{type(self).__name__}>"
+
+
+_REGISTRY: Dict[str, Callable[..., Compressor]] = {}
+
+
+def register_compressor(name: str):
+    def deco(factory: Callable[..., Compressor]):
+        _REGISTRY[name] = factory
+        return factory
+
+    return deco
+
+
+def get_compressor(name: str, **kwargs: Any) -> Compressor:
+    if name in (None, "", "identity", "none"):
+        return Compressor()
+    if name not in _REGISTRY:
+        raise KeyError(
+            f"unknown compressor '{name}'; registered: {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[name](**kwargs)
+
+
+def from_params(params: Optional[Dict[str, Any]]) -> "CompressionSpec":
+    """Parse a reference-style ``compression_params`` dict into a spec."""
+    from byteps_tpu.compression.error_feedback import CompressionSpec
+
+    params = dict(params or {})
+    name = params.pop("compressor", None)
+    ef = params.pop("ef", None)
+    momentum = params.pop("momentum", None)
+    mu = params.pop("mu", 0.9)
+    seed = params.pop("seed", 0)
+    two_way = params.pop("two_way", True)
+    compressor = get_compressor(name, **params) if name else Compressor()
+    return CompressionSpec(
+        compressor=compressor,
+        ef=ef in ("vanilla", True, "1"),
+        momentum=momentum in ("nesterov", True, "1"),
+        mu=mu,
+        seed=seed,
+        two_way=bool(two_way),
+    )

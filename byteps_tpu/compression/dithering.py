@@ -1,0 +1,102 @@
+"""Stochastic (dithered) quantization
+(reference: ``byteps/common/compressor/impl/dithering.{h,cc}``).
+
+Quantizes x/||x|| onto s levels with stochastic rounding (unbiased), keeping
+the sign; wire format = int8 levels + one fp32 norm. Options mirror the
+reference kwargs:
+
+* ``s`` — number of quantization levels (default 127 to fit int8).
+* ``partition`` — ``"linear"`` (levels i/s) or ``"natural"`` (powers of two:
+  levels 2^-j, denser near zero).
+* ``normalize`` — ``"l2"`` or ``"max"`` norm.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from byteps_tpu.compression.base import Compressor, Payload, register_compressor
+
+
+@register_compressor("dithering")
+class DitheringCompressor(Compressor):
+    name = "dithering"
+    presummable = False  # per-worker norms differ; levels aren't summable
+    stochastic = True
+
+    def __init__(
+        self,
+        s: int = 127,
+        partition: str = "linear",
+        normalize: str = "l2",
+        **_ignored,
+    ):
+        if partition not in ("linear", "natural"):
+            raise ValueError(f"partition must be linear|natural, got {partition}")
+        if normalize not in ("l2", "max"):
+            raise ValueError(f"normalize must be l2|max, got {normalize}")
+        if not 1 <= int(s) <= 127:
+            raise ValueError(f"s must be in [1, 127] (levels are stored int8), got {s}")
+        self.s = int(s)
+        self.partition = partition
+        self.normalize = normalize
+
+    def _norm(self, x: jnp.ndarray) -> jnp.ndarray:
+        if self.normalize == "l2":
+            return jnp.sqrt(jnp.sum(x * x))
+        return jnp.max(jnp.abs(x))
+
+    def compress(self, x: jnp.ndarray, rng: Optional[jnp.ndarray] = None) -> Payload:
+        if rng is None:
+            raise ValueError("dithering requires an rng key for stochastic rounding")
+        xf = x.astype(jnp.float32)
+        norm = self._norm(xf)
+        safe = jnp.where(norm > 0, norm, 1.0)
+        p = jnp.abs(xf) / safe  # in [0, 1]
+        u = jax.random.uniform(rng, xf.shape)
+        if self.partition == "linear":
+            # scale to [0, s], stochastic-round to integer level
+            y = p * self.s
+            lo = jnp.floor(y)
+            level = lo + (u < (y - lo))
+        else:  # natural: levels 0 and 2^j for j in [-(s-1)..0] over p in (0,1]
+            # express p = 2^e * m with m in [1,2); round m stochastically to
+            # 1 or 2, i.e. quantize onto powers of two
+            tiny = jnp.float32(2.0 ** (-(self.s - 1)))
+            pc = jnp.clip(p, tiny, 1.0)
+            e = jnp.floor(jnp.log2(pc))
+            base = jnp.exp2(e)
+            frac = pc / base - 1.0  # in [0,1)
+            up = (u < frac).astype(jnp.float32)
+            q = base * (1.0 + up)  # 2^e or 2^(e+1)
+            # kill true zeros / below-tiny values stochastically toward 0
+            keep = (u < p / tiny) | (p >= tiny)
+            q = jnp.where(keep, q, 0.0)
+            # store exponent index as level: j = log2(q) + (s-1), 0 => zero
+            level = jnp.where(q > 0, jnp.log2(q) + (self.s - 1) + 1, 0.0)
+        sign = jnp.sign(xf)
+        levels = (sign * level).astype(jnp.int8)
+        return {"levels": levels, "norm": norm.reshape(1)}
+
+    def decompress(
+        self,
+        payload: Payload,
+        n: int,
+        dtype=jnp.float32,
+        rng: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        lv = payload["levels"].astype(jnp.float32)
+        norm = payload["norm"][0]
+        sign = jnp.sign(lv)
+        mag = jnp.abs(lv)
+        if self.partition == "linear":
+            p = mag / self.s
+        else:
+            p = jnp.where(mag > 0, jnp.exp2(mag - 1 - (self.s - 1)), 0.0)
+        return (sign * p * norm).astype(dtype)
+
+    def compressed_bytes(self, n: int, itemsize: int = 4) -> int:
+        return n + 4

@@ -1,0 +1,77 @@
+"""Error-feedback and momentum decorators, as functional pytree state.
+
+Reference analogs:
+``byteps/common/compressor/impl/{error_feedback,vanilla_error_feedback}.{h,cc}``
+(decorator persisting e ← g' − D(C(g')) with g' = g + e_prev, per partition)
+and ``impl/{momentum,nesterov_momentum}.{h,cc}`` (Nesterov momentum applied
+*before* compression, because a compressed PS cannot equivalently apply
+optimizer-side momentum).
+
+The reference keeps this state in C++ side buffers; under jit it must be
+pure, so both decorators are (value, state) → (value, state) functions whose
+state the caller (``DistributedOptimizer``) threads through its pytree
+(SURVEY §7 hard-parts list).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from byteps_tpu.compression.base import Compressor, Payload
+
+
+@dataclasses.dataclass
+class CompressionSpec:
+    """Resolved compression configuration for one tensor/partition."""
+
+    compressor: Compressor
+    ef: bool = False
+    momentum: bool = False
+    mu: float = 0.9
+    seed: int = 0
+    # compress the pull direction too (reference: server re-compresses the
+    # sum before answering pulls). Max wire savings, but the recompression
+    # error is NOT covered by worker-side EF — set False for unbiased
+    # aggregation of the EF-compensated pushes at 2x pull bandwidth.
+    two_way: bool = True
+
+    @property
+    def enabled(self) -> bool:
+        return self.compressor.name != "identity"
+
+
+def ef_init_state(n: int, dtype=jnp.float32) -> jnp.ndarray:
+    """Initial error-feedback residual (zeros, one per compressed chunk)."""
+    return jnp.zeros((n,), dtype)
+
+
+def momentum_init_state(n: int, dtype=jnp.float32) -> jnp.ndarray:
+    return jnp.zeros((n,), dtype)
+
+
+def momentum_step(
+    x: jnp.ndarray, m: jnp.ndarray, mu: float
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Nesterov momentum pre-compression: m' = μm + x; out = x + μm'."""
+    m_new = mu * m + x
+    return x + mu * m_new, m_new
+
+
+def ef_compress(
+    compressor: Compressor,
+    x: jnp.ndarray,
+    e: jnp.ndarray,
+    rng: Optional[jnp.ndarray] = None,
+) -> Tuple[Payload, jnp.ndarray]:
+    """Compress with error feedback.
+
+    corrected = x + e;  payload = C(corrected);
+    e' = corrected − D(payload)   (the ``FastUpdateError`` rule).
+    """
+    corrected = x.astype(jnp.float32) + e
+    payload = compressor.compress(corrected, rng)
+    approx = compressor.decompress(payload, corrected.shape[0], jnp.float32, rng)
+    return payload, corrected - approx

@@ -1,0 +1,68 @@
+"""1-bit sign compression (reference: ``byteps/common/compressor/impl/onebit.{h,cc}``).
+
+Wire format: 32 sign bits packed per uint32 word + one optional fp32 scale.
+``scaling=True`` sets scale = mean(|x|) so decompress returns ±mean|x|
+(reference kwarg ``scaling`` / env ``BYTEPS_COMPRESSOR_ONEBIT_SCALING``);
+otherwise ±1. Compression ratio ≈ 32× vs fp32.
+
+Bit convention: bit=1 ⇔ x >= 0 (non-negative). Padding lanes (beyond n) are
+packed as sign of 0 (= 1) and sliced away on decompress.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from byteps_tpu.compression.base import Compressor, Payload, register_compressor
+
+
+def _pack_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    """bits: (m*32,) of {0,1} int32 -> (m,) uint32."""
+    w = bits.reshape(-1, 32).astype(jnp.uint32)
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    return (w << shifts).sum(axis=1, dtype=jnp.uint32)
+
+
+def _unpack_bits(words: jnp.ndarray) -> jnp.ndarray:
+    """(m,) uint32 -> (m*32,) of {0,1} int32."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (words[:, None] >> shifts) & jnp.uint32(1)
+    return bits.reshape(-1).astype(jnp.int32)
+
+
+@register_compressor("onebit")
+class OnebitCompressor(Compressor):
+    name = "onebit"
+    presummable = False  # signs cannot be summed; must decompress first
+
+    def __init__(self, scaling: bool = True, **_ignored):
+        self.scaling = bool(scaling)
+
+    def compress(self, x: jnp.ndarray, rng: Optional[jnp.ndarray] = None) -> Payload:
+        n = x.shape[0]
+        pad = (-n) % 32
+        xf = x.astype(jnp.float32)
+        xp = jnp.pad(xf, (0, pad))
+        bits = (xp >= 0).astype(jnp.int32)
+        words = _pack_bits(bits)
+        if self.scaling:
+            scale = jnp.mean(jnp.abs(xf)).reshape(1)
+        else:
+            scale = jnp.ones((1,), jnp.float32)
+        return {"signs": words, "scale": scale}
+
+    def decompress(
+        self,
+        payload: Payload,
+        n: int,
+        dtype=jnp.float32,
+        rng: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        bits = _unpack_bits(payload["signs"])[:n]
+        signs = bits.astype(jnp.float32) * 2.0 - 1.0
+        return (signs * payload["scale"][0]).astype(dtype)
+
+    def compressed_bytes(self, n: int, itemsize: int = 4) -> int:
+        return 4 * ((n + 31) // 32) + 4

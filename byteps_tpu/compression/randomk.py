@@ -1,0 +1,62 @@
+"""Random-k sparsification (reference: ``byteps/common/compressor/impl/randomk.{h,cc}``).
+
+Keeps k uniformly-sampled coordinates, scaled by n/k for unbiasedness. The
+reference synchronizes the PRNG seed across workers so all workers pick the
+same indices and the server can sum values positionally without sending
+indices; we reproduce that by deriving indices ONLY from the caller-provided
+``rng`` key (same key on every worker ⇒ same indices — threefry is
+deterministic), so the wire payload is values-only.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from byteps_tpu.compression.base import Compressor, Payload, register_compressor
+from byteps_tpu.compression.topk import resolve_k
+
+
+@register_compressor("randomk")
+class RandomkCompressor(Compressor):
+    name = "randomk"
+    stochastic = True
+
+    def __init__(self, k: Union[int, float] = 0.01, scale: bool = True, **_ignored):
+        self.k = k
+        self.scale = bool(scale)
+
+    def _indices(self, rng: jnp.ndarray, n: int, k: int) -> jnp.ndarray:
+        # without-replacement sample, deterministic in rng
+        return jax.random.choice(rng, n, shape=(k,), replace=False).astype(jnp.int32)
+
+    def compress(self, x: jnp.ndarray, rng: Optional[jnp.ndarray] = None) -> Payload:
+        if rng is None:
+            raise ValueError("randomk requires an rng key (synchronized across workers)")
+        n = x.shape[0]
+        k = resolve_k(self.k, n)
+        idx = self._indices(rng, n, k)
+        vals = x.astype(jnp.float32)[idx]
+        if self.scale:
+            vals = vals * (n / k)
+        return {"values": vals}
+
+    def decompress(
+        self,
+        payload: Payload,
+        n: int,
+        dtype=jnp.float32,
+        rng: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        if rng is None:
+            raise ValueError("randomk decompress requires the same rng used to compress")
+        k = payload["values"].shape[0]
+        idx = self._indices(rng, n, k)
+        dense = jnp.zeros((n,), jnp.float32)
+        dense = dense.at[idx].add(payload["values"])
+        return dense.astype(dtype)
+
+    def compressed_bytes(self, n: int, itemsize: int = 4) -> int:
+        return resolve_k(self.k, n) * itemsize

@@ -1,0 +1,53 @@
+"""Top-k sparsification (reference: ``byteps/common/compressor/impl/topk.{h,cc}``).
+
+Keeps the k coordinates of largest magnitude; wire format = (index, value)
+pairs, matching the reference. ``k`` may be an absolute count or a float
+ratio in (0, 1] (interpreted per compressed chunk, as the reference does
+per partition).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from byteps_tpu.compression.base import Compressor, Payload, register_compressor
+
+
+def resolve_k(k: Union[int, float], n: int) -> int:
+    if isinstance(k, float) and 0 < k <= 1:
+        return max(1, int(n * k))
+    return max(1, min(int(k), n))
+
+
+@register_compressor("topk")
+class TopkCompressor(Compressor):
+    name = "topk"
+    presummable = False  # per-worker supports differ; must densify to sum
+
+    def __init__(self, k: Union[int, float] = 0.01, **_ignored):
+        self.k = k
+
+    def compress(self, x: jnp.ndarray, rng: Optional[jnp.ndarray] = None) -> Payload:
+        n = x.shape[0]
+        k = resolve_k(self.k, n)
+        xf = x.astype(jnp.float32)
+        _, idx = jax.lax.top_k(jnp.abs(xf), k)
+        return {"indices": idx.astype(jnp.int32), "values": xf[idx]}
+
+    def decompress(
+        self,
+        payload: Payload,
+        n: int,
+        dtype=jnp.float32,
+        rng: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        dense = jnp.zeros((n,), jnp.float32)
+        dense = dense.at[payload["indices"]].add(payload["values"])
+        return dense.astype(dtype)
+
+    def compressed_bytes(self, n: int, itemsize: int = 4) -> int:
+        k = resolve_k(self.k, n)
+        return k * (4 + itemsize)

@@ -1,0 +1,363 @@
+"""byteps_tpu.jax — the JAX framework adapter.
+
+Mirrors the reference's per-framework adapter surface
+(``byteps/torch/__init__.py`` is the model: ``init``, ``rank``/``size``,
+``push_pull``, ``DistributedOptimizer``, ``broadcast_parameters``), as the
+BASELINE north star's ``byteps/jax/`` package. Typical use::
+
+    import byteps_tpu.jax as bps
+
+    bps.init()
+    tx = bps.DistributedOptimizer(
+        optax.sgd(0.1),
+        compression_params={"compressor": "onebit", "ef": "vanilla"},
+    )
+    # inside a shard_map'd per-device train step:
+    #   updates, opt_state = tx.update(grads, opt_state, params)
+
+Two aggregation paths (SURVEY §7 phase 2/3):
+
+* **fused** — ``DistributedOptimizer`` / ``push_pull_inside`` used inside the
+  user's jitted ``shard_map`` step: gradients are flattened, chunked to
+  ``BYTEPS_PARTITION_BYTES``, and each chunk aggregated with a psum or the
+  compressed collective, all in one XLA program. This is the
+  peak-bandwidth path — XLA's scheduler overlaps chunk collectives.
+* **eager** — ``push_pull``/``push_pull_async`` on stacked ``(N, ...)``
+  arrays outside jit: each tensor is declared (priority = -declaration
+  order), partitioned, and its chunks dispatched through the credit-limited
+  priority scheduler, preserving the reference's dynamic inter-tensor
+  reordering and giving per-stage chrome traces.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from byteps_tpu.common.config import Config, get_config
+from byteps_tpu.common.logging import bps_check, get_logger
+from byteps_tpu.common.partition import TensorRegistry
+from byteps_tpu.common.scheduler import (
+    Handle,
+    PartitionTask,
+    PipelineScheduler,
+    Stage,
+)
+from byteps_tpu.common.tracing import get_tracer
+from byteps_tpu.comm.ici import (
+    allreduce_flat,
+    broadcast_flat,
+    compressed_allreduce_flat,
+)
+from byteps_tpu.comm.mesh import device_mesh
+from byteps_tpu.compression import from_params
+from byteps_tpu.compression.error_feedback import CompressionSpec
+
+from byteps_tpu.jax.optimizer import (  # noqa: F401,E402
+    DistributedOptimizer,
+    DistributedOptState,
+    dp_state_specs,
+    push_pull_inside,
+)
+
+log = get_logger("jax")
+
+
+class _BytePSJaxState:
+    def __init__(self) -> None:
+        self.initialized = False
+        self.cfg: Optional[Config] = None
+        self.mesh = None
+        self.registry: Optional[TensorRegistry] = None
+        self.scheduler: Optional[PipelineScheduler] = None
+        self.spec: Optional[CompressionSpec] = None
+        self.versions: Dict[str, int] = {}
+        # per-(name, part_idx) EF residual / momentum buffers, (N, plen)
+        self.ef_state: Dict[Any, jnp.ndarray] = {}
+        self.mom_state: Dict[Any, jnp.ndarray] = {}
+        self.base_rng = None
+        self.anon_counter = 0
+        self.lock = threading.Lock()
+
+
+_state = _BytePSJaxState()
+
+
+def init(
+    mesh=None,
+    compression_params: Optional[Dict[str, Any]] = None,
+    seed: int = 0,
+) -> None:
+    """Initialize the adapter (reference: ``byteps_init`` / ``BytePSGlobal::Init``).
+
+    On multi-host TPU pods call ``jax.distributed.initialize()`` first (the
+    launcher does this); ``mesh`` then spans all hosts' devices.
+    """
+    if _state.initialized:
+        return
+    cfg = get_config()
+    _state.cfg = cfg
+    _state.mesh = mesh if mesh is not None else device_mesh()
+    _state.registry = TensorRegistry()
+    _state.spec = from_params(compression_params)
+    _state.base_rng = jax.random.PRNGKey(seed)
+    tracer = get_tracer()
+    # Eager pipeline: PUSHPULL issues the jitted chunk collective (async
+    # dispatch; issue order = execution order on the device stream), SYNC
+    # blocks until the chunk's result is ready and frees the credit.
+    _state.scheduler = PipelineScheduler(
+        stages=[
+            Stage("PUSHPULL", _dispatch_stage, credited=True, pool_size=1),
+            Stage("SYNC", _sync_stage, pool_size=4),
+        ],
+        credit=cfg.scheduling_credit,
+        tracer=tracer,
+    )
+    _state.initialized = True
+    log.info(
+        "byteps_tpu.jax initialized: mesh=%s devices=%d compression=%s",
+        dict(_state.mesh.shape), size(), _state.spec.compressor.name,
+    )
+
+
+def shutdown() -> None:
+    """Reference: ``byteps_shutdown``."""
+    if _state.scheduler is not None:
+        _state.scheduler.shutdown()
+    _state.initialized = False
+    _state.versions.clear()
+    _state.ef_state.clear()
+    _state.mom_state.clear()
+
+
+def _require_init() -> None:
+    bps_check(_state.initialized, "call byteps_tpu.jax.init() first")
+
+
+# --- topology queries (reference: byteps_rank/size/local_rank/local_size) ---
+def rank() -> int:
+    """This controller's worker id (0 on a single-host job)."""
+    _require_init()
+    return _state.cfg.worker_id
+
+
+def size() -> int:
+    """Number of data-parallel participants = dp-axis size (each TPU device
+    is the analog of one reference GPU worker)."""
+    _require_init()
+    return _state.mesh.shape[_state.cfg.dp_axis]
+
+
+def local_rank() -> int:
+    _require_init()
+    return _state.cfg.local_rank
+
+
+def local_size() -> int:
+    _require_init()
+    return jax.local_device_count()
+
+
+def mesh():
+    _require_init()
+    return _state.mesh
+
+
+# --- eager push_pull path ---------------------------------------------------
+def _tensor_rng(name: str, version: int, seed: int = 0):
+    # zlib.crc32 is stable across processes/runs, unlike salted hash() —
+    # multi-host controllers must derive identical keys for the same tensor
+    # (randomk index agreement).
+    import zlib
+
+    base = jax.random.fold_in(_state.base_rng, zlib.crc32(name.encode()) & 0x7FFFFFFF)
+    base = jax.random.fold_in(base, seed)
+    return jax.random.fold_in(base, version)
+
+
+def _dispatch_stage(task: PartitionTask):
+    """Issue the chunk collective (returns an in-flight jax array).
+
+    Applies the reference compression pipeline per partition: Nesterov
+    momentum → error feedback → compress → exchange (the decorator order of
+    the reference's momentum/EF wrappers around the base compressor).
+    """
+    x = task.context["x2d"]
+    p = task.partition
+    chunk = jax.lax.slice_in_dim(x, p.offset, p.offset + p.length, axis=1)
+    spec = task.context["spec"]
+    average = task.context["average"]
+    if not spec.enabled:
+        return allreduce_flat(
+            chunk, _state.mesh, _state.cfg.dp_axis, average=average
+        )
+    rng = jax.random.fold_in(task.context["rng"], p.part_idx)
+    skey = (task.name, p.part_idx)
+    if spec.momentum:
+        m = _state.mom_state.get(skey)
+        if m is None:
+            m = jnp.zeros_like(chunk, dtype=jnp.float32)
+        m = spec.mu * m + chunk.astype(jnp.float32)
+        chunk = chunk.astype(jnp.float32) + spec.mu * m
+        _state.mom_state[skey] = m
+    if spec.ef:
+        e = _state.ef_state.get(skey)
+        if e is None:
+            e = jnp.zeros_like(chunk, dtype=jnp.float32)
+        out, new_e = compressed_allreduce_flat(
+            chunk, spec.compressor, _state.mesh, _state.cfg.dp_axis,
+            average=average, rng=rng, two_way=spec.two_way, ef_residual=e,
+        )
+        _state.ef_state[skey] = new_e
+        return out
+    return compressed_allreduce_flat(
+        chunk, spec.compressor, _state.mesh, _state.cfg.dp_axis,
+        average=average, rng=rng, two_way=spec.two_way,
+    )
+
+
+def _sync_stage(task: PartitionTask):
+    out = task.payload
+    out.block_until_ready()
+    return out
+
+
+def push_pull_async(
+    x: jnp.ndarray,
+    average: bool = True,
+    name: Optional[str] = None,
+    priority: Optional[int] = None,
+    compression_params: Optional[Dict[str, Any]] = None,
+) -> Handle:
+    """Asynchronously all-reduce a stacked per-device tensor.
+
+    ``x`` has shape ``(size(), ...)``, row d = device d's local value (the
+    analog of each reference worker's GPU buffer), ideally sharded over the
+    dp axis. Returns a Handle; ``handle.wait()`` / :func:`synchronize`.
+
+    Reference: ``byteps_push_pull`` / ``byteps_torch_push_pull_async``.
+    """
+    _require_init()
+    n = size()
+    bps_check(x.ndim >= 1 and x.shape[0] == n,
+              f"expected leading axis {n} (= size()), got {x.shape}")
+    with _state.lock:
+        if name is None:
+            name = f"byteps_push_pull.anon_{_state.anon_counter}"
+            _state.anon_counter += 1
+    inner_shape = x.shape[1:]
+    L = int(np.prod(inner_shape)) if inner_shape else 1
+    ctx = _state.registry.declare(name, (L,), np.dtype(x.dtype))
+    with _state.lock:
+        version = _state.versions.get(name, 0)
+        _state.versions[name] = version + 1
+    spec = (
+        from_params(compression_params)
+        if compression_params is not None
+        else _state.spec
+    )
+    # Skip compression for tiny tensors (reference: BYTEPS_MIN_COMPRESS_BYTES)
+    if spec.enabled and L * np.dtype(x.dtype).itemsize < _state.cfg.min_compress_bytes:
+        spec = from_params(None)
+    x2d = x.reshape(n, L)
+    handle = Handle(name, len(ctx.partitions))
+    handle.inner_shape = inner_shape  # type: ignore[attr-defined]
+    handle.dtype = x.dtype            # type: ignore[attr-defined]
+    shared = {
+        "x2d": x2d,
+        "spec": spec,
+        "average": average,
+        "rng": _tensor_rng(name, version, spec.seed),
+    }
+    tasks = []
+    for p in ctx.partitions:
+        if priority is not None:
+            p = type(p)(  # override declaration-order priority if given
+                key=p.key, tensor_id=p.tensor_id, part_idx=p.part_idx,
+                offset=p.offset, length=p.length, priority=priority,
+            )
+        tasks.append(
+            PartitionTask(partition=p, name=name, handle=handle, context=shared)
+        )
+    _state.scheduler.enqueue(tasks)
+    return handle
+
+
+def synchronize(handle: Handle, timeout: Optional[float] = 120.0) -> jnp.ndarray:
+    """Wait for a handle and assemble the replicated result.
+
+    Reference: ``synchronize()``/``wait_and_clear`` in byteps/torch.
+    """
+    results = handle.wait(timeout)
+    parts = [results[i] for i in sorted(results)]
+    flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+    out = flat.reshape(handle.inner_shape)  # type: ignore[attr-defined]
+    return out.astype(handle.dtype)         # type: ignore[attr-defined]
+
+
+def push_pull(
+    x: jnp.ndarray,
+    average: bool = True,
+    name: Optional[str] = None,
+    priority: Optional[int] = None,
+    compression_params: Optional[Dict[str, Any]] = None,
+) -> jnp.ndarray:
+    """Blocking push_pull (reference: ``push_pull(tensor, average, name)``)."""
+    return synchronize(
+        push_pull_async(x, average, name, priority, compression_params)
+    )
+
+
+def push_pull_tree(
+    grads, average: bool = True, name_prefix: str = "grad",
+) -> Any:
+    """Eagerly aggregate a pytree of stacked (N, ...) gradients; tensors are
+    declared in pytree order so earlier leaves get higher priority."""
+    _require_init()
+    leaves, treedef = jax.tree.flatten(grads)
+    handles = [
+        push_pull_async(leaf, average=average, name=f"{name_prefix}.{i}")
+        for i, leaf in enumerate(leaves)
+    ]
+    outs = [synchronize(h) for h in handles]
+    return jax.tree.unflatten(treedef, outs)
+
+
+# --- broadcast (reference: broadcast_parameters / broadcast_optimizer_state) -
+def broadcast_parameters(params, root_rank: int = 0):
+    """Replicate row ``root_rank`` of stacked (N, ...) leaves to all rows'
+    consumers — returns the replicated pytree (functional, unlike the
+    reference's in-place op). Implemented as zero-on-non-root + psum, the
+    reference's own trick."""
+    _require_init()
+
+    def bcast(leaf):
+        n = size()
+        bps_check(leaf.shape[0] == n, f"leading axis must be {n}")
+        L = int(np.prod(leaf.shape[1:])) if leaf.ndim > 1 else 1
+        # native dtype throughout: zero-plus-psum is exact for ints too,
+        # and a float32 round-trip would corrupt int leaves > 2^24
+        flat = broadcast_flat(
+            leaf.reshape(n, L), _state.mesh, root=root_rank,
+            axis=_state.cfg.dp_axis,
+        )
+        return flat.reshape(leaf.shape[1:])
+
+    return jax.tree.map(bcast, params)
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0):
+    """Parity alias: optimizer states are pytrees too."""
+    return broadcast_parameters(opt_state, root_rank)
+
+
+def declare_tensor(name: str, shape, dtype) -> None:
+    """Pre-declare to fix priority order explicitly (reference:
+    ``byteps_declare_tensor``)."""
+    _require_init()
+    L = int(np.prod(shape)) if len(tuple(shape)) else 1
+    _state.registry.declare(name, (L,), np.dtype(dtype))

@@ -4,17 +4,23 @@ Multi-device is faked on CPU (SURVEY §4 rebuild guidance): 8 virtual CPU
 devices substitute for a TPU slice, mirroring how the reference fakes
 multi-node with multi-process on localhost.
 
-Must run before jax is imported anywhere.
+Note: this environment's sitecustomize exports JAX_PLATFORMS=axon (the real
+TPU tunnel) at interpreter startup, so the env var alone is not enough —
+``jax.config.update`` after import is authoritative. XLA_FLAGS must still be
+set before the backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
@@ -27,3 +33,9 @@ def _fresh_config(monkeypatch):
     config_mod.reset_config()
     yield
     config_mod.reset_config()
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    """8-device 1-D dp mesh on CPU."""
+    return jax.make_mesh((8,), ("dp",))

@@ -1,0 +1,180 @@
+"""ICI collective layer on a faked 8-device CPU mesh (SURVEY §4 tier-2).
+
+The compressed all-reduce's dataflow mirrors the reference hybrid PS
+(compress → owner decompress → fp32 sum → recompress → broadcast); these
+tests pin its numerics.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byteps_tpu.comm.ici import (
+    allreduce_flat,
+    broadcast_flat,
+    compressed_allreduce_flat,
+)
+from byteps_tpu.compression import (
+    Compressor,
+    OnebitCompressor,
+    RandomkCompressor,
+    TopkCompressor,
+    DitheringCompressor,
+)
+
+N = 8
+
+
+@pytest.fixture
+def grads():
+    rng = np.random.RandomState(0)
+    return jnp.asarray(rng.randn(N, 1000).astype(np.float32))
+
+
+def test_allreduce_mean(grads, mesh8):
+    out = allreduce_flat(grads, mesh8, average=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(grads).mean(axis=0), rtol=1e-5
+    )
+
+
+def test_allreduce_sum(grads, mesh8):
+    out = allreduce_flat(grads, mesh8, average=False)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(grads).sum(axis=0), rtol=1e-5
+    )
+
+
+def test_broadcast_root(grads, mesh8):
+    for root in (0, 3, 7):
+        out = broadcast_flat(grads, mesh8, root=root)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(grads)[root], rtol=1e-6)
+
+
+def test_identity_compressed_equals_allreduce(grads, mesh8):
+    """Identity compressor -> positional-sum fast path == chunked RS+AG ==
+    plain psum result."""
+    out = compressed_allreduce_flat(grads, Compressor(), mesh8, average=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(grads).mean(axis=0), rtol=1e-5
+    )
+
+
+def test_identity_compressed_with_padding(mesh8):
+    """L=1003 not divisible by 8: pad/trim must be exact."""
+    g = jnp.asarray(np.random.RandomState(1).randn(N, 1003).astype(np.float32))
+    out = compressed_allreduce_flat(g, Compressor(), mesh8, average=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(g).mean(axis=0), rtol=1e-5)
+
+
+def test_topk_full_k_exact(grads, mesh8):
+    """k=1.0 keeps everything -> both directions lossless -> exact mean."""
+    out = compressed_allreduce_flat(grads, TopkCompressor(k=1.0), mesh8, average=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(grads).mean(axis=0), rtol=1e-4
+    )
+
+
+def test_randomk_full_k_exact(grads, mesh8):
+    out = compressed_allreduce_flat(
+        grads, RandomkCompressor(k=1.0), mesh8, average=True,
+        rng=jax.random.PRNGKey(3),
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(grads).mean(axis=0), rtol=1e-4
+    )
+
+
+def test_randomk_sparse_support_and_sum(grads, mesh8):
+    """k<1: result support = the k synced indices per segment; values are the
+    mean of all workers' (scaled) entries there."""
+    k = 0.25
+    out = np.asarray(
+        compressed_allreduce_flat(
+            grads, RandomkCompressor(k=k), mesh8, average=True,
+            rng=jax.random.PRNGKey(5),
+        )
+    )
+    # support: 25% of each 125-element segment = 31 indices * 8 segments
+    nz = (out != 0).sum()
+    assert 8 * 28 <= nz <= 8 * 31  # some sampled entries may be ~0 by chance
+    # unbiasedness-ish: nonzero entries equal scaled mean at those coords
+    g_mean = np.asarray(grads).mean(axis=0)
+    idx = np.nonzero(out)[0]
+    scale = 1 / k  # n/k scaling per segment (125/31 ~= 4.03, approx 1/k)
+    ratio = out[idx] / g_mean[idx]
+    assert np.median(np.abs(ratio)) == pytest.approx(scale, rel=0.12)
+
+
+def test_onebit_golden_two_stage(grads, mesh8):
+    """Pin the full two-stage dataflow against a numpy simulation of
+    segment-wise onebit (compress -> sum of D(C(.)) -> recompress)."""
+    out = np.asarray(
+        compressed_allreduce_flat(
+            grads, OnebitCompressor(scaling=True), mesh8, average=True, two_way=True
+        )
+    )
+    g = np.asarray(grads)
+    L = g.shape[1]
+    seg = L // N  # 1000/8 = 125 exactly
+    golden = np.zeros(L, np.float32)
+
+    def dc(v):  # D(C(v)) for onebit+scaling
+        return np.where(v >= 0, 1.0, -1.0).astype(np.float32) * np.abs(v).mean()
+
+    for j in range(N):
+        sl = slice(j * seg, (j + 1) * seg)
+        s = np.zeros(seg, np.float32)
+        for w in range(N):
+            s += dc(g[w, sl])
+        golden[sl] = dc(s) / N  # two-way: recompressed sum, averaged
+    np.testing.assert_allclose(out, golden, rtol=1e-4, atol=1e-6)
+
+
+def test_onebit_one_way_exact_sign_sum(grads, mesh8):
+    """two_way=False returns the exact fp32 sum of the workers' sign
+    approximations (no recompression loss)."""
+    out = np.asarray(
+        compressed_allreduce_flat(
+            grads, OnebitCompressor(scaling=True), mesh8, average=False, two_way=False
+        )
+    )
+    g = np.asarray(grads)
+    seg = g.shape[1] // N
+    golden = np.zeros(g.shape[1], np.float32)
+    for j in range(N):
+        sl = slice(j * seg, (j + 1) * seg)
+        for w in range(N):
+            v = g[w, sl]
+            golden[sl] += np.where(v >= 0, 1.0, -1.0) * np.abs(v).mean()
+    np.testing.assert_allclose(out, golden, rtol=1e-4, atol=1e-5)
+
+
+def test_dithering_statistical(mesh8):
+    """Dithered compressed allreduce approximates the true mean in
+    expectation over rng keys."""
+    g = jnp.asarray(np.random.RandomState(7).randn(N, 64).astype(np.float32))
+    c = DitheringCompressor(s=127, partition="linear", normalize="l2")
+    outs = []
+    for seed in range(20):
+        outs.append(
+            np.asarray(
+                compressed_allreduce_flat(
+                    g, c, mesh8, average=True, rng=jax.random.PRNGKey(seed),
+                    two_way=False,
+                )
+            )
+        )
+    mean = np.stack(outs).mean(axis=0)
+    true = np.asarray(g).mean(axis=0)
+    # s=127 levels: per-sample quantization error is tiny; 20-seed mean tighter
+    np.testing.assert_allclose(mean, true, atol=0.02)
+
+
+def test_compressed_wire_ratio_accounting():
+    """compressed_bytes drives scheduling decisions; sanity-check ratios."""
+    assert OnebitCompressor().compressed_bytes(1024) == 1024 // 32 * 4 + 4
+    assert TopkCompressor(k=0.01).compressed_bytes(10000) == 100 * 8
+    assert RandomkCompressor(k=0.01).compressed_bytes(10000) == 100 * 4
+    assert DitheringCompressor().compressed_bytes(1024) == 1024 + 4
