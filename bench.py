@@ -1,0 +1,184 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Two modes, chosen by visible device count:
+
+* **multi-device** (a real slice or a virtual CPU mesh): gradient all-reduce
+  bus bandwidth GB/s/chip through the framework's partitioned path
+  (push_pull_inside: BYTEPS_PARTITION_BYTES chunks in declaration order)
+  vs. the native single fused psum — ``vs_baseline`` is ours/native, the
+  BASELINE north star's "≥90% of native all-reduce" criterion.
+
+* **single-chip**: flagship GPT train-step throughput (tokens/s) through the
+  full framework stack (DistributedOptimizer on a 1-device mesh) vs. an
+  identical plain jax+optax train step — ``vs_baseline`` is ours/plain,
+  i.e. the framework-overhead ratio (1.0 = zero overhead), mirroring the
+  reference's synthetic benchmark methodology
+  (example/pytorch/benchmark_byteps.py measures img/s with/without byteps).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _time_it(fn, warmup: int = 3, iters: int = 10) -> float:
+    """Median wall seconds per call (fn must block until ready)."""
+    for _ in range(warmup):
+        fn()
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def _time_pair(fn_a, fn_b, warmup: int = 2, iters: int = 8):
+    """Interleaved A/B timing (cancels clock/thermal drift over the device
+    tunnel); each sample is one fn call, which should itself batch several
+    steps. Returns (median_a, median_b)."""
+    for _ in range(warmup):
+        fn_a()
+        fn_b()
+    ta, tb = [], []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn_a()
+        ta.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        fn_b()
+        tb.append(time.perf_counter() - t0)
+    return float(np.median(ta)), float(np.median(tb))
+
+
+def bench_allreduce_multichip() -> dict:
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from byteps_tpu.jax.optimizer import push_pull_inside
+
+    n = len(jax.devices())
+    mesh = jax.make_mesh((n,), ("dp",))
+    elems = 16 * 1024 * 1024  # 64 MB fp32 per device
+    x = jax.device_put(
+        jnp.ones((n, elems), jnp.float32),
+        NamedSharding(mesh, P("dp")),
+    )
+
+    native = jax.jit(jax.shard_map(
+        lambda b: jax.lax.psum(b[0], "dp") / n,
+        mesh=mesh, in_specs=P("dp"), out_specs=P(),
+    ))
+    ours = jax.jit(jax.shard_map(
+        lambda b: push_pull_inside(b[0], axis="dp", n=n),
+        mesh=mesh, in_specs=P("dp"), out_specs=P(),
+    ))
+
+    t_native = _time_it(lambda: native(x).block_until_ready())
+    t_ours = _time_it(lambda: ours(x).block_until_ready())
+    # ring all-reduce bus bandwidth: 2(n-1)/n · bytes / t  per chip
+    nbytes = elems * 4
+    bus = 2 * (n - 1) / n * nbytes
+    gbps = bus / t_ours / 1e9
+    ratio = t_native / t_ours
+    _log(f"allreduce {nbytes/1e6:.0f}MB x{n}dev: ours {t_ours*1e3:.2f}ms, "
+         f"native {t_native*1e3:.2f}ms")
+    return {
+        "metric": "grad all-reduce bus bandwidth (partitioned push_pull)",
+        "value": round(gbps, 3),
+        "unit": "GB/s/chip",
+        "vs_baseline": round(ratio, 4),
+    }
+
+
+def bench_gpt_singlechip() -> dict:
+    import optax
+
+    from byteps_tpu.models import GPTConfig, gpt_init, gpt_loss
+    from byteps_tpu.models.train import make_gpt_train_step, synthetic_batch
+    from byteps_tpu.parallel import MeshAxes, make_mesh
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    cfg = (
+        GPTConfig.tiny() if on_cpu else
+        GPTConfig(vocab_size=32768, max_seq=512, d_model=512, n_heads=8,
+                  n_layers=8, d_ff=2048, dtype=jnp.bfloat16)
+    )
+    batch, seq = (4, 32) if on_cpu else (8, 512)
+    tokens, targets = synthetic_batch(jax.random.PRNGKey(0), cfg, batch, seq)
+
+    # ours: full framework path on a 1-device mesh
+    mesh = make_mesh(MeshAxes(dp=1), devices=jax.devices()[:1])
+    step, params, opt_state, bsh = make_gpt_train_step(
+        cfg, mesh, optax.adamw(1e-3)
+    )
+    tok_s = jax.device_put(tokens, bsh)
+    tgt_s = jax.device_put(targets, bsh)
+
+    state = {"p": params, "o": opt_state}
+    inner = 4 if on_cpu else 20  # steps per timed sample (async-chained)
+
+    def run_ours():
+        for _ in range(inner):
+            loss, state["p"], state["o"] = step(
+                state["p"], state["o"], tok_s, tgt_s
+            )
+        jax.block_until_ready(state["p"])
+
+    # plain jax+optax baseline, identical model/loss
+    gold_tx = optax.adamw(1e-3)
+    gparams = gpt_init(jax.random.PRNGKey(0), cfg)
+    gstate = gold_tx.init(gparams)
+
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def gold_step(p, s, tok, tgt):
+        loss, g = jax.value_and_grad(
+            lambda p_: gpt_loss(p_, tok, tgt, cfg)
+        )(p)
+        u, s = gold_tx.update(g, s, p)
+        return loss, optax.apply_updates(p, u), s
+
+    gold = {"p": gparams, "o": gstate}
+
+    def run_gold():
+        for _ in range(inner):
+            loss, gold["p"], gold["o"] = gold_step(gold["p"], gold["o"],
+                                                   tokens, targets)
+        jax.block_until_ready(gold["p"])
+
+    t_ours, t_gold = _time_pair(run_ours, run_gold)
+    t_ours /= inner
+    t_gold /= inner
+
+    tps = batch * seq / t_ours
+    ratio = t_gold / t_ours  # >1 means we are FASTER than plain jax
+    _log(f"gpt train step ({'tiny/cpu' if on_cpu else 'base/tpu'}): "
+         f"ours {t_ours*1e3:.2f}ms, plain {t_gold*1e3:.2f}ms")
+    return {
+        "metric": "GPT train-step throughput (full framework, 1 chip)",
+        "value": round(tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(ratio, 4),
+    }
+
+
+def main() -> None:
+    n = len(jax.devices())
+    _log(f"bench: {n} device(s): {jax.devices()[0].device_kind}")
+    result = bench_allreduce_multichip() if n > 1 else bench_gpt_singlechip()
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

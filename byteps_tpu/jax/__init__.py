@@ -55,7 +55,7 @@ from byteps_tpu.comm.ici import (
 )
 from byteps_tpu.comm.mesh import device_mesh
 from byteps_tpu.compression import from_params
-from byteps_tpu.compression.error_feedback import CompressionSpec
+from byteps_tpu.compression.error_feedback import CompressionSpec, momentum_step
 
 from byteps_tpu.jax.optimizer import (  # noqa: F401,E402
     DistributedOptimizer,
@@ -201,8 +201,7 @@ def _dispatch_stage(task: PartitionTask):
         m = _state.mom_state.get(skey)
         if m is None:
             m = jnp.zeros_like(chunk, dtype=jnp.float32)
-        m = spec.mu * m + chunk.astype(jnp.float32)
-        chunk = chunk.astype(jnp.float32) + spec.mu * m
+        chunk, m = momentum_step(chunk.astype(jnp.float32), m, spec.mu)
         _state.mom_state[skey] = m
     if spec.ef:
         e = _state.ef_state.get(skey)
@@ -245,8 +244,9 @@ def push_pull_async(
     n = size()
     bps_check(x.ndim >= 1 and x.shape[0] == n,
               f"expected leading axis {n} (= size()), got {x.shape}")
+    anonymous = name is None
     with _state.lock:
-        if name is None:
+        if anonymous:
             name = f"byteps_push_pull.anon_{_state.anon_counter}"
             _state.anon_counter += 1
     inner_shape = x.shape[1:]
@@ -260,6 +260,23 @@ def push_pull_async(
         if compression_params is not None
         else _state.spec
     )
+    if anonymous and spec.enabled and (spec.ef or spec.momentum):
+        # EF/momentum are per-tensor persistent state keyed by name; a fresh
+        # anonymous name every call would never accumulate (EF silently off)
+        # while leaking one gradient-sized buffer per call into the state
+        # dicts. The reference requires named tensors for the same reason
+        # (per-tensor compressor instances in BPSContext).
+        import dataclasses as _dc
+
+        if not getattr(push_pull_async, "_warned_anon_state", False):
+            log.warning(
+                "push_pull called without name= while %s is configured: "
+                "error-feedback/momentum need a stable tensor name to "
+                "persist state — disabled for anonymous tensors",
+                spec.compressor.name,
+            )
+            push_pull_async._warned_anon_state = True  # type: ignore[attr-defined]
+        spec = _dc.replace(spec, ef=False, momentum=False)
     # Skip compression for tiny tensors (reference: BYTEPS_MIN_COMPRESS_BYTES)
     if spec.enabled and L * np.dtype(x.dtype).itemsize < _state.cfg.min_compress_bytes:
         spec = from_params(None)

@@ -25,7 +25,7 @@ import optax
 from byteps_tpu.common.config import get_config
 from byteps_tpu.comm.ici import compressed_allreduce_local
 from byteps_tpu.compression import from_params
-from byteps_tpu.compression.error_feedback import CompressionSpec
+from byteps_tpu.compression.error_feedback import CompressionSpec, momentum_step
 
 
 def _flatten_concat(tree):
@@ -55,43 +55,31 @@ def _chunk_bounds(total: int, chunk_elems: int):
     return bounds or [(0, total)]
 
 
-def push_pull_inside(
-    grads,
-    axis: Optional[str] = None,
-    n: Optional[int] = None,
-    average: bool = True,
-    spec: Optional[CompressionSpec] = None,
-    rng: Optional[jnp.ndarray] = None,
-    ef_residual: Optional[jnp.ndarray] = None,
-    partition_bytes: Optional[int] = None,
-    two_way: bool = True,
+def _aggregate_flat(
+    flat: jnp.ndarray,
+    axis: str,
+    n: int,
+    average: bool,
+    spec: CompressionSpec,
+    rng: Optional[jnp.ndarray],
+    ef_flat: Optional[jnp.ndarray],
+    chunk_elems: int,
+    two_way: bool,
+    chunk_id_offset: int = 0,
 ):
-    """Aggregate a gradient pytree across the dp axis, **inside** shard_map.
+    """Chunk a flat fp32 grad vector and aggregate each chunk over ``axis``.
 
-    Returns ``agg_grads`` (same structure as ``grads``), or
-    ``(agg_grads, new_ef_residual)`` when ``ef_residual`` is given (a flat
-    fp32 vector of the total parameter count).
-
-    This is the fused analog of per-tensor ``push_pull`` calls: one trace,
-    chunked collectives in declaration order, XLA overlaps them.
+    Returns ``(agg_flat, new_ef_flat_or_None, num_chunks)``. The chunking is
+    the reference's tensor partitioning (BYTEPS_PARTITION_BYTES,
+    operations.cc); under jit the chunk collectives are issued in order and
+    XLA overlaps them with surrounding compute.
     """
-    cfg = get_config()
-    axis = axis or cfg.dp_axis
-    if n is None:
-        n = jax.lax.axis_size(axis)
-    if spec is None:
-        spec = from_params(None)
-    partition_bytes = partition_bytes or cfg.partition_bytes
-    chunk_elems = max(1, partition_bytes // 4)  # aggregation runs in fp32
-
-    flat, sizes = _flatten_concat(grads)
     total = flat.shape[0]
     bounds = _chunk_bounds(total, chunk_elems)
-
     out_chunks = []
-    new_e_chunks = [] if ef_residual is not None else None
+    new_e_chunks = [] if ef_flat is not None else None
     for ci, (off, ln) in enumerate(bounds):
-        g = jax.lax.dynamic_slice_in_dim(flat, off, ln)
+        g = jax.lax.slice_in_dim(flat, off, off + ln)
         if spec.enabled:
             if rng is None:
                 if spec.compressor.stochastic:
@@ -101,10 +89,10 @@ def push_pull_inside(
                         "automatically from its step count)"
                     )
                 rng = jax.random.PRNGKey(0)
-            crng = jax.random.fold_in(rng, ci)
+            crng = jax.random.fold_in(rng, chunk_id_offset + ci)
             e = (
-                jax.lax.dynamic_slice_in_dim(ef_residual, off, ln)
-                if ef_residual is not None
+                jax.lax.slice_in_dim(ef_flat, off, off + ln)
+                if ef_flat is not None
                 else None
             )
             res = compressed_allreduce_local(
@@ -122,15 +110,111 @@ def push_pull_inside(
             if new_e_chunks is not None:
                 new_e_chunks.append(jnp.zeros_like(g))
         out_chunks.append(out)
-
-    agg_flat = jnp.concatenate(out_chunks) if len(out_chunks) > 1 else out_chunks[0]
-    agg = _unconcat_unflatten(agg_flat, grads, sizes)
-    if ef_residual is not None:
+    agg = out_chunks[0] if len(out_chunks) == 1 else jnp.concatenate(out_chunks)
+    new_e = None
+    if new_e_chunks is not None:
         new_e = (
-            jnp.concatenate(new_e_chunks) if len(new_e_chunks) > 1 else new_e_chunks[0]
+            new_e_chunks[0] if len(new_e_chunks) == 1
+            else jnp.concatenate(new_e_chunks)
         )
-        return agg, new_e
-    return agg
+    return agg, new_e, len(bounds)
+
+
+def _vma_groups(leaves):
+    """Group leaf indices by their VMA (varying-mesh-axes) type.
+
+    Concatenating a tp-sharded leaf with a replicated one would widen the
+    replicated leaf's inferred variance to the union and break shard_map's
+    out_specs check (and hide real type information). Grouping keeps each
+    concat type-pure; without VMA tracking every leaf lands in one group,
+    which is exactly the old behavior.
+    """
+    groups: Dict[frozenset, list] = {}
+    for i, l in enumerate(leaves):
+        key = frozenset(getattr(jax.typeof(l), "vma", ()) or ())
+        groups.setdefault(key, []).append(i)
+    return list(groups.values())
+
+
+def push_pull_inside(
+    grads,
+    axis: Optional[str] = None,
+    n: Optional[int] = None,
+    average: bool = True,
+    spec: Optional[CompressionSpec] = None,
+    rng: Optional[jnp.ndarray] = None,
+    ef_residual: Optional[jnp.ndarray] = None,
+    partition_bytes: Optional[int] = None,
+    two_way: bool = True,
+):
+    """Aggregate a gradient pytree across the dp axis, **inside** shard_map.
+
+    Returns ``agg_grads`` (same structure as ``grads``), or
+    ``(agg_grads, new_ef_residual)`` when ``ef_residual`` is given (a flat
+    fp32 vector of the total parameter count, laid out in VMA-group order —
+    treat it as opaque state).
+
+    This is the fused analog of per-tensor ``push_pull`` calls: one trace,
+    chunked collectives in declaration order, XLA overlaps them.
+    """
+    cfg = get_config()
+    axis = axis or cfg.dp_axis
+    if n is None:
+        n = jax.lax.axis_size(axis)
+    if spec is None:
+        spec = from_params(None)
+    if n == 1 and not spec.enabled:
+        # single-worker fast path: aggregation is the identity — skip the
+        # flatten/chunk machinery entirely (reference: single-machine mode
+        # short-circuits the PS pipeline, operations.cc queue-list build).
+        # Residual is zeroed exactly like the chunked uncompressed path: no
+        # compression happened, so no error may be carried forward.
+        if ef_residual is not None:
+            return grads, jnp.zeros_like(ef_residual)
+        return grads
+    partition_bytes = partition_bytes or cfg.partition_bytes
+    chunk_elems = max(1, partition_bytes // 4)  # aggregation runs in fp32
+
+    leaves, treedef = jax.tree.flatten(grads)
+    out_leaves = [None] * len(leaves)
+    groups = _vma_groups(leaves)
+    ef_off = 0
+    chunk_id = 0
+    new_e_parts = [] if ef_residual is not None else None
+    for idxs in groups:
+        flats = [jnp.ravel(leaves[i]).astype(jnp.float32) for i in idxs]
+        sizes = [f.shape[0] for f in flats]
+        flat = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+        gtotal = flat.shape[0]
+        e = (
+            jax.lax.slice_in_dim(ef_residual, ef_off, ef_off + gtotal)
+            if ef_residual is not None else None
+        )
+        agg, new_e, nchunks = _aggregate_flat(
+            flat, axis, n, average, spec, rng, e, chunk_elems, two_way,
+            chunk_id_offset=chunk_id,
+        )
+        chunk_id += nchunks
+        if new_e_parts is not None:
+            new_e_parts.append(
+                new_e if new_e is not None else jnp.zeros_like(flat)
+            )
+        off = 0
+        for i, s in zip(idxs, sizes):
+            leaf = leaves[i]
+            out_leaves[i] = (
+                agg[off:off + s].reshape(leaf.shape).astype(leaf.dtype)
+            )
+            off += s
+        ef_off += gtotal
+    agg_tree = jax.tree.unflatten(treedef, out_leaves)
+    if ef_residual is not None:
+        new_e_flat = (
+            new_e_parts[0] if len(new_e_parts) == 1
+            else jnp.concatenate(new_e_parts)
+        )
+        return agg_tree, new_e_flat
+    return agg_tree
 
 
 class DistributedOptState(NamedTuple):
@@ -164,8 +248,12 @@ def DistributedOptimizer(
     spec = from_params(compression_params)
 
     def init_fn(params):
-        flat, _ = _flatten_concat(params)
-        total = flat.shape[0]
+        # count elements from shapes — params may be tp-sharded global
+        # arrays here (no ravel/concat, which would force a resharding)
+        total = sum(
+            int(np.prod(l.shape)) if l.ndim else 1
+            for l in jax.tree.leaves(params)
+        )
         # EF / momentum are PER-DEVICE worker state (each device is one
         # reference worker): globally (n * total,), sharded over the dp axis
         # so each device's shard_map block is its own (total,) buffer. Shard
@@ -193,13 +281,26 @@ def DistributedOptimizer(
             jax.random.fold_in(jax.random.PRNGKey(seed), spec.seed), state.count
         )
 
-        flat, sizes = _flatten_concat(grads)
+        total = sum(
+            int(np.prod(l.shape)) if l.ndim else 1
+            for l in jax.tree.leaves(grads)
+        )
+        for buf, kind in ((state.ef, "EF"), (state.momentum, "momentum")):
+            if buf is not None and buf.shape[0] != total:
+                raise ValueError(
+                    f"{kind} state has {buf.shape[0]} elements per device but "
+                    f"this device's gradients have {total}. Most likely "
+                    "DistributedOptimizer was built without num_devices= on a "
+                    "mesh whose dp axis does not span all jax.devices() — "
+                    "pass num_devices=mesh.shape['dp']."
+                )
+
         mom = state.momentum
         if spec.enabled and mom is not None:
             # Nesterov momentum before compression (reference:
             # nesterov_momentum.cc decorator)
-            mom = spec.mu * mom + flat
-            flat = flat + spec.mu * mom
+            flat, sizes = _flatten_concat(grads)
+            flat, mom = momentum_step(flat, mom, spec.mu)
             grads_in = _unconcat_unflatten(flat, grads, sizes)
         else:
             grads_in = grads

@@ -1,0 +1,15 @@
+"""byteps_tpu.models — model zoo for benchmarks and examples.
+
+The reference ships no models of its own (SURVEY §1: "models come from the
+host framework") — its examples train torchvision/keras models. A
+standalone TPU framework needs its own: these functional JAX models are the
+benchmark/bench.py workloads (BASELINE configs: ResNet-50, BERT, GPT-2) and
+the flagship for the driver's compile checks.
+"""
+
+from byteps_tpu.models.gpt import GPTConfig, gpt_init, gpt_forward, gpt_loss
+from byteps_tpu.models.gpt import gpt_param_specs
+
+__all__ = [
+    "GPTConfig", "gpt_init", "gpt_forward", "gpt_loss", "gpt_param_specs",
+]

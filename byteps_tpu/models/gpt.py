@@ -1,0 +1,193 @@
+"""GPT-style decoder-only transformer — the flagship model.
+
+Functional (params pytree + pure apply), written once for every parallelism
+configuration: the same forward runs single-chip (all axes ``None``),
+tensor-parallel (Megatron col/row-parallel projections over ``tp``), and
+sequence-parallel (ring attention over ``sp``) inside one ``shard_map``.
+BASELINE config 4's workload ("GPT-2 medium with topk sparsification") uses
+this model at size; tests and the driver dry-run use tiny shapes.
+
+MXU notes: all FLOPs are batched matmuls (einsum/`@`) with static shapes;
+activations can run in bfloat16 (``GPTConfig.dtype``) while layernorm,
+softmax and the loss accumulate in float32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from byteps_tpu.parallel.ring_attention import ring_attention
+from byteps_tpu.parallel.tp import (
+    col_parallel_matmul,
+    maybe_psum,
+    row_parallel_matmul,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304
+    max_seq: int = 1024
+    d_model: int = 768
+    n_heads: int = 12
+    n_layers: int = 12
+    d_ff: int = 3072
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def tiny(cls) -> "GPTConfig":
+        """Dry-run / unit-test size; dims divisible by tp=2, sp=2, heads=4."""
+        return cls(vocab_size=256, max_seq=64, d_model=64, n_heads=4,
+                   n_layers=2, d_ff=128)
+
+    @classmethod
+    def gpt2_medium(cls) -> "GPTConfig":
+        return cls(vocab_size=50304, max_seq=1024, d_model=1024,
+                   n_heads=16, n_layers=24, d_ff=4096, dtype=jnp.bfloat16)
+
+
+def gpt_init(rng: jnp.ndarray, cfg: GPTConfig) -> Dict[str, Any]:
+    """Initialize full (unsharded) parameters; shard via device_put after."""
+    d, ff, hd = cfg.d_model, cfg.d_ff, cfg.n_heads * cfg.head_dim
+    std = 0.02
+
+    def dense(key, shape):
+        return (jax.random.normal(key, shape, jnp.float32) * std)
+
+    keys = jax.random.split(rng, 2 + cfg.n_layers)
+    params: Dict[str, Any] = {
+        "wte": dense(keys[0], (cfg.vocab_size, d)),
+        "wpe": dense(keys[1], (cfg.max_seq, d)),
+        "lnf_g": jnp.ones((d,), jnp.float32),
+        "lnf_b": jnp.zeros((d,), jnp.float32),
+        "blocks": [],
+    }
+    for li in range(cfg.n_layers):
+        bk = jax.random.split(keys[2 + li], 6)
+        params["blocks"].append({
+            "ln1_g": jnp.ones((d,), jnp.float32),
+            "ln1_b": jnp.zeros((d,), jnp.float32),
+            "wq": dense(bk[0], (d, hd)), "bq": jnp.zeros((hd,), jnp.float32),
+            "wk": dense(bk[1], (d, hd)), "bk": jnp.zeros((hd,), jnp.float32),
+            "wv": dense(bk[2], (d, hd)), "bv": jnp.zeros((hd,), jnp.float32),
+            # residual-branch projections scaled down with depth (GPT-2 trick)
+            "wo": dense(bk[3], (hd, d)) / (2 * cfg.n_layers) ** 0.5,
+            "bo": jnp.zeros((d,), jnp.float32),
+            "ln2_g": jnp.ones((d,), jnp.float32),
+            "ln2_b": jnp.zeros((d,), jnp.float32),
+            "w1": dense(bk[4], (d, ff)), "b1": jnp.zeros((ff,), jnp.float32),
+            "w2": dense(bk[5], (ff, d)) / (2 * cfg.n_layers) ** 0.5,
+            "b2": jnp.zeros((d,), jnp.float32),
+        })
+    return params
+
+
+def gpt_param_specs(cfg: GPTConfig, tp_axis: Optional[str]) -> Dict[str, Any]:
+    """PartitionSpec tree matching :func:`gpt_init`'s structure.
+
+    Column-parallel weights (qkv, w1) split their output dim over tp; the
+    matching row-parallel weights (wo, w2) split their input dim; biases of
+    column-parallel layers are sharded, everything else replicated (dp/sp
+    replication is implicit — those axes never appear in param specs).
+    """
+    t = tp_axis  # None → fully replicated specs
+    blk = {
+        "ln1_g": P(), "ln1_b": P(),
+        "wq": P(None, t), "bq": P(t),
+        "wk": P(None, t), "bk": P(t),
+        "wv": P(None, t), "bv": P(t),
+        "wo": P(t, None), "bo": P(),
+        "ln2_g": P(), "ln2_b": P(),
+        "w1": P(None, t), "b1": P(t),
+        "w2": P(t, None), "b2": P(),
+    }
+    return {
+        "wte": P(), "wpe": P(), "lnf_g": P(), "lnf_b": P(),
+        "blocks": [dict(blk) for _ in range(cfg.n_layers)],
+    }
+
+
+def _layernorm(x: jnp.ndarray, g: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + 1e-5) * g + b).astype(x.dtype)
+
+
+def _attention(x, p, cfg: GPTConfig, tp_axis, sp_axis):
+    B, S = x.shape[:2]
+    q = col_parallel_matmul(x, p["wq"].astype(x.dtype), p["bq"].astype(x.dtype))
+    k = col_parallel_matmul(x, p["wk"].astype(x.dtype), p["bk"].astype(x.dtype))
+    v = col_parallel_matmul(x, p["wv"].astype(x.dtype), p["bv"].astype(x.dtype))
+    hd = cfg.head_dim
+    h_loc = q.shape[-1] // hd   # heads this tp shard owns
+    q = q.reshape(B, S, h_loc, hd)
+    k = k.reshape(B, S, h_loc, hd)
+    v = v.reshape(B, S, h_loc, hd)
+    o = ring_attention(q, k, v, sp_axis, causal=True)
+    o = o.reshape(B, S, h_loc * hd)
+    return row_parallel_matmul(o, p["wo"].astype(x.dtype), tp_axis,
+                               p["bo"].astype(x.dtype))
+
+
+def _mlp(x, p, tp_axis):
+    h = col_parallel_matmul(x, p["w1"].astype(x.dtype), p["b1"].astype(x.dtype))
+    h = jax.nn.gelu(h)
+    return row_parallel_matmul(h, p["w2"].astype(x.dtype), tp_axis,
+                               p["b2"].astype(x.dtype))
+
+
+def gpt_forward(params, tokens: jnp.ndarray, cfg: GPTConfig,
+                tp_axis: Optional[str] = None,
+                sp_axis: Optional[str] = None) -> jnp.ndarray:
+    """Per-device forward: tokens (B_local, S_local) → logits (f32).
+
+    Single chip: all axes None, tokens are the whole batch/sequence.
+    Inside shard_map: tokens are this device's (dp, sp) block and the
+    weights its tp shard; output logits stay tp/dp/sp-local (replicated
+    over tp by construction).
+    """
+    B, S_loc = tokens.shape
+    if sp_axis is not None:
+        off = jax.lax.axis_index(sp_axis) * S_loc
+    else:
+        off = 0
+    pos = off + jnp.arange(S_loc)
+    x = (params["wte"][tokens] + params["wpe"][pos]).astype(cfg.dtype)
+    for p in params["blocks"]:
+        x = x + _attention(_layernorm(x, p["ln1_g"], p["ln1_b"]), p, cfg,
+                           tp_axis, sp_axis)
+        x = x + _mlp(_layernorm(x, p["ln2_g"], p["ln2_b"]), p, tp_axis)
+    x = _layernorm(x, params["lnf_g"], params["lnf_b"])
+    # weight-tied readout, f32 logits for a stable softmax/loss
+    return x.astype(jnp.float32) @ params["wte"].T.astype(jnp.float32)
+
+
+def gpt_loss(params, tokens, targets, cfg: GPTConfig,
+             dp_axis: Optional[str] = None,
+             tp_axis: Optional[str] = None,
+             sp_axis: Optional[str] = None) -> jnp.ndarray:
+    """Mean next-token cross-entropy, identical (replicated) on every device.
+
+    The replication is what makes per-device ``jax.grad`` correct under
+    shard_map: tp-sharded weights then need NO gradient collective, while
+    dp/sp-replicated weights need a psum over (dp, sp) — exactly the
+    aggregation `DistributedOptimizer` / `sync_grads` provide.
+    """
+    logits = gpt_forward(params, tokens, cfg, tp_axis, sp_axis)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    loss = nll.mean()
+    axes = tuple(a for a in (dp_axis, sp_axis) if a is not None)
+    if axes:
+        loss = jax.lax.pmean(loss, axes)
+    return loss

@@ -1,0 +1,159 @@
+"""Sharded training-step factory for the model zoo.
+
+Builds the full jitted train step over a (dp, tp, sp) mesh: per-device
+loss+grad via ``shard_map`` (ring attention over sp, Megatron collectives
+over tp inside the model), gradient psum over sp, and BytePS aggregation
+over dp through ``DistributedOptimizer`` (reference hot path, SURVEY §3.2 —
+here fused into one XLA program so chunk collectives overlap backward
+compute).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from byteps_tpu.jax.optimizer import DistributedOptimizer
+from byteps_tpu.models.gpt import GPTConfig, gpt_init, gpt_loss, gpt_param_specs
+from byteps_tpu.parallel.sharding import opt_state_specs
+
+
+def _axis(mesh: Mesh, name: str) -> Optional[str]:
+    return name if name in mesh.axis_names else None
+
+
+def make_gpt_train_step(
+    cfg: GPTConfig,
+    mesh: Mesh,
+    base_tx: optax.GradientTransformation,
+    compression_params: Optional[Dict[str, Any]] = None,
+    partition_bytes: Optional[int] = None,
+):
+    """Returns ``(step, params, opt_state, batch_sharding)``.
+
+    ``step(params, opt_state, tokens, targets) -> (loss, params, opt_state)``
+    is jitted over ``mesh``; tokens/targets are global arrays of shape
+    (B, S) sharded (dp, sp) by ``batch_sharding``.
+    """
+    dp, tp, sp = _axis(mesh, "dp"), _axis(mesh, "tp"), _axis(mesh, "sp")
+    pspecs = gpt_param_specs(cfg, tp)
+
+    if dp is not None:
+        tx = DistributedOptimizer(
+            base_tx, compression_params=compression_params, axis=dp,
+            num_devices=mesh.shape[dp], partition_bytes=partition_bytes,
+        )
+    else:
+        tx = base_tx
+
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    params = jax.device_put(
+        params, jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs)
+    )
+    opt_state = tx.init(params)
+    ospecs = opt_state_specs(opt_state, params, pspecs)
+    if dp is not None:
+        # EF / momentum flats are per-dp-worker state (see dp_state_specs)
+        ospecs = ospecs._replace(
+            ef=P(dp) if opt_state.ef is not None else None,
+            momentum=P(dp) if opt_state.momentum is not None else None,
+        )
+    opt_state = jax.device_put(
+        opt_state, jax.tree.map(lambda s: NamedSharding(mesh, s), ospecs)
+    )
+    batch_spec = P(dp, sp)
+    batch_sharding = NamedSharding(mesh, batch_spec)
+
+    # Grad loss is dp-LOCAL (dp_axis=None): each dp replica is one reference
+    # worker computing the grad of its own local mean loss; averaging across
+    # workers is DistributedOptimizer's job (push_pull average=True). A dp
+    # pmean inside the loss would double-apply the 1/n_dp.
+    loss_fn = functools.partial(
+        gpt_loss, cfg=cfg, dp_axis=None, tp_axis=tp, sp_axis=sp
+    )
+
+    # VMA (check_vma=True) is what makes per-device AD exact here: replicated
+    # params' cotangents get the needed psums over sp/tp auto-inserted, and
+    # psum/pmean transpose correctly (under check_vma=False psum transposes
+    # to psum, scaling grads by the axis size whenever the forward contains
+    # collectives). The compressed collective's tree_map'd all_to_all defeats
+    # the VMA analysis (see comm/ici.py), so the compressed path runs with
+    # check_vma=False and is restricted to dp-only meshes, where the forward
+    # has no collectives and per-device AD is trivially exact.
+    use_vma = compression_params is None
+    if not use_vma and (tp is not None or sp is not None):
+        raise NotImplementedError(
+            "compressed aggregation currently requires a dp-only mesh "
+            "(tp/sp axes need the VMA path, which the compressed collective "
+            "does not yet support)"
+        )
+
+    def _resymmetrize(g, spec):
+        """Collapse conservative VMA variance on a grad leaf.
+
+        AD's auto-inserted psums make replicated params' grads bit-identical
+        across sp/tp (verified numerically), but the VMA *type* inference is
+        conservative on some paths (e.g. the embedding cotangent through the
+        residual stream). Where the inferred varying-set exceeds the leaf's
+        spec, a pmean over the excess axes is a numerical identity that
+        restores the invariant type. dp-variance is intended (per-worker
+        grads) and left alone.
+        """
+        allowed = set()
+        for part in spec:
+            if part is None:
+                continue
+            allowed.update((part,) if isinstance(part, str) else part)
+        vma = set(getattr(jax.typeof(g), "vma", ()) or ())
+        excess = tuple(sorted(a for a in vma if a not in allowed and a != dp))
+        return jax.lax.pmean(g, excess) if excess else g
+
+    def per_device_step(params, opt_state, tokens, targets):
+        if dp is not None and mesh.shape[dp] > 1 and use_vma:
+            # mark params dp-varying so AD yields per-replica LOCAL grads
+            # (instead of auto-psumming over dp) — dp aggregation must stay
+            # in DistributedOptimizer, the framework's hot path.
+            grad_params = jax.tree.map(
+                lambda x: jax.lax.pcast(x, (dp,), to="varying"), params
+            )
+        else:
+            grad_params = params
+        loss, grads = jax.value_and_grad(loss_fn)(grad_params, tokens, targets)
+        if use_vma:
+            grads = jax.tree.map(
+                _resymmetrize, grads, pspecs,
+                is_leaf=lambda x: x is None,
+            )
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        if dp is not None:
+            loss = jax.lax.pmean(loss, dp)  # report the global mean loss
+        return loss, params, opt_state
+
+    sharded = jax.shard_map(
+        per_device_step,
+        mesh=mesh,
+        in_specs=(pspecs, ospecs, batch_spec, batch_spec),
+        out_specs=(P(), pspecs, ospecs),
+        check_vma=use_vma,
+    )
+    # donate params/opt_state: the step is an in-place update at the XLA
+    # level (halves HBM traffic for the weight/optimizer buffers)
+    return (
+        jax.jit(sharded, donate_argnums=(0, 1)),
+        params, opt_state, batch_sharding,
+    )
+
+
+def synthetic_batch(
+    rng: jnp.ndarray, cfg: GPTConfig, batch: int, seq: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Random next-token LM batch (the reference benchmarks train on
+    synthetic data too — example/pytorch/benchmark_byteps.py)."""
+    toks = jax.random.randint(rng, (batch, seq + 1), 0, cfg.vocab_size)
+    return toks[:, :-1], toks[:, 1:]

@@ -1,0 +1,31 @@
+"""byteps_tpu.parallel — multi-dimensional parallelism over the device mesh.
+
+The reference implements data parallelism only (SURVEY §2.7); the TPU
+rebuild makes DP one axis of a general ``jax.sharding.Mesh`` and adds the
+axes long-context / large-model training needs: tensor parallelism (tp,
+Megatron-style column/row-parallel matmuls with psum over ICI), sequence /
+context parallelism (sp, ring attention via ``ppermute``), and room for
+pipeline (pp) / expert (ep) axes in the mesh factory.
+
+Everything here is shard_map-first: functions take axis *names* and are
+called inside ``jax.shard_map`` over a mesh built by :func:`make_mesh`.
+"""
+
+from byteps_tpu.parallel.mesh import MeshAxes, make_mesh, factor_devices
+from byteps_tpu.parallel.ring_attention import ring_attention, plain_attention
+from byteps_tpu.parallel.tp import (
+    col_parallel_matmul,
+    row_parallel_matmul,
+    maybe_psum,
+)
+
+__all__ = [
+    "MeshAxes",
+    "make_mesh",
+    "factor_devices",
+    "ring_attention",
+    "plain_attention",
+    "col_parallel_matmul",
+    "row_parallel_matmul",
+    "maybe_psum",
+]

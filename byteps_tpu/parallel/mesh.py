@@ -1,0 +1,74 @@
+"""Mesh factory for multi-axis parallelism.
+
+The reference's topology is env-var process ranks (``DMLC_WORKER_ID`` ×
+``BYTEPS_LOCAL_RANK``, SURVEY §5.6); on TPU the topology is a named
+``jax.sharding.Mesh``. Axis convention (order matters — outermost first so
+dp rides DCN across slices and tp/sp ride ICI within one):
+
+    (pp, dp, sp, tp, ep)   — any axis of size 1 may be omitted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshAxes:
+    """Named axis sizes for :func:`make_mesh`. Size 1 disables an axis."""
+
+    dp: int = 1
+    tp: int = 1
+    sp: int = 1
+    pp: int = 1
+    ep: int = 1
+
+    @property
+    def total(self) -> int:
+        return self.dp * self.tp * self.sp * self.pp * self.ep
+
+    def as_dict(self) -> Dict[str, int]:
+        return {"pp": self.pp, "dp": self.dp, "sp": self.sp,
+                "tp": self.tp, "ep": self.ep}
+
+
+def make_mesh(axes: MeshAxes, devices: Optional[Sequence] = None) -> Mesh:
+    """Build a mesh with only the non-trivial axes of ``axes``.
+
+    Axis order is (pp, dp, sp, tp, ep) outermost→innermost: tp needs the
+    tightest coupling (per-matmul psum) so it gets the innermost (fastest
+    ICI neighbourhood) placement; pp crosses the slowest links.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if axes.total != len(devices):
+        raise ValueError(
+            f"mesh axes {axes.as_dict()} require {axes.total} devices, "
+            f"have {len(devices)}"
+        )
+    names = []
+    sizes = []
+    for name, size in axes.as_dict().items():
+        if size > 1:
+            names.append(name)
+            sizes.append(size)
+    if not names:  # single device: degenerate 1-axis mesh so axis lookups work
+        names, sizes = ["dp"], [1]
+    return jax.make_mesh(tuple(sizes), tuple(names), devices=devices)
+
+
+def factor_devices(n: int, want_tp: int = 2, want_sp: int = 2) -> MeshAxes:
+    """Heuristic (dp, tp, sp) factorization of ``n`` devices.
+
+    Used by the dry-run path and examples: carve off tp then sp (innermost
+    first) when they divide ``n``, leave the rest to dp.
+    """
+    tp = want_tp if n % want_tp == 0 and n >= want_tp else 1
+    rem = n // tp
+    sp = want_sp if rem % want_sp == 0 and rem >= want_sp else 1
+    dp = rem // sp
+    return MeshAxes(dp=dp, tp=tp, sp=sp)

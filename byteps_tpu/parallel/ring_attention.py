@@ -1,0 +1,104 @@
+"""Ring attention — sequence/context parallelism over an sp mesh axis.
+
+No reference analog (SURVEY §5.7: the reference predates long-context
+training); this is the TPU-idiomatic form: the sequence is sharded over the
+``sp`` axis, each device holds one Q/K/V block, and K/V blocks rotate
+around the ring with ``jax.lax.ppermute`` while a flash-attention-style
+online softmax accumulates the output. Wire traffic per step is one K/V
+block over nearest-neighbour ICI links; compute of step t overlaps the
+ppermute of step t+1 on real hardware (XLA async collective).
+
+Differentiable: the ppermute transposes to the reverse rotation, so the
+backward pass is itself a ring.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG = -1e30  # masked-score value; avoids -inf NaN in the online softmax
+
+
+def _block_attn(q, k, v, q_pos, k_pos, scale, causal, m, l, o):
+    """One (q-block × k-block) online-softmax update.
+
+    q: (B, Sq, H, D), k/v: (B, Sk, H, D); m,l: (B, H, Sq); o: (B, Sq, H, D).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale          # (B,H,Sq,Sk)
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]               # (Sq,Sk)
+        s = jnp.where(mask[None, None], s, _NEG)
+    m_new = jnp.maximum(m, s.max(axis=-1))                    # (B,H,Sq)
+    # rescale previous accumulator, accumulate this block
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])                         # (B,H,Sq,Sk)
+    if causal:
+        p = jnp.where(mask[None, None], p, 0.0)
+    l_new = l * alpha + p.sum(axis=-1)
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v
+    )
+    return m_new, l_new, o_new
+
+
+def plain_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    causal: bool = True) -> jnp.ndarray:
+    """Single-device softmax attention, (B, S, H, D) layout. The numerics
+    golden for :func:`ring_attention` and the entry()/single-chip path."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    S, Sk = q.shape[1], k.shape[1]
+    pos_q = jnp.arange(S)
+    pos_k = jnp.arange(Sk)
+    B, _, H, D = q.shape
+    m = jnp.full((B, H, S), _NEG, jnp.float32)
+    l = jnp.zeros((B, H, S), jnp.float32)
+    o = jnp.zeros((B, S, H, D), jnp.float32)
+    m, l, o = _block_attn(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+        pos_q, pos_k, scale.astype(jnp.float32), causal, m, l, o,
+    )
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   sp_axis: Optional[str], causal: bool = True) -> jnp.ndarray:
+    """Sequence-parallel attention inside shard_map.
+
+    q/k/v: (B, S_local, H, D) — this device's sequence block; the global
+    sequence is the sp-axis concatenation of blocks in axis-index order.
+    With ``sp_axis=None`` falls through to :func:`plain_attention`.
+    """
+    if sp_axis is None:
+        return plain_attention(q, k, v, causal=causal)
+    n = jax.lax.axis_size(sp_axis)
+    if n == 1:
+        return plain_attention(q, k, v, causal=causal)
+    idx = jax.lax.axis_index(sp_axis)
+    B, S_loc, H, D = q.shape
+    scale = jnp.float32(1.0 / (D ** 0.5))
+    qf = q.astype(jnp.float32)
+    q_pos = idx * S_loc + jnp.arange(S_loc)
+
+    m = jnp.full((B, H, S_loc), _NEG, jnp.float32)
+    l = jnp.zeros((B, H, S_loc), jnp.float32)
+    o = jnp.zeros((B, S_loc, H, D), jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    k_blk = k.astype(jnp.float32)
+    v_blk = v.astype(jnp.float32)
+    # sp is small and static → unrolled python loop (one XLA program);
+    # lax.scan would re-materialize the ring state each step for no gain.
+    for step in range(n):
+        src = (idx - step) % n                # owner of the block we hold
+        k_pos = src * S_loc + jnp.arange(S_loc)
+        m, l, o = _block_attn(qf, k_blk, v_blk, q_pos, k_pos, scale,
+                              causal, m, l, o)
+        if step + 1 < n:
+            k_blk = jax.lax.ppermute(k_blk, sp_axis, perm)
+            v_blk = jax.lax.ppermute(v_blk, sp_axis, perm)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)

@@ -1,0 +1,38 @@
+"""Sharding-spec helpers for shard_map'd training steps.
+
+The reference never shards state (each GPU process owns full replicas;
+SURVEY §2.7) so none of this has a reference analog — it is the glue that
+makes multi-axis meshes usable: given a params pytree and its PartitionSpec
+tree, derive matching specs for arbitrary optax optimizer states.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+def opt_state_specs(opt_state: Any, params: Any, param_specs: Any) -> Any:
+    """PartitionSpec tree for ``opt_state``.
+
+    Rule: any subtree structurally identical to ``params`` (e.g. Adam's
+    mu/nu) gets ``param_specs``; every other array leaf (step counts,
+    EF/momentum flats handled separately by ``dp_state_specs``) is
+    replicated.
+    """
+    pdef = jax.tree.structure(params)
+
+    def is_param_tree(node: Any) -> bool:
+        try:
+            return jax.tree.structure(node) == pdef
+        except Exception:  # noqa: BLE001 - unregistered nodes are not trees
+            return False
+
+    def mapper(node: Any) -> Any:
+        if is_param_tree(node):
+            return param_specs
+        return P()
+
+    return jax.tree.map(mapper, opt_state, is_leaf=is_param_tree)

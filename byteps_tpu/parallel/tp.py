@@ -1,0 +1,47 @@
+"""Tensor-parallel building blocks (Megatron-style, shard_map-first).
+
+No reference analog (the reference is DP-only, SURVEY §2.7); these are the
+TPU-idiomatic primitives for sharding a transformer's wide matmuls over the
+innermost mesh axis. Called inside ``shard_map``; weights arrive already
+sharded by the in_specs (column-parallel: out-features split; row-parallel:
+in-features split), so the functions are plain matmuls plus the one psum
+the row-parallel output needs — XLA overlaps it with the next layer's
+compute.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def maybe_psum(x: jnp.ndarray, axis: Optional[str]) -> jnp.ndarray:
+    """psum over ``axis`` when it names a mesh axis, identity when None
+    (single-device / axis-disabled path shares the same model code)."""
+    if axis is None:
+        return x
+    return jax.lax.psum(x, axis)
+
+
+def col_parallel_matmul(x: jnp.ndarray, w: jnp.ndarray,
+                        b: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """y_local = x @ w_local: ``w`` is split on its output dim; the result
+    stays sharded (each device owns its slice of features). No collective."""
+    y = x @ w
+    if b is not None:
+        y = y + b
+    return y
+
+
+def row_parallel_matmul(x_local: jnp.ndarray, w: jnp.ndarray,
+                        axis: Optional[str],
+                        b: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """y = psum_tp(x_local @ w_local): ``w`` is split on its input dim,
+    matching a column-parallel producer; the psum makes the output
+    replicated across tp. Bias is added AFTER the psum (it is replicated)."""
+    y = maybe_psum(x_local @ w, axis)
+    if b is not None:
+        y = y + b
+    return y
