@@ -196,9 +196,7 @@ def push_pull_inside(
         )
         chunk_id += nchunks
         if new_e_parts is not None:
-            new_e_parts.append(
-                new_e if new_e is not None else jnp.zeros_like(flat)
-            )
+            new_e_parts.append(new_e)  # always set when ef_residual given
         off = 0
         for i, s in zip(idxs, sizes):
             leaf = leaves[i]

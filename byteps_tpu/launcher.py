@@ -1,0 +1,119 @@
+"""bpslaunch — role dispatch and local process spawn.
+
+Reference analog: ``launcher/launch.py`` (installed as ``bpslaunch``):
+reads ``DMLC_ROLE``; scheduler/server roles run the summation service;
+the worker role spawns ``BYTEPS_LOCAL_SIZE`` copies of the user command
+with per-child rank env, monitors them, and tears the job down if any
+child fails.
+
+TPU deltas (SURVEY §5.8): one worker process drives all local TPU devices
+(so the default local_size is 1, not the visible-device count), and there is
+no separate scheduler node — rendezvous is ``jax.distributed`` or direct
+worker→server TCP connects with retry. ``DMLC_ROLE=scheduler`` is accepted
+for reference-script compatibility and runs an extra (idle) summation
+endpoint only so the process exists and exits cleanly with the job.
+
+Usage (same shape as the reference):
+    DMLC_ROLE=server  DMLC_NUM_WORKER=2 ... python -m byteps_tpu.launcher
+    DMLC_ROLE=worker  DMLC_WORKER_ID=0 ... python -m byteps_tpu.launcher \
+        python train.py
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+from typing import List
+
+from byteps_tpu.common.config import get_config
+from byteps_tpu.common.logging import get_logger
+
+log = get_logger("launcher")
+
+
+def _run_server() -> int:
+    from byteps_tpu.server import serve_forever
+
+    serve_forever()
+    return 0
+
+
+def _run_scheduler() -> int:
+    # Compatibility shim: our design has no scheduler node (SURVEY §5.8 —
+    # jax.distributed replaces ps-lite rendezvous). Block until SIGTERM so
+    # reference launch scripts that expect a long-lived scheduler work.
+    log.info("scheduler role is a no-op in byteps_tpu; idling until killed")
+    signal.sigwait({signal.SIGTERM, signal.SIGINT})
+    return 0
+
+
+def _spawn_workers(cmd: List[str]) -> int:
+    cfg = get_config()
+    local_size = cfg.local_size
+    procs: List[subprocess.Popen] = []
+    single_host_sim = (
+        local_size > 1 and cfg.num_worker == local_size and cfg.worker_id == 0
+    )
+    for i in range(local_size):
+        env = dict(os.environ)
+        env["BYTEPS_LOCAL_RANK"] = str(i)
+        env["BYTEPS_LOCAL_SIZE"] = str(local_size)
+        if single_host_sim:
+            # localhost multi-worker simulation (reference test pattern:
+            # N worker processes on one machine, each a full DMLC worker)
+            env["DMLC_WORKER_ID"] = str(i)
+        log.info("spawning worker local_rank=%d: %s", i, " ".join(cmd))
+        procs.append(subprocess.Popen(cmd, env=env))
+    rc = 0
+    try:
+        # fail-fast: first nonzero child exit kills the rest (reference
+        # launch.py child monitoring)
+        remaining = set(range(len(procs)))
+        while remaining:
+            for idx in list(remaining):
+                p = procs[idx]
+                try:
+                    r = p.wait(timeout=0.2)
+                except subprocess.TimeoutExpired:
+                    continue
+                remaining.discard(idx)
+                if r != 0:
+                    log.error("worker local_rank=%d exited rc=%d — "
+                              "terminating job", idx, r)
+                    rc = r
+                    for j in remaining:
+                        procs[j].terminate()
+                    for j in remaining:
+                        try:
+                            procs[j].wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            procs[j].kill()
+                    remaining.clear()
+    except KeyboardInterrupt:
+        for p in procs:
+            p.terminate()
+        rc = 130
+    return rc
+
+
+def main(argv: List[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    cfg = get_config()
+    role = cfg.role.lower()
+    if role == "server":
+        return _run_server()
+    if role == "scheduler":
+        return _run_scheduler()
+    if role in ("worker", "joint"):
+        if not argv:
+            log.error("worker role needs a command to run")
+            return 2
+        return _spawn_workers(argv)
+    log.error("unknown DMLC_ROLE=%r", role)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

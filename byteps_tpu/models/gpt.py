@@ -22,11 +22,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from byteps_tpu.parallel.ring_attention import ring_attention
-from byteps_tpu.parallel.tp import (
-    col_parallel_matmul,
-    maybe_psum,
-    row_parallel_matmul,
-)
+from byteps_tpu.parallel.tp import col_parallel_matmul, row_parallel_matmul
 
 
 @dataclasses.dataclass(frozen=True)

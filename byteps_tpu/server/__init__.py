@@ -1,0 +1,177 @@
+"""byteps_tpu.server — the DCN-tier parameter server (summation service).
+
+Reference analogs: ``byteps/server/server.{h,cc}`` (the service itself,
+started by ``import byteps.server`` from the launcher) and the worker-side
+``ps::KVWorker`` usage in ``byteps/common/core_loops.cc`` PUSH/PULL stages.
+
+Topology: ``DMLC_NUM_SERVER`` summation servers listen on
+``DMLC_PS_ROOT_PORT + 1 + server_id`` (all on ``DMLC_PS_ROOT_URI`` in the
+localhost test topology; one per aggregator host in a real deployment).
+Partition keys are assigned to servers by ``key % num_server`` — the
+reference's key→server hash placement. There is no separate scheduler
+process: ``jax.distributed`` (or the launcher) does rendezvous, which is the
+TPU-native simplification of ps-lite's scheduler node (SURVEY §5.8).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from byteps_tpu.common.config import Config, get_config
+from byteps_tpu.common.logging import get_logger
+from byteps_tpu.server.native import NativeClient, load_lib, reduce_sum_f32
+
+log = get_logger("server")
+
+__all__ = [
+    "start_server", "stop_server", "serve_forever", "server_addresses",
+    "PSWorker", "reduce_sum_f32",
+]
+
+
+def server_addresses(cfg: Optional[Config] = None) -> List[Tuple[str, int]]:
+    cfg = cfg or get_config()
+    num = max(1, cfg.num_server)
+    return [(cfg.ps_root_uri, cfg.ps_root_port + 1 + i) for i in range(num)]
+
+
+def start_server(
+    port: Optional[int] = None,
+    num_workers: Optional[int] = None,
+    engine_threads: Optional[int] = None,
+    async_mode: Optional[bool] = None,
+    server_id: int = 0,
+) -> int:
+    """Start the native summation service in this process (non-blocking)."""
+    cfg = get_config()
+    lib = load_lib()
+    port = port if port is not None else cfg.ps_root_port + 1 + server_id
+    rc = lib.bps_server_start(
+        port,
+        num_workers if num_workers is not None else cfg.num_worker,
+        engine_threads if engine_threads is not None
+        else cfg.server_engine_threads,
+        1 if (async_mode if async_mode is not None else cfg.enable_async)
+        else 0,
+    )
+    if rc != 0:
+        raise RuntimeError(f"bps_server_start failed (rc={rc}, port={port})")
+    log.info("summation server listening on :%d", port)
+    return port
+
+
+def stop_server() -> None:
+    load_lib().bps_server_stop()
+
+
+def serve_forever(server_id: Optional[int] = None) -> None:
+    """Launcher entry for the server role: start and block until all workers
+    shut down (reference: ``import byteps.server`` → ``StartPS`` blocks)."""
+    import os
+
+    sid = (
+        server_id if server_id is not None
+        else int(os.environ.get("DMLC_SERVER_ID", "0"))
+    )
+    start_server(server_id=sid)
+    load_lib().bps_server_wait()
+    log.info("summation server stopped")
+
+
+class PSWorker:
+    """Worker-side facade: key→server placement, per-key round tracking,
+    connection-per-thread for pipelined push/pull.
+
+    Each OS thread (one per scheduler pool slot) gets its own serial
+    connection to each server, so a pull blocked on a slow round never
+    stalls another partition's push — the deadlock-freedom argument of the
+    reference's separate PUSH/PULL core loops.
+    """
+
+    def __init__(
+        self,
+        servers: Optional[Sequence[Tuple[str, int]]] = None,
+        timeout_ms: int = 60000,
+    ):
+        self._servers = list(servers) if servers else server_addresses()
+        self._timeout = timeout_ms
+        self._tls = threading.local()
+        self._versions: Dict[int, int] = {}
+        self._vlock = threading.Lock()
+        self._all_conns: List[NativeClient] = []
+        self._conn_lock = threading.Lock()
+
+    # -- connection management ----------------------------------------------
+    def _conn(self, sidx: int) -> NativeClient:
+        pool = getattr(self._tls, "conns", None)
+        if pool is None:
+            pool = {}
+            self._tls.conns = pool
+        c = pool.get(sidx)
+        if c is None:
+            host, port = self._servers[sidx]
+            c = NativeClient(host, port, self._timeout)
+            pool[sidx] = c
+            with self._conn_lock:
+                self._all_conns.append(c)
+        return c
+
+    def server_for(self, key: int) -> int:
+        return key % len(self._servers)
+
+    # -- data plane ---------------------------------------------------------
+    def init_key(self, key: int, nbytes: int) -> None:
+        self._conn(self.server_for(key)).init_key(key, nbytes)
+
+    def push(self, key: int, data: np.ndarray) -> int:
+        """Push this worker's fp32 partition; returns the round number the
+        matching pull must wait for."""
+        data = np.ascontiguousarray(data, dtype=np.float32)
+        with self._vlock:
+            version = self._versions.get(key, 0) + 1
+            self._versions[key] = version
+        self._conn(self.server_for(key)).push(key, data)
+        return version
+
+    def pull(self, key: int, nelems: int, version: int) -> np.ndarray:
+        out = np.empty(nelems, np.float32)
+        self._conn(self.server_for(key)).pull(key, out, version)
+        return out
+
+    def push_pull(self, key: int, data: np.ndarray) -> np.ndarray:
+        v = self.push(key, data)
+        return self.pull(key, data.size, v)
+
+    def barrier(self) -> None:
+        """Global worker barrier through server 0 (reference: ps-lite
+        Postoffice::Barrier via the scheduler)."""
+        self._conn(0).barrier()
+
+    def shutdown(self) -> None:
+        """Tell every server this worker is done (server exits once all
+        workers said so), then drop connections."""
+        done = set()
+        with self._conn_lock:
+            conns = list(self._all_conns)
+            self._all_conns.clear()
+        # one shutdown per server (not per connection): servers count
+        # shutdowns against DMLC_NUM_WORKER
+        for sidx in range(len(self._servers)):
+            try:
+                self._conn(sidx)  # ensure a conn exists on this thread
+            except ConnectionError:
+                continue
+        pool = getattr(self._tls, "conns", {})
+        for sidx, c in pool.items():
+            if sidx not in done:
+                try:
+                    c.shutdown()
+                    done.add(sidx)
+                except Exception:  # noqa: BLE001
+                    pass
+        for c in conns:
+            c.close()
+        self._tls.conns = {}

@@ -1,0 +1,56 @@
+// C API surface loaded from Python via ctypes (no pybind11 in this image).
+// Reference analog: the extern "C" block of byteps/common/operations.h plus
+// byteps/server's StartPS entry.
+#include <cstdint>
+
+#include "client.h"
+#include "server.h"
+
+extern "C" {
+
+int bps_server_start(uint16_t port, int num_workers, int engine_threads,
+                     int async_mode) {
+  return bps::StartServer(port, num_workers, engine_threads,
+                          async_mode != 0);
+}
+
+void bps_server_wait() { bps::WaitServer(); }
+
+void bps_server_stop() { bps::StopServer(); }
+
+void* bps_client_connect(const char* host, uint16_t port, int timeout_ms) {
+  auto* c = new bps::Client();
+  if (c->Connect(host, port, timeout_ms) != 0) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+int bps_client_init_key(void* client, uint64_t key, uint64_t nbytes) {
+  return static_cast<bps::Client*>(client)->InitKey(key, nbytes);
+}
+
+int bps_client_push(void* client, uint64_t key, const void* data,
+                    uint64_t nbytes) {
+  return static_cast<bps::Client*>(client)->Push(key, data, nbytes);
+}
+
+int bps_client_pull(void* client, uint64_t key, void* data, uint64_t nbytes,
+                    uint64_t version) {
+  return static_cast<bps::Client*>(client)->Pull(key, data, nbytes, version);
+}
+
+int bps_client_barrier(void* client) {
+  return static_cast<bps::Client*>(client)->Barrier();
+}
+
+int bps_client_shutdown(void* client) {
+  return static_cast<bps::Client*>(client)->Shutdown();
+}
+
+void bps_client_free(void* client) {
+  delete static_cast<bps::Client*>(client);
+}
+
+}  // extern "C"

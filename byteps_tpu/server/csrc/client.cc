@@ -1,0 +1,108 @@
+#include "client.h"
+
+#include <chrono>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <netdb.h>
+
+#include "common.h"
+
+namespace bps {
+
+namespace {
+int ConnectOnce(const std::string& host, uint16_t port) {
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (::getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints,
+                    &res) != 0) {
+    return -1;
+  }
+  int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd >= 0 && ::connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+    ::close(fd);
+    fd = -1;
+  }
+  ::freeaddrinfo(res);
+  return fd;
+}
+}  // namespace
+
+Client::~Client() {
+  if (fd_ >= 0) ::close(fd_);
+}
+
+int Client::Connect(const std::string& host, uint16_t port, int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    int fd = ConnectOnce(host, port);
+    if (fd >= 0) {
+      set_nodelay(fd);
+      fd_ = fd;
+      return 0;
+    }
+    if (std::chrono::steady_clock::now() >= deadline) return -1;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+// Serial request → response. Returns 0 ok, negative on transport error,
+// positive on server kErr.
+static int Roundtrip(int fd, Cmd cmd, uint64_t key, uint64_t version,
+                     const void* out, uint32_t out_len, void* in,
+                     uint64_t in_len) {
+  if (!send_frame(fd, cmd, key, version, out, out_len)) return -2;
+  FrameHeader h;
+  if (!recv_all(fd, &h, sizeof(h))) return -3;
+  if (h.magic != kMagic) return -4;
+  if (h.cmd == kErr) {
+    std::vector<char> msg(h.len);
+    recv_all(fd, msg.data(), h.len);
+    return 1;
+  }
+  if (h.cmd == kResp) {
+    if (h.len != in_len || in == nullptr) return -5;
+    if (!recv_all(fd, in, h.len)) return -6;
+    return 0;
+  }
+  // kAck
+  if (h.len > 0) {
+    std::vector<char> skip(h.len);
+    if (!recv_all(fd, skip.data(), h.len)) return -6;
+  }
+  return 0;
+}
+
+int Client::InitKey(uint64_t key, uint64_t nbytes) {
+  std::lock_guard<std::mutex> lk(mu_);
+  // nbytes rides the version field (payload-free frame)
+  return Roundtrip(fd_, kInit, key, nbytes, nullptr, 0, nullptr, 0);
+}
+
+int Client::Push(uint64_t key, const void* data, uint64_t nbytes) {
+  std::lock_guard<std::mutex> lk(mu_);
+  return Roundtrip(fd_, kPush, key, 0, data,
+                   static_cast<uint32_t>(nbytes), nullptr, 0);
+}
+
+int Client::Pull(uint64_t key, void* data, uint64_t nbytes,
+                 uint64_t version) {
+  std::lock_guard<std::mutex> lk(mu_);
+  return Roundtrip(fd_, kPull, key, version, nullptr, 0, data, nbytes);
+}
+
+int Client::Barrier() {
+  std::lock_guard<std::mutex> lk(mu_);
+  return Roundtrip(fd_, kBarrier, 0, 0, nullptr, 0, nullptr, 0);
+}
+
+int Client::Shutdown() {
+  std::lock_guard<std::mutex> lk(mu_);
+  return Roundtrip(fd_, kShutdown, 0, 0, nullptr, 0, nullptr, 0);
+}
+
+}  // namespace bps

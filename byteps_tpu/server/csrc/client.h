@@ -1,0 +1,33 @@
+// DCN worker-side client — the reference's ps::KVWorker<char>::ZPush/ZPull
+// (3rdparty/ps-lite include/ps/kv_app.h) reduced to the summation service's
+// needs. One Client = one TCP connection with strictly serial
+// request/response (parallelism = several Client instances, one per
+// scheduler pool thread, mirroring ps-lite's per-thread customers).
+#pragma once
+
+#include <cstdint>
+#include <mutex>
+#include <string>
+
+namespace bps {
+
+class Client {
+ public:
+  ~Client();
+  // Retries until the server accepts or timeout_ms elapses (workers may
+  // start before servers; ps-lite's scheduler rendezvous absorbs this in
+  // the reference).
+  int Connect(const std::string& host, uint16_t port, int timeout_ms);
+  int InitKey(uint64_t key, uint64_t nbytes);
+  int Push(uint64_t key, const void* data, uint64_t nbytes);
+  // Blocks until the server completed round `version` for this key.
+  int Pull(uint64_t key, void* data, uint64_t nbytes, uint64_t version);
+  int Barrier();
+  int Shutdown();
+
+ private:
+  int fd_ = -1;
+  std::mutex mu_;
+};
+
+}  // namespace bps

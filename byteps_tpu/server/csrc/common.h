@@ -1,0 +1,100 @@
+// Wire protocol + socket helpers for the DCN parameter-server tier.
+//
+// Reference analog: 3rdparty/ps-lite message framing (ps::Message over the
+// ZMQ/RDMA van) reduced to what the summation service needs: a fixed little-
+// endian header + raw payload over TCP. One frame per request/response.
+//
+// Frame layout (32 bytes header):
+//   u32 magic 'BPS1'  | u8 cmd | u8 flags | u16 reserved
+//   u64 key           | u64 version       | u32 payload_len | u32 pad
+#pragma once
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace bps {
+
+constexpr uint32_t kMagic = 0x31535042;  // "BPS1"
+
+enum Cmd : uint8_t {
+  kInit = 1,      // allocate store[key] of payload_len bytes (payload empty)
+  kPush = 2,      // payload = fp32 data to sum into store[key]
+  kPull = 3,      // wait until store[key].version >= version, then kResp
+  kResp = 4,      // payload = fp32 result
+  kBarrier = 5,   // block until num_workers barriers arrive
+  kShutdown = 6,  // connection is done
+  kAck = 7,       // empty acknowledgement
+  kErr = 8,       // payload = error string
+};
+
+#pragma pack(push, 1)
+struct FrameHeader {
+  uint32_t magic = kMagic;
+  uint8_t cmd = 0;
+  uint8_t flags = 0;
+  uint16_t reserved = 0;
+  uint64_t key = 0;
+  uint64_t version = 0;
+  uint32_t len = 0;
+  uint32_t pad = 0;
+};
+#pragma pack(pop)
+
+static_assert(sizeof(FrameHeader) == 32, "frame header must be 32 bytes");
+
+// Full-buffer send/recv (TCP gives a byte stream; short reads are normal).
+inline bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+inline bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    if (r == 0) return false;  // peer closed
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+inline bool send_frame(int fd, Cmd cmd, uint64_t key, uint64_t version,
+                       const void* payload, uint32_t len) {
+  FrameHeader h;
+  h.cmd = cmd;
+  h.key = key;
+  h.version = version;
+  h.len = len;
+  if (!send_all(fd, &h, sizeof(h))) return false;
+  if (len > 0 && !send_all(fd, payload, len)) return false;
+  return true;
+}
+
+inline void set_nodelay(int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+}  // namespace bps

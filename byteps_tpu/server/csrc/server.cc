@@ -1,0 +1,326 @@
+#include "server.h"
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "reducer.h"
+#include "threadpool.h"
+
+namespace bps {
+namespace {
+
+struct PendingPull {
+  int fd;
+  uint64_t version;  // respond when store version >= this
+};
+
+// Double-buffered per-key state (reference: BytePSArray store + the
+// "all workers arrived → answer queued pulls" logic in BytePSHandler).
+// `accum` receives the in-progress round; on completion it is copied to
+// `result` and zeroed. A worker cannot start round v+2 before every worker
+// pulled round v+1 (its own pull gates it), so `result` is never
+// overwritten while still being served.
+struct KeyStore {
+  std::mutex mu;
+  std::vector<float> accum;
+  std::vector<float> result;
+  uint64_t version = 0;
+  uint32_t arrived = 0;
+  std::vector<PendingPull> pending;
+};
+
+class Server {
+ public:
+  int Start(uint16_t port, int num_workers, int engine_threads, bool async) {
+    num_workers_ = num_workers;
+    async_ = async;
+    engine_ = std::make_unique<ThreadPool>(engine_threads);
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return -1;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(port);
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0) {
+      ::close(listen_fd_);
+      return -2;
+    }
+    if (::listen(listen_fd_, 128) != 0) {
+      ::close(listen_fd_);
+      return -3;
+    }
+    running_ = true;
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return 0;
+  }
+
+  void Wait() {
+    std::unique_lock<std::mutex> lk(done_mu_);
+    done_cv_.wait(lk, [this] { return !running_.load(); });
+  }
+
+  void Stop() {
+    // serialize concurrent stops (worker-initiated auto-stop can race an
+    // explicit StopServer); the loser blocks until teardown completes so
+    // the caller may safely delete the server afterwards
+    std::lock_guard<std::mutex> stop_lk(stop_mu_);
+    bool was = running_.exchange(false);
+    if (!was) return;
+    if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+    if (listen_fd_ >= 0) ::close(listen_fd_);
+    {
+      std::lock_guard<std::mutex> lk(conn_mu_);
+      for (int fd : conns_) ::shutdown(fd, SHUT_RDWR);
+    }
+    if (accept_thread_.joinable() &&
+        accept_thread_.get_id() != std::this_thread::get_id()) {
+      accept_thread_.join();
+    }
+    for (auto& t : conn_threads_) {
+      if (t.joinable() && t.get_id() != std::this_thread::get_id()) t.join();
+    }
+    conn_threads_.clear();
+    if (engine_) engine_->Stop();
+    {
+      // close only after every conn thread exited — closing earlier would
+      // let the kernel reuse the fd number (e.g. for a Python socket in
+      // this process) while a stale shutdown() could still target it
+      std::lock_guard<std::mutex> lk(conn_mu_);
+      for (int fd : conns_) ::close(fd);
+      conns_.clear();
+      send_mu_.clear();
+    }
+    done_cv_.notify_all();
+  }
+
+ private:
+  void AcceptLoop() {
+    while (running_) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      set_nodelay(fd);
+      {
+        std::lock_guard<std::mutex> lk(conn_mu_);
+        conns_.push_back(fd);
+        send_mu_[fd] = std::make_unique<std::mutex>();
+        conn_threads_.emplace_back([this, fd] { ConnLoop(fd); });
+      }
+    }
+  }
+
+  void SendFrame(int fd, Cmd cmd, uint64_t key, uint64_t version,
+                 const void* payload, uint32_t len) {
+    std::mutex* mu = nullptr;
+    {
+      std::lock_guard<std::mutex> lk(conn_mu_);
+      auto it = send_mu_.find(fd);
+      if (it == send_mu_.end()) return;
+      mu = it->second.get();
+    }
+    std::lock_guard<std::mutex> lk(*mu);
+    send_frame(fd, cmd, key, version, payload, len);
+  }
+
+  KeyStore* GetOrCreate(uint64_t key, size_t nfloats) {
+    std::lock_guard<std::mutex> lk(store_mu_);
+    auto& slot = store_[key];
+    if (!slot) {
+      slot = std::make_unique<KeyStore>();
+      slot->accum.assign(nfloats, 0.f);
+      slot->result.assign(nfloats, 0.f);
+    }
+    return slot.get();
+  }
+
+  KeyStore* Get(uint64_t key) {
+    std::lock_guard<std::mutex> lk(store_mu_);
+    auto it = store_.find(key);
+    return it == store_.end() ? nullptr : it->second.get();
+  }
+
+  void HandlePush(int fd, uint64_t key, std::shared_ptr<std::vector<char>> buf) {
+    engine_->Submit([this, fd, key, buf] {
+      KeyStore* ks = Get(key);
+      if (ks == nullptr) {
+        SendFrame(fd, kErr, key, 0, "push before init", 16);
+        return;
+      }
+      const auto n = static_cast<int64_t>(buf->size() / sizeof(float));
+      const float* src = reinterpret_cast<const float*>(buf->data());
+      std::vector<std::pair<int, uint64_t>> ready;  // (fd, version) to answer
+      uint64_t v = 0;
+      {
+        std::lock_guard<std::mutex> lk(ks->mu);
+        if (async_) {
+          // async mode: accumulate into the served buffer immediately, no
+          // per-round barrier (reference BYTEPS_ENABLE_ASYNC)
+          reduce_sum_f32(ks->result.data(), src, n);
+          ks->version++;
+        } else {
+          reduce_sum_f32(ks->accum.data(), src, n);
+          if (++ks->arrived == static_cast<uint32_t>(num_workers_)) {
+            std::memcpy(ks->result.data(), ks->accum.data(),
+                        ks->accum.size() * sizeof(float));
+            std::memset(ks->accum.data(), 0,
+                        ks->accum.size() * sizeof(float));
+            ks->arrived = 0;
+            ks->version++;
+          }
+        }
+        v = ks->version;
+        auto it = ks->pending.begin();
+        while (it != ks->pending.end()) {
+          if (v >= it->version || async_) {
+            ready.emplace_back(it->fd, v);
+            it = ks->pending.erase(it);
+          } else {
+            ++it;
+          }
+        }
+        for (auto& [rfd, rv] : ready) {
+          SendFrame(rfd, kResp, key, rv, ks->result.data(),
+                    static_cast<uint32_t>(ks->result.size() * sizeof(float)));
+        }
+      }
+      SendFrame(fd, kAck, key, v, nullptr, 0);
+    });
+  }
+
+  void HandlePull(int fd, uint64_t key, uint64_t version) {
+    KeyStore* ks = Get(key);
+    if (ks == nullptr) {
+      SendFrame(fd, kErr, key, 0, "pull before init", 16);
+      return;
+    }
+    std::lock_guard<std::mutex> lk(ks->mu);
+    if (ks->version >= version || (async_ && ks->version > 0)) {
+      SendFrame(fd, kResp, key, ks->version, ks->result.data(),
+                static_cast<uint32_t>(ks->result.size() * sizeof(float)));
+    } else {
+      ks->pending.push_back({fd, version});
+    }
+  }
+
+  void HandleBarrier(int fd) {
+    std::vector<int> release;
+    {
+      std::lock_guard<std::mutex> lk(barrier_mu_);
+      barrier_fds_.push_back(fd);
+      if (static_cast<int>(barrier_fds_.size()) == num_workers_) {
+        release.swap(barrier_fds_);
+      }
+    }
+    for (int rfd : release) SendFrame(rfd, kAck, 0, 0, nullptr, 0);
+  }
+
+  void ConnLoop(int fd) {
+    FrameHeader h;
+    while (running_ && recv_all(fd, &h, sizeof(h))) {
+      if (h.magic != kMagic) break;
+      auto payload = std::make_shared<std::vector<char>>();
+      if (h.len > 0) {
+        payload->resize(h.len);
+        if (!recv_all(fd, payload->data(), h.len)) break;
+      }
+      switch (h.cmd) {
+        case kInit:
+          GetOrCreate(h.key, h.version / sizeof(float));
+          SendFrame(fd, kAck, h.key, 0, nullptr, 0);
+          break;
+        case kPush:
+          HandlePush(fd, h.key, std::move(payload));
+          break;
+        case kPull:
+          HandlePull(fd, h.key, h.version);
+          break;
+        case kBarrier:
+          HandleBarrier(fd);
+          break;
+        case kShutdown: {
+          SendFrame(fd, kAck, 0, 0, nullptr, 0);
+          int count = ++shutdown_count_;
+          if (count >= num_workers_) {
+            std::thread([this] { Stop(); }).detach();
+          }
+          return;
+        }
+        default:
+          SendFrame(fd, kErr, h.key, 0, "bad cmd", 7);
+          break;
+      }
+    }
+  }
+
+  int listen_fd_ = -1;
+  int num_workers_ = 1;
+  bool async_ = false;
+  std::atomic<bool> running_{false};
+  std::atomic<int> shutdown_count_{0};
+  std::unique_ptr<ThreadPool> engine_;
+  std::thread accept_thread_;
+  std::vector<std::thread> conn_threads_;
+  std::vector<int> conns_;
+  std::mutex conn_mu_;
+  std::unordered_map<int, std::unique_ptr<std::mutex>> send_mu_;
+  std::mutex store_mu_;
+  std::unordered_map<uint64_t, std::unique_ptr<KeyStore>> store_;
+  std::mutex barrier_mu_;
+  std::vector<int> barrier_fds_;
+  std::mutex stop_mu_;
+  std::mutex done_mu_;
+  std::condition_variable done_cv_;
+};
+
+Server* g_server = nullptr;
+std::mutex g_server_mu;
+
+}  // namespace
+
+int StartServer(uint16_t port, int num_workers, int engine_threads,
+                bool async) {
+  std::lock_guard<std::mutex> lk(g_server_mu);
+  if (g_server != nullptr) return -10;  // already running
+  auto* s = new Server();
+  int rc = s->Start(port, num_workers, engine_threads, async);
+  if (rc != 0) {
+    delete s;
+    return rc;
+  }
+  g_server = s;
+  return 0;
+}
+
+void WaitServer() {
+  Server* s;
+  {
+    std::lock_guard<std::mutex> lk(g_server_mu);
+    s = g_server;
+  }
+  if (s != nullptr) s->Wait();
+}
+
+void StopServer() {
+  Server* s;
+  {
+    std::lock_guard<std::mutex> lk(g_server_mu);
+    s = g_server;
+    g_server = nullptr;
+  }
+  if (s != nullptr) {
+    s->Stop();
+    delete s;
+  }
+}
+
+}  // namespace bps

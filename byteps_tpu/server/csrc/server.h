@@ -1,0 +1,21 @@
+// DCN summation service — the reference's byteps/server/server.{h,cc}
+// (BytePSServer + BytePSHandler over ps::KVServer<char>) rebuilt on a plain
+// TCP van: workers INIT/PUSH/PULL fp32 partitions by u64 key; the server
+// sums pushes in fp32 on an engine thread pool and answers pulls when all
+// DMLC_NUM_WORKER workers contributed the round (sync) or immediately
+// (BYTEPS_ENABLE_ASYNC).
+#pragma once
+
+#include <cstdint>
+
+namespace bps {
+
+// Returns 0 on success. num_workers: pushes per round per key; engine
+// threads: summation pool size; async: no per-round barrier.
+int StartServer(uint16_t port, int num_workers, int engine_threads,
+                bool async);
+// Blocks until the server stops (all workers sent kShutdown, or StopServer).
+void WaitServer();
+void StopServer();
+
+}  // namespace bps

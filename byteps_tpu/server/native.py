@@ -1,0 +1,153 @@
+"""ctypes binding for the native DCN summation service.
+
+Builds ``libbyteps_tpu_server.so`` on first use if missing (``make`` +
+``g++`` are part of the supported toolchain; no pybind11 in this image, so
+the boundary is a C API + ctypes, reference analog: the ctypes-free
+``byteps/server/__init__.py`` loading the prebuilt native lib).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from byteps_tpu.common.logging import get_logger
+
+log = get_logger("server.native")
+
+_CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
+_SO = os.path.join(_CSRC, "libbyteps_tpu_server.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build() -> None:
+    log.info("building native server library (one-time)…")
+    subprocess.run(
+        ["make", "-C", _CSRC, "-j4"], check=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+
+
+def load_lib() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO):
+            _build()
+        lib = ctypes.CDLL(_SO)
+        lib.bps_server_start.argtypes = [
+            ctypes.c_uint16, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.bps_server_start.restype = ctypes.c_int
+        lib.bps_server_wait.argtypes = []
+        lib.bps_server_stop.argtypes = []
+        lib.bps_client_connect.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint16, ctypes.c_int,
+        ]
+        lib.bps_client_connect.restype = ctypes.c_void_p
+        lib.bps_client_init_key.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+        ]
+        lib.bps_client_init_key.restype = ctypes.c_int
+        lib.bps_client_push.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
+            ctypes.c_uint64,
+        ]
+        lib.bps_client_push.restype = ctypes.c_int
+        lib.bps_client_pull.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
+            ctypes.c_uint64, ctypes.c_uint64,
+        ]
+        lib.bps_client_pull.restype = ctypes.c_int
+        lib.bps_client_barrier.argtypes = [ctypes.c_void_p]
+        lib.bps_client_barrier.restype = ctypes.c_int
+        lib.bps_client_shutdown.argtypes = [ctypes.c_void_p]
+        lib.bps_client_shutdown.restype = ctypes.c_int
+        lib.bps_client_free.argtypes = [ctypes.c_void_p]
+        lib.bps_reduce_sum_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64,
+        ]
+        _lib = lib
+        return lib
+
+
+def reduce_sum_f32(dst: np.ndarray, src: np.ndarray) -> None:
+    """dst += src via the native kernel (golden-testable)."""
+    lib = load_lib()
+    assert dst.dtype == np.float32 and src.dtype == np.float32
+    assert dst.flags.c_contiguous and src.flags.c_contiguous
+    lib.bps_reduce_sum_f32(
+        dst.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        dst.size,
+    )
+
+
+class NativeClient:
+    """One serial TCP connection to one summation server.
+
+    Reference analog: a ps-lite customer. Thread-safety: the native side
+    serializes per connection; use one NativeClient per scheduler pool
+    thread for parallelism.
+    """
+
+    def __init__(self, host: str, port: int, timeout_ms: int = 30000):
+        self._lib = load_lib()
+        self._h: Optional[int] = self._lib.bps_client_connect(
+            host.encode(), port, timeout_ms
+        )
+        if not self._h:
+            raise ConnectionError(f"cannot reach bps server {host}:{port}")
+
+    def init_key(self, key: int, nbytes: int) -> None:
+        self._check(self._lib.bps_client_init_key(self._h, key, nbytes),
+                    "init")
+
+    def push(self, key: int, data: np.ndarray) -> None:
+        assert data.dtype == np.float32 and data.flags.c_contiguous
+        self._check(
+            self._lib.bps_client_push(
+                self._h, key, data.ctypes.data, data.nbytes
+            ),
+            "push",
+        )
+
+    def pull(self, key: int, out: np.ndarray, version: int) -> None:
+        assert out.dtype == np.float32 and out.flags.c_contiguous
+        self._check(
+            self._lib.bps_client_pull(
+                self._h, key, out.ctypes.data, out.nbytes, version
+            ),
+            "pull",
+        )
+
+    def barrier(self) -> None:
+        self._check(self._lib.bps_client_barrier(self._h), "barrier")
+
+    def shutdown(self) -> None:
+        if self._h:
+            self._lib.bps_client_shutdown(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.bps_client_free(self._h)
+            self._h = None
+
+    def _check(self, rc: int, op: str) -> None:
+        if rc != 0:
+            raise RuntimeError(f"bps {op} failed (rc={rc})")
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass

@@ -1,0 +1,348 @@
+"""byteps_tpu.torch — the PyTorch framework adapter (CPU workers over the
+DCN summation service).
+
+Reference analog: ``byteps/torch/__init__.py`` + ``byteps/torch/ops.cc`` —
+the same public surface (``init``, ``rank``/``size``, ``push_pull``,
+``DistributedOptimizer`` with per-parameter gradient hooks,
+``broadcast_parameters``, ``broadcast_optimizer_state``), with the native
+NCCL/ps-lite pipeline replaced by this framework's credit-scheduled
+partition pipeline over the native TCP summation servers
+(byteps_tpu/server). The TPU compute path lives in ``byteps_tpu.jax``; this
+adapter exists for capability parity with the reference's torch users
+(BASELINE config 1: torch MNIST, 2 local CPU workers, unchanged script).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+import numpy as np
+import torch
+
+from byteps_tpu.common.config import get_config
+from byteps_tpu.common.logging import bps_check, get_logger
+from byteps_tpu.common.partition import TensorRegistry
+from byteps_tpu.common.scheduler import (
+    Handle,
+    PartitionTask,
+    PipelineScheduler,
+    Stage,
+)
+from byteps_tpu.common.tracing import get_tracer
+from byteps_tpu.server import PSWorker
+
+log = get_logger("torch")
+
+
+class Compression:
+    """Python-level compression shim for API parity (reference:
+    byteps/torch/compression.py). ``fp16`` rounds gradients through float16
+    before the fp32 wire push (wire stays fp32 on this tier; the real
+    compressed wire formats live in the ICI-tier Pallas/XLA path)."""
+
+    none = "none"
+    fp16 = "fp16"
+
+
+class _TorchState:
+    def __init__(self) -> None:
+        self.initialized = False
+        self.cfg = None
+        self.worker: Optional[PSWorker] = None
+        self.registry: Optional[TensorRegistry] = None
+        self.scheduler: Optional[PipelineScheduler] = None
+        self.inited_keys = set()
+        self.key_lock = threading.Lock()
+
+
+_state = _TorchState()
+
+
+def init() -> None:
+    """Connect to the summation servers and rendezvous (reference:
+    ``byteps_init`` — env-driven: DMLC_PS_ROOT_URI/PORT, DMLC_NUM_WORKER,
+    DMLC_NUM_SERVER, DMLC_WORKER_ID)."""
+    if _state.initialized:
+        return
+    cfg = get_config()
+    _state.cfg = cfg
+    _state.worker = PSWorker()
+    _state.registry = TensorRegistry()
+    _state.scheduler = PipelineScheduler(
+        stages=[
+            Stage("PUSH", _push_stage, credited=True, pool_size=4),
+            Stage("PULL", _pull_stage, pool_size=4),
+        ],
+        credit=cfg.scheduling_credit,
+        tracer=get_tracer(),
+    )
+    _state.worker.barrier()
+    _state.initialized = True
+    log.info("byteps_tpu.torch initialized: worker %d/%d",
+             cfg.worker_id, cfg.num_worker)
+
+
+def shutdown() -> None:
+    if not _state.initialized:
+        return
+    _state.scheduler.shutdown()
+    _state.worker.shutdown()
+    _state.initialized = False
+    _state.inited_keys.clear()
+
+
+def _require_init() -> None:
+    bps_check(_state.initialized, "call byteps_tpu.torch.init() first")
+
+
+def rank() -> int:
+    _require_init()
+    return _state.cfg.worker_id
+
+
+def size() -> int:
+    _require_init()
+    return _state.cfg.num_worker
+
+
+def local_rank() -> int:
+    _require_init()
+    return _state.cfg.local_rank
+
+
+def local_size() -> int:
+    _require_init()
+    return _state.cfg.local_size
+
+
+# --- pipeline stages --------------------------------------------------------
+def _push_stage(task: PartitionTask):
+    p = task.partition
+    flat: np.ndarray = task.context["flat"]
+    chunk = np.ascontiguousarray(flat[p.offset:p.offset + p.length])
+    with _state.key_lock:
+        needs_init = p.key not in _state.inited_keys
+        if needs_init:
+            _state.inited_keys.add(p.key)
+    if needs_init:
+        # no cross-worker barrier needed: server-side init is idempotent
+        # and never resets an existing store, so only THIS worker's init
+        # must precede its own push (serial on this connection)
+        _state.worker.init_key(p.key, p.length * 4)
+    return _state.worker.push(p.key, chunk)
+
+
+def _pull_stage(task: PartitionTask):
+    p = task.partition
+    version = task.payload
+    return _state.worker.pull(p.key, p.length, version)
+
+
+# --- push_pull --------------------------------------------------------------
+def push_pull_async(
+    tensor: torch.Tensor,
+    average: bool = True,
+    name: Optional[str] = None,
+    priority: Optional[int] = None,
+    compression: str = Compression.none,
+) -> Handle:
+    """In-place async sum (mean) of ``tensor`` across workers.
+
+    Reference: ``byteps_torch_push_pull_async`` (byteps/torch/ops.cc).
+    ``synchronize(handle)`` writes the result back into ``tensor``.
+    """
+    _require_init()
+    bps_check(name is not None,
+              "byteps_tpu.torch.push_pull requires a tensor name (keys must "
+              "agree across workers)")
+    t = tensor.detach()
+    flat = t.to(torch.float32).contiguous().view(-1).numpy()
+    if compression == Compression.fp16:
+        flat = flat.astype(np.float16).astype(np.float32)
+    ctx = _state.registry.declare(name, (flat.size,), np.float32)
+    handle = Handle(name, len(ctx.partitions))
+    handle.tensor = tensor          # type: ignore[attr-defined]
+    handle.average = average        # type: ignore[attr-defined]
+    shared = {"flat": flat}
+    tasks = []
+    for p in ctx.partitions:
+        if priority is not None:
+            p = type(p)(key=p.key, tensor_id=p.tensor_id,
+                        part_idx=p.part_idx, offset=p.offset,
+                        length=p.length, priority=priority)
+        tasks.append(PartitionTask(partition=p, name=name, handle=handle,
+                                   context=shared))
+    _state.scheduler.enqueue(tasks)
+    return handle
+
+
+def synchronize(handle: Handle, timeout: Optional[float] = 120.0) -> torch.Tensor:
+    """Wait and write the aggregated value back into the original tensor
+    (reference: ``synchronize``/``wait_and_clear``)."""
+    results = handle.wait(timeout)
+    parts = [results[i] for i in sorted(results)]
+    flat = parts[0] if len(parts) == 1 else np.concatenate(parts)
+    if handle.average:  # type: ignore[attr-defined]
+        flat = flat / size()
+    tensor: torch.Tensor = handle.tensor  # type: ignore[attr-defined]
+    out = torch.from_numpy(flat).view(tensor.shape).to(tensor.dtype)
+    with torch.no_grad():
+        tensor.copy_(out)
+    return tensor
+
+
+def push_pull(
+    tensor: torch.Tensor,
+    average: bool = True,
+    name: Optional[str] = None,
+    priority: Optional[int] = None,
+    compression: str = Compression.none,
+) -> torch.Tensor:
+    return synchronize(
+        push_pull_async(tensor, average, name, priority, compression)
+    )
+
+
+# --- broadcast --------------------------------------------------------------
+def broadcast_parameters(
+    params: Iterable[Tuple[str, torch.Tensor]] | Dict[str, torch.Tensor],
+    root_rank: int = 0,
+) -> None:
+    """Replicate root's values to all workers, in place. Implemented as
+    zero-on-non-root + summed push_pull — the reference's own trick
+    (byteps/torch/__init__.py broadcast_parameters)."""
+    _require_init()
+    items = params.items() if isinstance(params, dict) else params
+    handles = []
+    for pname, p in items:
+        if p is None:
+            continue
+        if rank() != root_rank:
+            with torch.no_grad():
+                p.zero_()
+        handles.append(push_pull_async(
+            p, average=False, name=f"byteps_broadcast.{pname}"
+        ))
+    for h in handles:
+        synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0) -> None:
+    """Broadcast optimizer state tensors + hyperparameters from root
+    (reference: broadcast_optimizer_state)."""
+    _require_init()
+    tensors = {}
+    for gi, group in enumerate(optimizer.param_groups):
+        for k, v in group.items():
+            if isinstance(v, (int, float)) and k != "params":
+                t = torch.tensor(float(v), dtype=torch.float64)
+                tensors[f"opt_group{gi}.{k}"] = (group, k, t)
+    for pid, st in optimizer.state.items():
+        for k, v in st.items():
+            if torch.is_tensor(v):
+                tensors[f"opt_state.{pid}.{k}"] = (st, k, v)
+            elif isinstance(v, (int, float)):
+                t = torch.tensor(float(v), dtype=torch.float64)
+                tensors[f"opt_state.{pid}.{k}"] = (st, k, t)
+    broadcast_parameters(
+        {n: t for n, (_, _, t) in tensors.items()}, root_rank
+    )
+    for n, (container, k, t) in tensors.items():
+        if torch.is_tensor(container.get(k)):
+            continue  # broadcast wrote in place
+        orig = container[k]
+        container[k] = type(orig)(t.item()) if isinstance(orig, (int, float)) else t.item()
+
+
+# --- DistributedOptimizer ---------------------------------------------------
+class _DistributedOptimizer(torch.optim.Optimizer):
+    """Wraps a torch optimizer: per-parameter post-accumulate-grad hooks fire
+    push_pull as soon as each grad is ready (comm/compute overlap), and
+    ``step()`` synchronizes before applying the inner optimizer.
+
+    Reference: byteps/torch DistributedOptimizer (grad-accumulator hooks →
+    _push_pull_param_async; synchronize() in step)."""
+
+    def __init__(self, optimizer: torch.optim.Optimizer,
+                 named_parameters: Iterable[Tuple[str, torch.Tensor]],
+                 compression: str = Compression.none,
+                 backward_passes_per_step: int = 1):
+        self._opt = optimizer
+        self._compression = compression
+        self._bpps = max(1, backward_passes_per_step)
+        self._pass_count = 0
+        self._handles: Dict[torch.Tensor, Handle] = {}
+        self._names: Dict[torch.Tensor, str] = {}
+        self._hooks = []
+        named = list(named_parameters)
+        bps_check(len({n for n, _ in named}) == len(named),
+                  "parameter names must be unique")
+        # declaration order = named_parameters order → priorities fixed
+        # identically on every worker before any backward runs
+        for pname, p in named:
+            if p.requires_grad:
+                name = f"byteps_push_pull.{pname}"
+                self._names[p] = name
+                _state.registry.declare(name, (p.numel(),), np.float32)
+        for pname, p in named:
+            if p.requires_grad:
+                self._hooks.append(p.register_post_accumulate_grad_hook(
+                    self._make_hook()
+                ))
+
+    # pass-throughs
+    @property
+    def param_groups(self):
+        return self._opt.param_groups
+
+    @param_groups.setter
+    def param_groups(self, v):
+        self._opt.param_groups = v
+
+    @property
+    def state(self):
+        return self._opt.state
+
+    def state_dict(self):
+        return self._opt.state_dict()
+
+    def load_state_dict(self, sd):
+        return self._opt.load_state_dict(sd)
+
+    def zero_grad(self, set_to_none: bool = True):
+        return self._opt.zero_grad(set_to_none=set_to_none)
+
+    def _make_hook(self):
+        def hook(p: torch.Tensor) -> None:
+            if (self._pass_count + 1) % self._bpps != 0:
+                return  # accumulate locally this pass
+            self._handles[p] = push_pull_async(
+                p.grad, average=True, name=self._names[p],
+                compression=self._compression,
+            )
+        return hook
+
+    def synchronize(self) -> None:
+        for p, h in self._handles.items():
+            synchronize(h)
+        self._handles.clear()
+
+    def step(self, closure=None):
+        self._pass_count += 1
+        if self._pass_count % self._bpps != 0:
+            return None  # mid-accumulation: no sync, no step
+        self.synchronize()
+        out = self._opt.step(closure)
+        return out
+
+
+def DistributedOptimizer(
+    optimizer: torch.optim.Optimizer,
+    named_parameters: Iterable[Tuple[str, torch.Tensor]],
+    compression: str = Compression.none,
+    backward_passes_per_step: int = 1,
+) -> _DistributedOptimizer:
+    return _DistributedOptimizer(optimizer, named_parameters, compression,
+                                 backward_passes_per_step)

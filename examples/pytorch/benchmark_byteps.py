@@ -1,0 +1,57 @@
+"""Synthetic push_pull benchmark for the torch/DCN path (reference:
+example/pytorch/benchmark_byteps.py measures img/s on synthetic data).
+
+Measures end-to-end DistributedOptimizer step throughput on a synthetic
+ResNet-50-sized gradient set, and raw push_pull GB/s.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import numpy as np
+import torch
+
+import byteps_tpu.torch as bps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-iters", type=int, default=20)
+    ap.add_argument("--tensor-mb", type=float, default=25.0,
+                    help="gradient bytes per step (ResNet-50 ≈ 100 MB fp32; "
+                         "default smaller for CPU runs)")
+    ap.add_argument("--num-tensors", type=int, default=8)
+    args = ap.parse_args()
+
+    bps.init()
+    elems = int(args.tensor_mb * 1e6 / 4 / args.num_tensors)
+    tensors = [torch.randn(elems) for _ in range(args.num_tensors)]
+
+    # warmup (declares + inits keys)
+    hs = [bps.push_pull_async(t, name=f"bench.{i}")
+          for i, t in enumerate(tensors)]
+    for h in hs:
+        bps.synchronize(h)
+
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        hs = [bps.push_pull_async(t, name=f"bench.{i}")
+              for i, t in enumerate(tensors)]
+        for h in hs:
+            bps.synchronize(h)
+    dt = (time.perf_counter() - t0) / args.num_iters
+    gb = args.tensor_mb / 1e3
+    if bps.rank() == 0:
+        print(f"push_pull: {gb / dt:.3f} GB/s/worker "
+              f"({args.tensor_mb:.0f} MB in {dt*1e3:.1f} ms, "
+              f"{bps.size()} workers)", flush=True)
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""MNIST training with byteps_tpu.torch — reference-parity script
+(reference: example/pytorch/train_mnist_byteps.py; BASELINE config 1 runs
+it with 2 local CPU workers, no compression).
+
+The dataset is synthetic MNIST-shaped data from a fixed teacher network (no
+dataset downloads in this environment); the script shape — init,
+DistributedOptimizer wrap, broadcast, shard-per-worker training loop — is
+the reference's.
+
+Run (per worker, plus a server process):
+    DMLC_ROLE=server DMLC_NUM_WORKER=2 ... python -m byteps_tpu.launcher
+    DMLC_ROLE=worker DMLC_NUM_WORKER=2 BYTEPS_LOCAL_SIZE=2 ... \
+        python -m byteps_tpu.launcher python examples/pytorch/train_mnist_byteps.py
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import byteps_tpu.torch as bps
+
+
+class Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(784, 128)
+        self.fc2 = nn.Linear(128, 64)
+        self.fc3 = nn.Linear(64, 10)
+
+    def forward(self, x):
+        x = x.view(-1, 784)
+        x = F.relu(self.fc1(x))
+        x = F.relu(self.fc2(x))
+        return F.log_softmax(self.fc3(x), dim=1)
+
+
+def synthetic_mnist(n, seed):
+    """MNIST-shaped data labeled by a fixed random teacher (learnable)."""
+    g = torch.Generator().manual_seed(1234)      # teacher shared by all
+    teacher = torch.randn(784, 10, generator=g)
+    gd = torch.Generator().manual_seed(seed)     # data per worker shard
+    x = torch.randn(n, 1, 28, 28, generator=gd)
+    y = (x.view(n, 784) @ teacher).argmax(1)
+    return torch.utils.data.TensorDataset(x, y)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--samples", type=int, default=4096)
+    args = ap.parse_args()
+
+    bps.init()
+    torch.manual_seed(0)
+    model = Net()
+    optimizer = torch.optim.SGD(model.parameters(), lr=args.lr,
+                                momentum=0.9)
+    optimizer = bps.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+    )
+    bps.broadcast_parameters(dict(model.named_parameters()), root_rank=0)
+
+    ds = synthetic_mnist(args.samples // bps.size(), seed=bps.rank())
+    loader = torch.utils.data.DataLoader(ds, batch_size=args.batch_size,
+                                         shuffle=True)
+    for epoch in range(args.epochs):
+        model.train()
+        total, correct, loss_sum = 0, 0, 0.0
+        for x, y in loader:
+            optimizer.zero_grad()
+            out = model(x)
+            loss = F.nll_loss(out, y)
+            loss.backward()
+            optimizer.step()
+            loss_sum += float(loss) * len(y)
+            correct += int((out.argmax(1) == y).sum())
+            total += len(y)
+        print(f"[worker {bps.rank()}] epoch {epoch}: "
+              f"loss={loss_sum/total:.4f} acc={correct/total:.3f}",
+              flush=True)
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

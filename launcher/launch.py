@@ -1,0 +1,11 @@
+#!/usr/bin/env python
+"""bpslaunch wrapper (reference path parity: launcher/launch.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from byteps_tpu.launcher import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,89 @@
+"""Worker body for the torch-adapter localhost integration test.
+
+Asserts (reference test strategy, SURVEY §4):
+  * push_pull == sum/mean of all workers' tensors
+  * broadcast_parameters equalizes across ranks
+  * DistributedOptimizer training is identical across workers and matches
+    the single-process gold run on the combined batch.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.environ["BPS_REPO"])
+
+import numpy as np
+import torch
+
+import byteps_tpu.torch as bps
+
+
+def make_model():
+    torch.manual_seed(0)
+    return torch.nn.Sequential(
+        torch.nn.Linear(8, 16), torch.nn.ReLU(), torch.nn.Linear(16, 4),
+    )
+
+
+def main():
+    bps.init()
+    r, n = bps.rank(), bps.size()
+
+    # 1. push_pull correctness
+    x = torch.full((5, 3), float(r + 1))
+    out = bps.push_pull(x.clone(), average=False, name="t0")
+    want = sum(float(i + 1) for i in range(n))
+    assert torch.allclose(out, torch.full((5, 3), want)), out
+    out = bps.push_pull(x.clone(), average=True, name="t1")
+    assert torch.allclose(out, torch.full((5, 3), want / n)), out
+
+    # 2. broadcast_parameters
+    model = make_model()
+    with torch.no_grad():
+        for p in model.parameters():
+            p.add_(float(r) * 10)  # desync non-root ranks
+    bps.broadcast_parameters(dict(model.named_parameters()), root_rank=0)
+    model0 = make_model()
+    for (pn, p), (qn, q) in zip(model.named_parameters(),
+                                model0.named_parameters()):
+        assert torch.allclose(p, q), f"{pn} not broadcast"
+
+    # 3. DistributedOptimizer == single-process gold on the combined batch
+    torch.manual_seed(42)
+    full_x = torch.randn(8 * n, 8)
+    full_y = torch.randn(8 * n, 4)
+    my_x = full_x[r * 8:(r + 1) * 8]
+    my_y = full_y[r * 8:(r + 1) * 8]
+
+    model = make_model()
+    opt = bps.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters(),
+    )
+    for _ in range(5):
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(my_x), my_y)
+        loss.backward()
+        opt.step()
+
+    gold = make_model()
+    gopt = torch.optim.SGD(gold.parameters(), lr=0.1)
+    for _ in range(5):
+        gopt.zero_grad()
+        # mean over the combined batch = mean of per-worker means (equal
+        # shard sizes), matching push_pull average=True
+        loss = torch.nn.functional.mse_loss(gold(full_x), full_y)
+        loss.backward()
+        gopt.step()
+    for (pn, p), (qn, q) in zip(model.named_parameters(),
+                                gold.named_parameters()):
+        np.testing.assert_allclose(
+            p.detach().numpy(), q.detach().numpy(), rtol=1e-4, atol=1e-5,
+        )
+
+    bps.shutdown()
+    print(f"WORKER_{r}_OK")
+
+
+if __name__ == "__main__":
+    main()

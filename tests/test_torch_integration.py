@@ -1,0 +1,75 @@
+"""Localhost multi-process integration: server + 2 torch CPU workers through
+the launcher (reference pattern: 1 scheduler + 1 server + N workers on
+127.0.0.1 — SURVEY §4; BASELINE config 1's topology)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HELPER = os.path.join(REPO, "tests", "helpers", "torch_worker.py")
+
+BASE_PORT = 19600
+
+
+def _env(role: str, port: int, worker_id: int = 0, num_workers: int = 2,
+         local_size: int = 1):
+    env = dict(os.environ)
+    env.update({
+        "BPS_REPO": REPO,
+        "PYTHONPATH": REPO,
+        "DMLC_ROLE": role,
+        "DMLC_NUM_WORKER": str(num_workers),
+        "DMLC_NUM_SERVER": "1",
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_WORKER_ID": str(worker_id),
+        "BYTEPS_LOCAL_SIZE": str(local_size),
+        # keep partitions small so multi-partition scheduling is exercised
+        "BYTEPS_PARTITION_BYTES": "256",
+        "JAX_PLATFORMS": "cpu",
+    })
+    return env
+
+
+@pytest.mark.parametrize("via_launcher", [False, True])
+def test_two_workers_one_server(via_launcher):
+    port = BASE_PORT + (1 if via_launcher else 0)
+    server = subprocess.Popen(
+        [sys.executable, "-m", "byteps_tpu.launcher"],
+        env=_env("server", port), cwd=REPO,
+    )
+    workers = []
+    try:
+        if via_launcher:
+            # one launcher invocation spawning both workers (localhost
+            # multi-worker simulation: BYTEPS_LOCAL_SIZE=2)
+            workers.append(subprocess.Popen(
+                [sys.executable, "-m", "byteps_tpu.launcher",
+                 sys.executable, HELPER],
+                env=_env("worker", port, local_size=2),
+                cwd=REPO, stdout=subprocess.PIPE, text=True,
+            ))
+        else:
+            for wid in range(2):
+                workers.append(subprocess.Popen(
+                    [sys.executable, HELPER],
+                    env=_env("worker", port, worker_id=wid),
+                    cwd=REPO, stdout=subprocess.PIPE, text=True,
+                ))
+        outs = []
+        for w in workers:
+            out, _ = w.communicate(timeout=120)
+            outs.append(out)
+            assert w.returncode == 0, out
+        combined = "".join(outs)
+        assert "WORKER_0_OK" in combined
+        assert "WORKER_1_OK" in combined
+        server.wait(timeout=30)  # all workers shut down → server exits
+        assert server.returncode == 0
+    finally:
+        for p in workers + [server]:
+            if p.poll() is None:
+                p.kill()
