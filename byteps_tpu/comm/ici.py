@@ -129,11 +129,11 @@ def compressed_allreduce_local(
         # positional-sum fast path: sum payloads, one decompress at end
         out_payload = jax.tree.map(lambda a: a.sum(axis=0), recv)
     else:
-        # server path: decompress each peer's segment, fp32 sum
-        dec = jax.vmap(
-            lambda p: compressor.decompress(p, seg, jnp.float32, my_key)
-        )(recv)
-        s = dec.sum(axis=0)
+        # server path: decompress each peer's segment, fp32 sum — fused
+        # (Pallas on TPU) via the compressor's decompress_sum hot op
+        my_keys = jnp.broadcast_to(my_key, (n,) + my_key.shape) \
+            if compressor.stochastic else None
+        s = compressor.decompress_sum(recv, seg, jnp.float32, my_keys)
         if two_way:
             # recompress the sum for the "PULL" direction
             out_payload = compressor.compress(s, my_key)

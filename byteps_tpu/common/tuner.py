@@ -1,0 +1,154 @@
+"""Online auto-tuner for (partition_bytes, scheduling_credit).
+
+Reference analog: the ByteScheduler subproject's Bayesian search
+(``bytescheduler/bytescheduler/common/search.py`` tuning credit/partition
+size online during training, SOSP'19 §5; SURVEY §2.6 notes the rebuild
+needs ONE scheduler but should reproduce the tuner).
+
+Strategy: coordinate-descent hill climbing over a small log-spaced grid —
+measure the median step time of the current config over ``interval`` steps,
+try a neighbor along one knob, keep it if faster by ``min_gain`` else
+revert and switch knobs. Simpler than the reference's Bayesian optimizer
+but converges on the same two-knob space in tens of steps and has no
+dependencies. (On the fused jit path a partition-bytes move triggers one
+retrace per new value; the grid is small so compiles are cached.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+from typing import Callable, List, Optional, Tuple
+
+from byteps_tpu.common.logging import get_logger
+
+log = get_logger("tuner")
+
+PARTITION_GRID = [512 << 10, 1 << 20, 2 << 20, 4 << 20, 8 << 20, 16 << 20]
+CREDIT_GRID = [2, 4, 8, 16, 32]
+
+
+@dataclasses.dataclass
+class _Candidate:
+    part_idx: int
+    credit_idx: int
+
+    @property
+    def partition_bytes(self) -> int:
+        return PARTITION_GRID[self.part_idx]
+
+    @property
+    def credit(self) -> int:
+        return CREDIT_GRID[self.credit_idx]
+
+
+class AutoTuner:
+    """Feed ``record_step(seconds)`` once per training step; the tuner calls
+    ``apply(partition_bytes, credit)`` whenever it moves.
+
+    ``apply`` is typically ``lambda pb, cr: (registry.repartition(pb),
+    scheduler.set_credit(cr))`` for the eager path, or a closure that sets
+    the partition_bytes used at the next jit trace for the fused path.
+    """
+
+    def __init__(
+        self,
+        apply: Callable[[int, int], None],
+        interval: int = 5,
+        warmup: int = 3,
+        min_gain: float = 0.02,
+        partition_bytes: int = 4 << 20,
+        credit: int = 4,
+    ) -> None:
+        pi = min(range(len(PARTITION_GRID)),
+                 key=lambda i: abs(PARTITION_GRID[i] - partition_bytes))
+        ci = min(range(len(CREDIT_GRID)),
+                 key=lambda i: abs(CREDIT_GRID[i] - credit))
+        if (PARTITION_GRID[pi], CREDIT_GRID[ci]) != (partition_bytes, credit):
+            log.info(
+                "tuner: snapping start config to grid: partition %d→%d "
+                "bytes, credit %d→%d", partition_bytes, PARTITION_GRID[pi],
+                credit, CREDIT_GRID[ci],
+            )
+        self._apply = apply
+        self._interval = max(2, interval)
+        self._warmup = warmup
+        self._min_gain = min_gain
+        self._current = _Candidate(pi, ci)
+        self._best = self._current
+        self._best_time: Optional[float] = None
+        self._samples: List[float] = []
+        self._steps = 0
+        self._knob = 0          # 0: partition, 1: credit
+        self._direction = +1
+        self._exhausted = 0     # directions tried without improvement
+        self.converged = False
+        self._apply(self._current.partition_bytes, self._current.credit)
+
+    # -- measurement --------------------------------------------------------
+    def record_step(self, seconds: float) -> None:
+        if self.converged:
+            return
+        self._steps += 1
+        if self._steps <= self._warmup:
+            return  # compile/cache effects pollute early samples
+        self._samples.append(seconds)
+        if len(self._samples) >= self._interval:
+            self._evaluate(statistics.median(self._samples))
+            self._samples.clear()
+            self._steps = 0
+
+    # -- hill climbing ------------------------------------------------------
+    def _evaluate(self, t: float) -> None:
+        if self._best_time is None or t < self._best_time * (1 - self._min_gain):
+            if self._best_time is not None:
+                log.info(
+                    "tuner: kept partition=%dKB credit=%d (%.1fms < %.1fms)",
+                    self._current.partition_bytes >> 10, self._current.credit,
+                    t * 1e3, self._best_time * 1e3,
+                )
+                self._exhausted = 0
+            self._best = self._current
+            self._best_time = t
+        else:
+            # revert and rotate direction/knob
+            self._current = self._best
+            self._exhausted += 1
+            if self._direction > 0:
+                self._direction = -1
+            else:
+                self._direction = +1
+                self._knob = 1 - self._knob
+        if self._exhausted >= 4:
+            self.converged = True
+            self._apply(self._best.partition_bytes, self._best.credit)
+            log.info("tuner converged: partition=%dKB credit=%d",
+                     self._best.partition_bytes >> 10, self._best.credit)
+            return
+        nxt = self._neighbor()
+        if nxt is None:
+            self._exhausted += 1
+            nxt = self._current
+        self._current = nxt
+        self._apply(self._current.partition_bytes, self._current.credit)
+
+    def _neighbor(self) -> Optional[_Candidate]:
+        c = self._current
+        if self._knob == 0:
+            i = c.part_idx + self._direction
+            if 0 <= i < len(PARTITION_GRID):
+                return _Candidate(i, c.credit_idx)
+        else:
+            i = c.credit_idx + self._direction
+            if 0 <= i < len(CREDIT_GRID):
+                return _Candidate(c.part_idx, i)
+        return None
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def current(self) -> Tuple[int, int]:
+        return (self._current.partition_bytes, self._current.credit)
+
+    @property
+    def best(self) -> Tuple[int, int]:
+        return (self._best.partition_bytes, self._best.credit)

@@ -56,6 +56,28 @@ class Compressor:
     ) -> jnp.ndarray:
         return payload["values"].astype(dtype)
 
+    def decompress_sum(
+        self,
+        payloads: Payload,
+        n: int,
+        dtype=jnp.float32,
+        rng_keys: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        """Σ_k decompress(payload_k): the aggregation tier's inner loop
+        (reference server: decompress-then-SumRecvBuff per worker push).
+        ``payloads`` is the stacked tree (leading axis K); ``rng_keys`` the
+        matching (K, ...) keys when the compressor is stochastic. Subclasses
+        override with fused kernels; this default just vmaps."""
+        import jax
+
+        if rng_keys is None:
+            dec = jax.vmap(lambda p: self.decompress(p, n, dtype))(payloads)
+        else:
+            dec = jax.vmap(
+                lambda p, k: self.decompress(p, n, dtype, k)
+            )(payloads, rng_keys)
+        return dec.sum(axis=0)
+
     def compressed_bytes(self, n: int, itemsize: int = 4) -> int:
         return n * itemsize
 

@@ -1,12 +1,18 @@
 """1-bit sign compression (reference: ``byteps/common/compressor/impl/onebit.{h,cc}``).
 
-Wire format: 32 sign bits packed per uint32 word + one optional fp32 scale.
-``scaling=True`` sets scale = mean(|x|) so decompress returns ±mean|x|
-(reference kwarg ``scaling`` / env ``BYTEPS_COMPRESSOR_ONEBIT_SCALING``);
-otherwise ±1. Compression ratio ≈ 32× vs fp32.
+Wire format: sign bits in the TPU-native ``(32, L)`` transposed layout of
+``byteps_tpu.ops.onebit_kernels`` (bit k of word j = padded element
+``k*L + j``) + one fp32 scale. ``scaling=True`` sets scale = mean(|x|) so
+decompress returns ±mean|x| (reference kwarg ``scaling`` / env
+``BYTEPS_COMPRESSOR_ONEBIT_SCALING``); otherwise ±1. Compression ratio
+≈ 32× vs fp32 for large tensors; the lane padding floors the wire size at
+512 bytes + scale per segment, so tiny segments EXPAND — the adapters'
+``BYTEPS_MIN_COMPRESS_BYTES`` gate (and honest ``compressed_bytes``
+accounting) keeps such tensors uncompressed.
 
-Bit convention: bit=1 ⇔ x >= 0 (non-negative). Padding lanes (beyond n) are
-packed as sign of 0 (= 1) and sliced away on decompress.
+The pack / unpack-and-sum hot ops run as Pallas kernels on TPU (jnp
+fallback elsewhere, identical wire layout); the fused
+:meth:`decompress_sum` is the aggregation-tier inner loop.
 """
 
 from __future__ import annotations
@@ -16,20 +22,12 @@ from typing import Optional
 import jax.numpy as jnp
 
 from byteps_tpu.compression.base import Compressor, Payload, register_compressor
-
-
-def _pack_bits(bits: jnp.ndarray) -> jnp.ndarray:
-    """bits: (m*32,) of {0,1} int32 -> (m,) uint32."""
-    w = bits.reshape(-1, 32).astype(jnp.uint32)
-    shifts = jnp.arange(32, dtype=jnp.uint32)
-    return (w << shifts).sum(axis=1, dtype=jnp.uint32)
-
-
-def _unpack_bits(words: jnp.ndarray) -> jnp.ndarray:
-    """(m,) uint32 -> (m*32,) of {0,1} int32."""
-    shifts = jnp.arange(32, dtype=jnp.uint32)
-    bits = (words[:, None] >> shifts) & jnp.uint32(1)
-    return bits.reshape(-1).astype(jnp.int32)
+from byteps_tpu.ops.onebit_kernels import (
+    onebit_pack,
+    onebit_unpack,
+    onebit_unpack_sum,
+    packed_words,
+)
 
 
 @register_compressor("onebit")
@@ -41,12 +39,8 @@ class OnebitCompressor(Compressor):
         self.scaling = bool(scaling)
 
     def compress(self, x: jnp.ndarray, rng: Optional[jnp.ndarray] = None) -> Payload:
-        n = x.shape[0]
-        pad = (-n) % 32
         xf = x.astype(jnp.float32)
-        xp = jnp.pad(xf, (0, pad))
-        bits = (xp >= 0).astype(jnp.int32)
-        words = _pack_bits(bits)
+        words = onebit_pack(xf)
         if self.scaling:
             scale = jnp.mean(jnp.abs(xf)).reshape(1)
         else:
@@ -60,9 +54,19 @@ class OnebitCompressor(Compressor):
         dtype=jnp.float32,
         rng: Optional[jnp.ndarray] = None,
     ) -> jnp.ndarray:
-        bits = _unpack_bits(payload["signs"])[:n]
-        signs = bits.astype(jnp.float32) * 2.0 - 1.0
-        return (signs * payload["scale"][0]).astype(dtype)
+        return onebit_unpack(payload["signs"], payload["scale"], n).astype(dtype)
+
+    def decompress_sum(
+        self,
+        payloads: Payload,
+        n: int,
+        dtype=jnp.float32,
+        rng_keys: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        # fused kernel: one VMEM pass over the K payloads
+        return onebit_unpack_sum(
+            payloads["signs"], payloads["scale"][:, 0], n
+        ).astype(dtype)
 
     def compressed_bytes(self, n: int, itemsize: int = 4) -> int:
-        return 4 * ((n + 31) // 32) + 4
+        return 4 * packed_words(n) + 4

@@ -82,6 +82,7 @@ class _BytePSJaxState:
         self.base_rng = None
         self.anon_counter = 0
         self.lock = threading.Lock()
+        self.tuner = None
 
 
 _state = _BytePSJaxState()
@@ -117,6 +118,24 @@ def init(
         credit=cfg.scheduling_credit,
         tracer=tracer,
     )
+    if cfg.auto_tune:
+        # ByteScheduler auto-tuner (BYTEPS_AUTO_TUNE=1): online hill-climb
+        # of (partition_bytes, credit) on the eager path. Single-controller
+        # only — all devices see one scheduler, so moves are consistent; on
+        # the multi-controller DCN path tuning must stay off until decisions
+        # are synchronized across workers.
+        from byteps_tpu.common.tuner import AutoTuner
+
+        _state.tuner = AutoTuner(
+            apply=lambda pb, cr: (
+                _state.registry.repartition(pb),
+                _state.scheduler.set_credit(cr),
+            ),
+            partition_bytes=cfg.partition_bytes,
+            credit=cfg.scheduling_credit,
+        )
+    else:
+        _state.tuner = None
     _state.initialized = True
     log.info(
         "byteps_tpu.jax initialized: mesh=%s devices=%d compression=%s",
@@ -370,6 +389,13 @@ def broadcast_parameters(params, root_rank: int = 0):
 def broadcast_optimizer_state(opt_state, root_rank: int = 0):
     """Parity alias: optimizer states are pytrees too."""
     return broadcast_parameters(opt_state, root_rank)
+
+
+def tuner():
+    """The active AutoTuner (or None): call ``tuner().record_step(secs)``
+    once per training step to drive online (partition, credit) tuning."""
+    _require_init()
+    return _state.tuner
 
 
 def declare_tensor(name: str, shape, dtype) -> None:

@@ -1,0 +1,20 @@
+"""byteps_tpu.ops — device kernels (Pallas TPU + jnp fallbacks).
+
+The reference implements compressors as hand-written CPU C++
+(``byteps/common/compressor/impl/*``); the TPU-native equivalents are
+Pallas kernels for the hot wire ops, with jnp fallbacks that share the
+exact wire layout so either backend can decode the other's payloads.
+Backend selection: Pallas on TPU, jnp elsewhere; override with
+``BYTEPS_KERNEL_BACKEND=pallas|jnp``.
+"""
+
+from byteps_tpu.ops.onebit_kernels import (
+    onebit_pack,
+    onebit_unpack,
+    onebit_unpack_sum,
+    packed_words,
+)
+
+__all__ = [
+    "onebit_pack", "onebit_unpack", "onebit_unpack_sum", "packed_words",
+]

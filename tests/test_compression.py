@@ -37,10 +37,10 @@ def test_onebit_golden(x):
     # golden: sign(x) * mean|x|
     golden = np.where(xn >= 0, 1.0, -1.0) * np.abs(xn).mean()
     np.testing.assert_allclose(xh, golden, rtol=1e-6)
-    # packing is 32x: 1000 -> 32 words (of 4 bytes) + scale
-    assert payload["signs"].shape == (32,)
+    # packing is 32x, lane-padded: 1000 -> ceil(1000/32)=32 -> 128 words
+    assert payload["signs"].shape == (128,)
     assert payload["signs"].dtype == jnp.uint32
-    assert c.compressed_bytes(1000) == 32 * 4 + 4
+    assert c.compressed_bytes(1000) == 128 * 4 + 4
 
 
 def test_onebit_no_scaling(x):
@@ -50,10 +50,13 @@ def test_onebit_no_scaling(x):
 
 
 def test_onebit_pack_unpack_roundtrip():
-    from byteps_tpu.compression.onebit import _pack_bits, _unpack_bits
+    from byteps_tpu.ops import onebit_pack, onebit_unpack
 
-    bits = jnp.asarray(np.random.RandomState(0).randint(0, 2, 128), jnp.int32)
-    np.testing.assert_array_equal(np.asarray(_unpack_bits(_pack_bits(bits))), np.asarray(bits))
+    x = jnp.asarray(np.random.RandomState(0).randn(4097).astype(np.float32))
+    signs = onebit_unpack(onebit_pack(x), jnp.ones(1), x.shape[0])
+    np.testing.assert_array_equal(
+        np.asarray(signs), np.where(np.asarray(x) >= 0, 1.0, -1.0)
+    )
 
 
 def test_onebit_jit_and_vmap(x):

@@ -174,7 +174,8 @@ def test_dithering_statistical(mesh8):
 
 def test_compressed_wire_ratio_accounting():
     """compressed_bytes drives scheduling decisions; sanity-check ratios."""
-    assert OnebitCompressor().compressed_bytes(1024) == 1024 // 32 * 4 + 4
+    # lane-padded to 128 words (TPU wire layout, ops/onebit_kernels.py)
+    assert OnebitCompressor().compressed_bytes(1024) == 128 * 4 + 4
     assert TopkCompressor(k=0.01).compressed_bytes(10000) == 100 * 8
     assert RandomkCompressor(k=0.01).compressed_bytes(10000) == 100 * 4
     assert DitheringCompressor().compressed_bytes(1024) == 1024 + 4

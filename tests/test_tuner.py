@@ -1,0 +1,57 @@
+"""AutoTuner: hill-climbing converges to the best (partition, credit) on a
+synthetic cost surface (reference: bytescheduler auto-tuner, SURVEY §2.6)."""
+
+from byteps_tpu.common.tuner import AutoTuner, CREDIT_GRID, PARTITION_GRID
+
+
+def _cost(pb: int, credit: int) -> float:
+    # synthetic bowl: optimum at 2MB / credit 8
+    import math
+
+    return (
+        1.0
+        + 0.3 * abs(math.log2(pb) - math.log2(2 << 20))
+        + 0.2 * abs(math.log2(credit) - 3)
+    )
+
+
+def test_tuner_converges_to_optimum():
+    applied = {}
+
+    def apply(pb, cr):
+        applied["cfg"] = (pb, cr)
+
+    tuner = AutoTuner(apply, interval=3, warmup=1, min_gain=0.01)
+    for _ in range(400):
+        if tuner.converged:
+            break
+        pb, cr = applied["cfg"]
+        for _ in range(4):  # warmup+interval steps at this config
+            tuner.record_step(_cost(pb, cr))
+    assert tuner.converged
+    pb, cr = tuner.best
+    assert pb == 2 << 20, (pb, cr)
+    assert cr == 8, (pb, cr)
+
+
+def test_tuner_applies_initial_config():
+    seen = []
+    AutoTuner(lambda pb, cr: seen.append((pb, cr)),
+              partition_bytes=4 << 20, credit=4)
+    assert seen[0] == (4 << 20, 4)
+
+
+def test_tuner_stays_on_grid():
+    cfgs = []
+    tuner = AutoTuner(lambda pb, cr: cfgs.append((pb, cr)), interval=2,
+                      warmup=0, min_gain=0.01)
+    import random
+
+    rnd = random.Random(0)
+    for _ in range(200):
+        if tuner.converged:
+            break
+        tuner.record_step(rnd.uniform(0.9, 1.1))
+    for pb, cr in cfgs:
+        assert pb in PARTITION_GRID
+        assert cr in CREDIT_GRID
