@@ -81,8 +81,11 @@ class _BytePSJaxState:
         self.mom_state: Dict[Any, jnp.ndarray] = {}
         self.base_rng = None
         self.anon_counter = 0
+        self.bcast_counter = 0
         self.lock = threading.Lock()
         self.tuner = None
+        self.psworker = None        # DCN tier client (distributed mode)
+        self.inited_keys = set()
 
 
 _state = _BytePSJaxState()
@@ -107,30 +110,65 @@ def init(
     _state.spec = from_params(compression_params)
     _state.base_rng = jax.random.PRNGKey(seed)
     tracer = get_tracer()
-    # Eager pipeline: PUSHPULL issues the jitted chunk collective (async
-    # dispatch; issue order = execution order on the device stream), SYNC
-    # blocks until the chunk's result is ready and frees the credit.
-    _state.scheduler = PipelineScheduler(
-        stages=[
-            Stage("PUSHPULL", _dispatch_stage, credited=True, pool_size=1),
-            Stage("SYNC", _sync_stage, pool_size=4),
-        ],
-        credit=cfg.scheduling_credit,
-        tracer=tracer,
-    )
-    if cfg.auto_tune:
+    if cfg.is_distributed:
+        # Hybrid two-tier pipeline (reference root-GPU queue list,
+        # operations.cc GetPushQueueList: REDUCE → COPYD2H → PUSH → PULL →
+        # COPYH2D; BROADCAST is implicit — the H2D value is the replicated
+        # result). Intra-pod reduction rides ICI; only this controller
+        # pushes the pod-sum per partition over DCN to the summation
+        # servers, which is what makes the hybrid topology
+        # bandwidth-optimal (SURVEY §5.8).
+        from byteps_tpu.server import PSWorker
+
+        _state.psworker = PSWorker()
+        _state.scheduler = PipelineScheduler(
+            stages=[
+                Stage("REDUCE", _reduce_stage, pool_size=1),
+                Stage("COPYD2H", _d2h_stage, pool_size=2),
+                Stage("PUSH", _dcn_push_stage, credited=True, pool_size=4),
+                Stage("PULL", _dcn_pull_stage, pool_size=4),
+                Stage("COPYH2D", _h2d_stage, pool_size=2),
+            ],
+            credit=cfg.scheduling_credit,
+            tracer=tracer,
+        )
+    else:
+        # Eager ICI pipeline: PUSHPULL issues the jitted chunk collective
+        # (async dispatch; issue order = execution order on the device
+        # stream), SYNC blocks until the chunk's result is ready and frees
+        # the credit.
+        _state.scheduler = PipelineScheduler(
+            stages=[
+                Stage("PUSHPULL", _dispatch_stage, credited=True, pool_size=1),
+                Stage("SYNC", _sync_stage, pool_size=4),
+            ],
+            credit=cfg.scheduling_credit,
+            tracer=tracer,
+        )
+    if cfg.auto_tune and cfg.is_distributed:
+        log.warning(
+            "BYTEPS_AUTO_TUNE ignored in distributed mode: per-worker "
+            "tuners would repartition at different times, pushing "
+            "mismatched partition sizes under the same keys"
+        )
+    if cfg.auto_tune and not cfg.is_distributed:
         # ByteScheduler auto-tuner (BYTEPS_AUTO_TUNE=1): online hill-climb
         # of (partition_bytes, credit) on the eager path. Single-controller
-        # only — all devices see one scheduler, so moves are consistent; on
-        # the multi-controller DCN path tuning must stay off until decisions
-        # are synchronized across workers.
+        # only — all devices see one scheduler, so moves are consistent.
         from byteps_tpu.common.tuner import AutoTuner
 
+        def _apply_tuning(pb: int, cr: int) -> None:
+            _state.registry.repartition(pb)
+            _state.scheduler.set_credit(cr)
+            # EF/momentum buffers are shaped per partition; a repartition
+            # invalidates them (the residual restarts from zero — same
+            # effect as the reference re-instantiating compressors on
+            # partition change)
+            _state.ef_state.clear()
+            _state.mom_state.clear()
+
         _state.tuner = AutoTuner(
-            apply=lambda pb, cr: (
-                _state.registry.repartition(pb),
-                _state.scheduler.set_credit(cr),
-            ),
+            apply=_apply_tuning,
             partition_bytes=cfg.partition_bytes,
             credit=cfg.scheduling_credit,
         )
@@ -147,10 +185,15 @@ def shutdown() -> None:
     """Reference: ``byteps_shutdown``."""
     if _state.scheduler is not None:
         _state.scheduler.shutdown()
+    if _state.psworker is not None:
+        _state.psworker.shutdown()
+        _state.psworker = None
     _state.initialized = False
     _state.versions.clear()
     _state.ef_state.clear()
     _state.mom_state.clear()
+    _state.inited_keys.clear()
+    _state.bcast_counter = 0
 
 
 def _require_init() -> None:
@@ -164,11 +207,18 @@ def rank() -> int:
     return _state.cfg.worker_id
 
 
-def size() -> int:
-    """Number of data-parallel participants = dp-axis size (each TPU device
-    is the analog of one reference GPU worker)."""
+def pod_size() -> int:
+    """Devices on this controller's dp axis (one pod / reference machine)."""
     _require_init()
     return _state.mesh.shape[_state.cfg.dp_axis]
+
+
+def size() -> int:
+    """Global data-parallel participant count (each TPU device is the
+    analog of one reference GPU worker): pod devices × DMLC_NUM_WORKER
+    pods. Matches the reference's size() = machines × local GPUs."""
+    _require_init()
+    return pod_size() * max(1, _state.cfg.num_worker)
 
 
 def local_rank() -> int:
@@ -244,6 +294,46 @@ def _sync_stage(task: PartitionTask):
     return out
 
 
+# --- hybrid (distributed) pipeline stages -----------------------------------
+def _reduce_stage(task: PartitionTask):
+    """Intra-pod ICI sum of this chunk (async dispatch; reference REDUCE)."""
+    x = task.context["x2d"]
+    p = task.partition
+    chunk = jax.lax.slice_in_dim(x, p.offset, p.offset + p.length, axis=1)
+    return allreduce_flat(chunk, _state.mesh, _state.cfg.dp_axis,
+                          average=False)
+
+
+def _d2h_stage(task: PartitionTask):
+    """Device→host for the DCN wire (reference COPYD2H; pool threads give
+    the double-buffering the reference gets from pinned shm)."""
+    return np.asarray(task.payload, dtype=np.float32)
+
+
+def _dcn_push_stage(task: PartitionTask):
+    p = task.partition
+    with _state.lock:
+        needs_init = p.key not in _state.inited_keys
+        if needs_init:
+            _state.inited_keys.add(p.key)
+    if needs_init:
+        _state.psworker.init_key(p.key, p.length * 4)
+    version = _state.psworker.push(p.key, task.payload)
+    return version
+
+
+def _dcn_pull_stage(task: PartitionTask):
+    p = task.partition
+    return _state.psworker.pull(p.key, p.length, task.payload)
+
+
+def _h2d_stage(task: PartitionTask):
+    out = jnp.asarray(task.payload)
+    if task.context["average"]:
+        out = out / size()  # global worker-device count
+    return out
+
+
 def push_pull_async(
     x: jnp.ndarray,
     average: bool = True,
@@ -253,16 +343,18 @@ def push_pull_async(
 ) -> Handle:
     """Asynchronously all-reduce a stacked per-device tensor.
 
-    ``x`` has shape ``(size(), ...)``, row d = device d's local value (the
-    analog of each reference worker's GPU buffer), ideally sharded over the
-    dp axis. Returns a Handle; ``handle.wait()`` / :func:`synchronize`.
+    ``x`` has shape ``(pod_size(), ...)``, row d = local device d's value
+    (the analog of one reference worker's GPU buffer), ideally sharded over
+    the dp axis. In hybrid mode the result additionally sums across the
+    ``DMLC_NUM_WORKER`` pods (``average=True`` divides by the global
+    ``size()``). Returns a Handle; ``handle.wait()`` / :func:`synchronize`.
 
     Reference: ``byteps_push_pull`` / ``byteps_torch_push_pull_async``.
     """
     _require_init()
-    n = size()
+    n = pod_size()
     bps_check(x.ndim >= 1 and x.shape[0] == n,
-              f"expected leading axis {n} (= size()), got {x.shape}")
+              f"expected leading axis {n} (= pod_size()), got {x.shape}")
     anonymous = name is None
     with _state.lock:
         if anonymous:
@@ -296,6 +388,15 @@ def push_pull_async(
             )
             push_pull_async._warned_anon_state = True  # type: ignore[attr-defined]
         spec = _dc.replace(spec, ef=False, momentum=False)
+    if spec.enabled and _state.cfg.is_distributed:
+        # the DCN wire is fp32-only for now (the C++ summation service has
+        # no decompress engine yet); ICI-tier compression still applies in
+        # single-pod mode
+        if not getattr(push_pull_async, "_warned_dcn_comp", False):
+            log.warning("compression is not yet supported on the hybrid "
+                        "DCN path — sending fp32")
+            push_pull_async._warned_dcn_comp = True  # type: ignore[attr-defined]
+        spec = from_params(None)
     # Skip compression for tiny tensors (reference: BYTEPS_MIN_COMPRESS_BYTES)
     if spec.enabled and L * np.dtype(x.dtype).itemsize < _state.cfg.min_compress_bytes:
         spec = from_params(None)
@@ -365,14 +466,40 @@ def push_pull_tree(
 
 # --- broadcast (reference: broadcast_parameters / broadcast_optimizer_state) -
 def broadcast_parameters(params, root_rank: int = 0):
-    """Replicate row ``root_rank`` of stacked (N, ...) leaves to all rows'
-    consumers — returns the replicated pytree (functional, unlike the
-    reference's in-place op). Implemented as zero-on-non-root + psum, the
-    reference's own trick."""
+    """Replicate global rank ``root_rank``'s row of stacked (n_pod, ...)
+    leaves to everyone — returns the replicated pytree (functional, unlike
+    the reference's in-place op). Implemented as zero-on-non-root + summed
+    aggregation, the reference's own trick; in hybrid mode the sum crosses
+    pods through the summation servers (rank = pod_id·pod_size + row)."""
     _require_init()
+    n = pod_size()
+    root_pod, root_row = divmod(root_rank, n)
+
+    if _state.cfg.is_distributed:
+        leaves, treedef = jax.tree.flatten(params)
+        # per-call unique name prefix: successive broadcasts (params, then
+        # optimizer state) have different leaf shapes, and registry names
+        # are declare-once. Workers issue broadcasts in the same order, so
+        # the counter stays aligned across pods.
+        with _state.lock:
+            call_id = _state.bcast_counter
+            _state.bcast_counter += 1
+        handles = []
+        for i, leaf in enumerate(leaves):
+            bps_check(leaf.shape[0] == n, f"leading axis must be {n}")
+            if _state.cfg.worker_id == root_pod:
+                mask = (jnp.arange(n) == root_row).reshape(
+                    (n,) + (1,) * (leaf.ndim - 1))
+                z = jnp.where(mask, leaf, jnp.zeros_like(leaf))
+            else:
+                z = jnp.zeros_like(leaf)
+            # fp32 wire: int leaves survive exactly below 2^24
+            handles.append(push_pull_async(
+                z, average=False, name=f"byteps_broadcast.c{call_id}.{i}"))
+        outs = [synchronize(h) for h in handles]
+        return jax.tree.unflatten(treedef, outs)
 
     def bcast(leaf):
-        n = size()
         bps_check(leaf.shape[0] == n, f"leading axis must be {n}")
         L = int(np.prod(leaf.shape[1:])) if leaf.ndim > 1 else 1
         # native dtype throughout: zero-plus-psum is exact for ints too,

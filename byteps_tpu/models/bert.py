@@ -1,0 +1,135 @@
+"""BERT-style bidirectional encoder with masked-LM loss.
+
+BASELINE config 3's workload ("BERT-base pretrain with onebit gradient
+compression"). Shares transformer blocks with the GPT family
+(models/gpt.py ``transformer_block`` with ``causal=False`` — the ring
+attention path supports bidirectional masks) plus token-type embeddings and
+an MLM head. Same parallelism surface: tp col/row-parallel projections,
+sp ring attention, dp BytePS aggregation via the train-step factory.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from byteps_tpu.models.gpt import (
+    _layernorm,
+    block_init,
+    block_specs,
+    transformer_block,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30528
+    max_seq: int = 512
+    d_model: int = 768
+    n_heads: int = 12
+    n_layers: int = 12
+    d_ff: int = 3072
+    type_vocab: int = 2
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def tiny(cls) -> "BertConfig":
+        return cls(vocab_size=256, max_seq=64, d_model=64, n_heads=4,
+                   n_layers=2, d_ff=128)
+
+    @classmethod
+    def base(cls) -> "BertConfig":
+        return cls(dtype=jnp.bfloat16)
+
+
+def bert_init(rng: jnp.ndarray, cfg: BertConfig) -> Dict[str, Any]:
+    d = cfg.d_model
+    std = 0.02
+    keys = jax.random.split(rng, 4 + cfg.n_layers)
+
+    def dense(key, shape):
+        return jax.random.normal(key, shape, jnp.float32) * std
+
+    return {
+        "wte": dense(keys[0], (cfg.vocab_size, d)),
+        "wpe": dense(keys[1], (cfg.max_seq, d)),
+        "wtype": dense(keys[2], (cfg.type_vocab, d)),
+        "emb_ln_g": jnp.ones((d,), jnp.float32),
+        "emb_ln_b": jnp.zeros((d,), jnp.float32),
+        "blocks": [
+            block_init(keys[4 + li], d, cfg.d_ff,
+                       cfg.n_heads * cfg.head_dim, cfg.n_layers)
+            for li in range(cfg.n_layers)
+        ],
+        # MLM head: dense + LN, readout tied to wte (reference BERT shape)
+        "mlm_w": dense(keys[3], (d, d)),
+        "mlm_b": jnp.zeros((d,), jnp.float32),
+        "mlm_ln_g": jnp.ones((d,), jnp.float32),
+        "mlm_ln_b": jnp.zeros((d,), jnp.float32),
+        "mlm_bias": jnp.zeros((cfg.vocab_size,), jnp.float32),
+    }
+
+
+def bert_param_specs(cfg: BertConfig, tp_axis: Optional[str]) -> Dict[str, Any]:
+    return {
+        "wte": P(), "wpe": P(), "wtype": P(),
+        "emb_ln_g": P(), "emb_ln_b": P(),
+        "blocks": [block_specs(tp_axis) for _ in range(cfg.n_layers)],
+        "mlm_w": P(), "mlm_b": P(),
+        "mlm_ln_g": P(), "mlm_ln_b": P(),
+        "mlm_bias": P(),
+    }
+
+
+def bert_forward(params, tokens: jnp.ndarray, cfg: BertConfig,
+                 type_ids: Optional[jnp.ndarray] = None,
+                 tp_axis: Optional[str] = None,
+                 sp_axis: Optional[str] = None) -> jnp.ndarray:
+    """(B, S_local) tokens → f32 MLM logits (B, S_local, V)."""
+    B, S_loc = tokens.shape
+    off = jax.lax.axis_index(sp_axis) * S_loc if sp_axis is not None else 0
+    pos = off + jnp.arange(S_loc)
+    x = params["wte"][tokens] + params["wpe"][pos]
+    if type_ids is not None:
+        x = x + params["wtype"][type_ids]
+    x = _layernorm(x.astype(cfg.dtype), params["emb_ln_g"],
+                   params["emb_ln_b"])
+    for p in params["blocks"]:
+        x = transformer_block(x, p, cfg.head_dim, tp_axis, sp_axis,
+                              causal=False)
+    h = jax.nn.gelu(x.astype(jnp.float32) @ params["mlm_w"] + params["mlm_b"])
+    h = _layernorm(h, params["mlm_ln_g"], params["mlm_ln_b"])
+    return h @ params["wte"].T.astype(jnp.float32) + params["mlm_bias"]
+
+
+def bert_mlm_loss(params, tokens, targets, mask, cfg: BertConfig,
+                  dp_axis: Optional[str] = None,
+                  tp_axis: Optional[str] = None,
+                  sp_axis: Optional[str] = None) -> jnp.ndarray:
+    """Masked-LM cross-entropy over ``mask`` positions only.
+
+    ``tokens`` are the corrupted inputs, ``targets`` the originals, ``mask``
+    a {0,1} (B, S) array of predicted positions. Same replication contract
+    as gpt_loss (identical across tp; pmean over sp; dp-local unless
+    dp_axis given).
+    """
+    logits = bert_forward(params, tokens, cfg, tp_axis=tp_axis,
+                          sp_axis=sp_axis)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    m = mask.astype(jnp.float32)
+    axes = tuple(a for a in (dp_axis, sp_axis) if a is not None)
+    num = (nll * m).sum()
+    den = m.sum()
+    if axes:
+        num = jax.lax.psum(num, axes)
+        den = jax.lax.psum(den, axes)
+    return num / jnp.maximum(den, 1.0)

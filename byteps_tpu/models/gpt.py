@@ -65,25 +65,11 @@ def gpt_init(rng: jnp.ndarray, cfg: GPTConfig) -> Dict[str, Any]:
         "wpe": dense(keys[1], (cfg.max_seq, d)),
         "lnf_g": jnp.ones((d,), jnp.float32),
         "lnf_b": jnp.zeros((d,), jnp.float32),
-        "blocks": [],
+        "blocks": [
+            block_init(keys[2 + li], d, ff, hd, cfg.n_layers)
+            for li in range(cfg.n_layers)
+        ],
     }
-    for li in range(cfg.n_layers):
-        bk = jax.random.split(keys[2 + li], 6)
-        params["blocks"].append({
-            "ln1_g": jnp.ones((d,), jnp.float32),
-            "ln1_b": jnp.zeros((d,), jnp.float32),
-            "wq": dense(bk[0], (d, hd)), "bq": jnp.zeros((hd,), jnp.float32),
-            "wk": dense(bk[1], (d, hd)), "bk": jnp.zeros((hd,), jnp.float32),
-            "wv": dense(bk[2], (d, hd)), "bv": jnp.zeros((hd,), jnp.float32),
-            # residual-branch projections scaled down with depth (GPT-2 trick)
-            "wo": dense(bk[3], (hd, d)) / (2 * cfg.n_layers) ** 0.5,
-            "bo": jnp.zeros((d,), jnp.float32),
-            "ln2_g": jnp.ones((d,), jnp.float32),
-            "ln2_b": jnp.zeros((d,), jnp.float32),
-            "w1": dense(bk[4], (d, ff)), "b1": jnp.zeros((ff,), jnp.float32),
-            "w2": dense(bk[5], (ff, d)) / (2 * cfg.n_layers) ** 0.5,
-            "b2": jnp.zeros((d,), jnp.float32),
-        })
     return params
 
 
@@ -95,20 +81,9 @@ def gpt_param_specs(cfg: GPTConfig, tp_axis: Optional[str]) -> Dict[str, Any]:
     column-parallel layers are sharded, everything else replicated (dp/sp
     replication is implicit — those axes never appear in param specs).
     """
-    t = tp_axis  # None → fully replicated specs
-    blk = {
-        "ln1_g": P(), "ln1_b": P(),
-        "wq": P(None, t), "bq": P(t),
-        "wk": P(None, t), "bk": P(t),
-        "wv": P(None, t), "bv": P(t),
-        "wo": P(t, None), "bo": P(),
-        "ln2_g": P(), "ln2_b": P(),
-        "w1": P(None, t), "b1": P(t),
-        "w2": P(t, None), "b2": P(),
-    }
     return {
         "wte": P(), "wpe": P(), "lnf_g": P(), "lnf_b": P(),
-        "blocks": [dict(blk) for _ in range(cfg.n_layers)],
+        "blocks": [block_specs(tp_axis) for _ in range(cfg.n_layers)],
     }
 
 
@@ -119,18 +94,17 @@ def _layernorm(x: jnp.ndarray, g: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return ((xf - mu) * jax.lax.rsqrt(var + 1e-5) * g + b).astype(x.dtype)
 
 
-def _attention(x, p, cfg: GPTConfig, tp_axis, sp_axis):
+def _attention(x, p, head_dim: int, tp_axis, sp_axis, causal: bool = True):
     B, S = x.shape[:2]
     q = col_parallel_matmul(x, p["wq"].astype(x.dtype), p["bq"].astype(x.dtype))
     k = col_parallel_matmul(x, p["wk"].astype(x.dtype), p["bk"].astype(x.dtype))
     v = col_parallel_matmul(x, p["wv"].astype(x.dtype), p["bv"].astype(x.dtype))
-    hd = cfg.head_dim
-    h_loc = q.shape[-1] // hd   # heads this tp shard owns
-    q = q.reshape(B, S, h_loc, hd)
-    k = k.reshape(B, S, h_loc, hd)
-    v = v.reshape(B, S, h_loc, hd)
-    o = ring_attention(q, k, v, sp_axis, causal=True)
-    o = o.reshape(B, S, h_loc * hd)
+    h_loc = q.shape[-1] // head_dim   # heads this tp shard owns
+    q = q.reshape(B, S, h_loc, head_dim)
+    k = k.reshape(B, S, h_loc, head_dim)
+    v = v.reshape(B, S, h_loc, head_dim)
+    o = ring_attention(q, k, v, sp_axis, causal=causal)
+    o = o.reshape(B, S, h_loc * head_dim)
     return row_parallel_matmul(o, p["wo"].astype(x.dtype), tp_axis,
                                p["bo"].astype(x.dtype))
 
@@ -140,6 +114,54 @@ def _mlp(x, p, tp_axis):
     h = jax.nn.gelu(h)
     return row_parallel_matmul(h, p["w2"].astype(x.dtype), tp_axis,
                                p["b2"].astype(x.dtype))
+
+
+def transformer_block(x, p, head_dim: int, tp_axis=None, sp_axis=None,
+                      causal: bool = True):
+    """Pre-LN block shared by the GPT (causal) and BERT (bidirectional)
+    families: attention + MLP, tp col/row-parallel, optional sp ring."""
+    x = x + _attention(_layernorm(x, p["ln1_g"], p["ln1_b"]), p, head_dim,
+                       tp_axis, sp_axis, causal=causal)
+    return x + _mlp(_layernorm(x, p["ln2_g"], p["ln2_b"]), p, tp_axis)
+
+
+def block_init(rng, d: int, ff: int, hd: int, n_layers: int):
+    """One transformer block's params (shape shared across families)."""
+    std = 0.02
+    bk = jax.random.split(rng, 6)
+
+    def dense(key, shape):
+        return jax.random.normal(key, shape, jnp.float32) * std
+
+    return {
+        "ln1_g": jnp.ones((d,), jnp.float32),
+        "ln1_b": jnp.zeros((d,), jnp.float32),
+        "wq": dense(bk[0], (d, hd)), "bq": jnp.zeros((hd,), jnp.float32),
+        "wk": dense(bk[1], (d, hd)), "bk": jnp.zeros((hd,), jnp.float32),
+        "wv": dense(bk[2], (d, hd)), "bv": jnp.zeros((hd,), jnp.float32),
+        "wo": dense(bk[3], (hd, d)) / (2 * n_layers) ** 0.5,
+        "bo": jnp.zeros((d,), jnp.float32),
+        "ln2_g": jnp.ones((d,), jnp.float32),
+        "ln2_b": jnp.zeros((d,), jnp.float32),
+        "w1": dense(bk[4], (d, ff)), "b1": jnp.zeros((ff,), jnp.float32),
+        "w2": dense(bk[5], (ff, d)) / (2 * n_layers) ** 0.5,
+        "b2": jnp.zeros((d,), jnp.float32),
+    }
+
+
+def block_specs(tp_axis):
+    """PartitionSpec dict for one transformer block (see gpt_param_specs)."""
+    t = tp_axis
+    return {
+        "ln1_g": P(), "ln1_b": P(),
+        "wq": P(None, t), "bq": P(t),
+        "wk": P(None, t), "bk": P(t),
+        "wv": P(None, t), "bv": P(t),
+        "wo": P(t, None), "bo": P(),
+        "ln2_g": P(), "ln2_b": P(),
+        "w1": P(None, t), "b1": P(t),
+        "w2": P(t, None), "b2": P(),
+    }
 
 
 def gpt_forward(params, tokens: jnp.ndarray, cfg: GPTConfig,
@@ -160,9 +182,8 @@ def gpt_forward(params, tokens: jnp.ndarray, cfg: GPTConfig,
     pos = off + jnp.arange(S_loc)
     x = (params["wte"][tokens] + params["wpe"][pos]).astype(cfg.dtype)
     for p in params["blocks"]:
-        x = x + _attention(_layernorm(x, p["ln1_g"], p["ln1_b"]), p, cfg,
-                           tp_axis, sp_axis)
-        x = x + _mlp(_layernorm(x, p["ln2_g"], p["ln2_b"]), p, tp_axis)
+        x = transformer_block(x, p, cfg.head_dim, tp_axis, sp_axis,
+                              causal=True)
     x = _layernorm(x, params["lnf_g"], params["lnf_b"])
     # weight-tied readout, f32 logits for a stable softmax/loss
     return x.astype(jnp.float32) @ params["wte"].T.astype(jnp.float32)

@@ -1,11 +1,19 @@
-"""Sharded training-step factory for the model zoo.
+"""Sharded training-step factories for the model zoo.
 
-Builds the full jitted train step over a (dp, tp, sp) mesh: per-device
+Builds full jitted train steps over a (dp, tp, sp) mesh: per-device
 loss+grad via ``shard_map`` (ring attention over sp, Megatron collectives
-over tp inside the model), gradient psum over sp, and BytePS aggregation
-over dp through ``DistributedOptimizer`` (reference hot path, SURVEY §3.2 —
-here fused into one XLA program so chunk collectives overlap backward
-compute).
+over tp inside the models), and BytePS aggregation over dp through
+``DistributedOptimizer`` (reference hot path, SURVEY §3.2 — here fused into
+one XLA program so chunk collectives overlap backward compute).
+
+VMA notes (apply to every factory): per-device AD is exact under
+``check_vma=True`` — replicated params' cotangents get their sp/tp psums
+auto-inserted, and marking params dp-varying (``pcast``) keeps grads
+per-replica LOCAL so dp aggregation stays in DistributedOptimizer. The
+compressed collective defeats the VMA analysis (comm/ici.py), so
+compression runs with ``check_vma=False`` and is restricted to dp-only
+meshes, where the forward has no collectives and per-device AD is
+trivially exact.
 """
 
 from __future__ import annotations
@@ -19,7 +27,19 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from byteps_tpu.jax.optimizer import DistributedOptimizer
+from byteps_tpu.models.bert import (
+    BertConfig,
+    bert_init,
+    bert_mlm_loss,
+    bert_param_specs,
+)
 from byteps_tpu.models.gpt import GPTConfig, gpt_init, gpt_loss, gpt_param_specs
+from byteps_tpu.models.resnet import (
+    ResNetConfig,
+    resnet_init,
+    resnet_loss,
+    resnet_param_specs,
+)
 from byteps_tpu.parallel.sharding import opt_state_specs
 
 
@@ -27,22 +47,18 @@ def _axis(mesh: Mesh, name: str) -> Optional[str]:
     return name if name in mesh.axis_names else None
 
 
-def make_gpt_train_step(
-    cfg: GPTConfig,
-    mesh: Mesh,
-    base_tx: optax.GradientTransformation,
-    compression_params: Optional[Dict[str, Any]] = None,
-    partition_bytes: Optional[int] = None,
-):
-    """Returns ``(step, params, opt_state, batch_sharding)``.
+def _check_compression_mesh(use_vma, tp, sp):
+    if not use_vma and (tp is not None or sp is not None):
+        raise NotImplementedError(
+            "compressed aggregation currently requires a dp-only mesh "
+            "(tp/sp axes need the VMA path, which the compressed collective "
+            "does not yet support)"
+        )
 
-    ``step(params, opt_state, tokens, targets) -> (loss, params, opt_state)``
-    is jitted over ``mesh``; tokens/targets are global arrays of shape
-    (B, S) sharded (dp, sp) by ``batch_sharding``.
-    """
-    dp, tp, sp = _axis(mesh, "dp"), _axis(mesh, "tp"), _axis(mesh, "sp")
-    pspecs = gpt_param_specs(cfg, tp)
 
+def _setup_optimizer(mesh, base_tx, params, pspecs, compression_params,
+                     partition_bytes, dp):
+    """Wrap base_tx with dp aggregation; shard params + opt state."""
     if dp is not None:
         tx = DistributedOptimizer(
             base_tx, compression_params=compression_params, axis=dp,
@@ -50,8 +66,6 @@ def make_gpt_train_step(
         )
     else:
         tx = base_tx
-
-    params = gpt_init(jax.random.PRNGKey(0), cfg)
     params = jax.device_put(
         params, jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs)
     )
@@ -66,44 +80,15 @@ def make_gpt_train_step(
     opt_state = jax.device_put(
         opt_state, jax.tree.map(lambda s: NamedSharding(mesh, s), ospecs)
     )
-    batch_spec = P(dp, sp)
-    batch_sharding = NamedSharding(mesh, batch_spec)
+    return tx, params, opt_state, ospecs
 
-    # Grad loss is dp-LOCAL (dp_axis=None): each dp replica is one reference
-    # worker computing the grad of its own local mean loss; averaging across
-    # workers is DistributedOptimizer's job (push_pull average=True). A dp
-    # pmean inside the loss would double-apply the 1/n_dp.
-    loss_fn = functools.partial(
-        gpt_loss, cfg=cfg, dp_axis=None, tp_axis=tp, sp_axis=sp
-    )
 
-    # VMA (check_vma=True) is what makes per-device AD exact here: replicated
-    # params' cotangents get the needed psums over sp/tp auto-inserted, and
-    # psum/pmean transpose correctly (under check_vma=False psum transposes
-    # to psum, scaling grads by the axis size whenever the forward contains
-    # collectives). The compressed collective's tree_map'd all_to_all defeats
-    # the VMA analysis (see comm/ici.py), so the compressed path runs with
-    # check_vma=False and is restricted to dp-only meshes, where the forward
-    # has no collectives and per-device AD is trivially exact.
-    use_vma = compression_params is None
-    if not use_vma and (tp is not None or sp is not None):
-        raise NotImplementedError(
-            "compressed aggregation currently requires a dp-only mesh "
-            "(tp/sp axes need the VMA path, which the compressed collective "
-            "does not yet support)"
-        )
+def _make_resymmetrize(pspecs, dp):
+    """Collapse conservative VMA variance on grad leaves (numerical identity
+    — AD's auto-psums already made replicated grads bit-identical across
+    sp/tp; only the inferred *type* is too wide on some paths)."""
 
-    def _resymmetrize(g, spec):
-        """Collapse conservative VMA variance on a grad leaf.
-
-        AD's auto-inserted psums make replicated params' grads bit-identical
-        across sp/tp (verified numerically), but the VMA *type* inference is
-        conservative on some paths (e.g. the embedding cotangent through the
-        residual stream). Where the inferred varying-set exceeds the leaf's
-        spec, a pmean over the excess axes is a numerical identity that
-        restores the invariant type. dp-variance is intended (per-worker
-        grads) and left alone.
-        """
+    def resym(g, spec):
         allowed = set()
         for part in spec:
             if part is None:
@@ -113,22 +98,61 @@ def make_gpt_train_step(
         excess = tuple(sorted(a for a in vma if a not in allowed and a != dp))
         return jax.lax.pmean(g, excess) if excess else g
 
+    def apply(grads):
+        return jax.tree.map(resym, grads, pspecs,
+                            is_leaf=lambda x: x is None)
+
+    return apply
+
+
+def _pcast_dp(params, dp, mesh, use_vma):
+    """Mark params dp-varying so AD yields per-replica LOCAL grads
+    (dp aggregation must stay in DistributedOptimizer, the framework's
+    hot path)."""
+    if dp is not None and mesh.shape[dp] > 1 and use_vma:
+        return jax.tree.map(lambda x: jax.lax.pcast(x, (dp,), to="varying"),
+                            params)
+    return params
+
+
+def make_gpt_train_step(
+    cfg: GPTConfig,
+    mesh: Mesh,
+    base_tx: optax.GradientTransformation,
+    compression_params: Optional[Dict[str, Any]] = None,
+    partition_bytes: Optional[int] = None,
+):
+    """Returns ``(step, params, opt_state, batch_sharding)``.
+
+    ``step(params, opt_state, tokens, targets) -> (loss, params, opt_state)``
+    is jitted over ``mesh``; tokens/targets are global (B, S) arrays
+    sharded (dp, sp) by ``batch_sharding``.
+    """
+    dp, tp, sp = _axis(mesh, "dp"), _axis(mesh, "tp"), _axis(mesh, "sp")
+    use_vma = compression_params is None
+    _check_compression_mesh(use_vma, tp, sp)
+    pspecs = gpt_param_specs(cfg, tp)
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    tx, params, opt_state, ospecs = _setup_optimizer(
+        mesh, base_tx, params, pspecs, compression_params, partition_bytes,
+        dp,
+    )
+    batch_spec = P(dp, sp)
+    resym = _make_resymmetrize(pspecs, dp)
+
+    # Grad loss is dp-LOCAL (dp_axis=None): each dp replica is one reference
+    # worker computing the grad of its own local mean loss; averaging across
+    # workers is DistributedOptimizer's job (push_pull average=True). A dp
+    # pmean inside the loss would double-apply the 1/n_dp.
+    loss_fn = functools.partial(
+        gpt_loss, cfg=cfg, dp_axis=None, tp_axis=tp, sp_axis=sp
+    )
+
     def per_device_step(params, opt_state, tokens, targets):
-        if dp is not None and mesh.shape[dp] > 1 and use_vma:
-            # mark params dp-varying so AD yields per-replica LOCAL grads
-            # (instead of auto-psumming over dp) — dp aggregation must stay
-            # in DistributedOptimizer, the framework's hot path.
-            grad_params = jax.tree.map(
-                lambda x: jax.lax.pcast(x, (dp,), to="varying"), params
-            )
-        else:
-            grad_params = params
+        grad_params = _pcast_dp(params, dp, mesh, use_vma)
         loss, grads = jax.value_and_grad(loss_fn)(grad_params, tokens, targets)
         if use_vma:
-            grads = jax.tree.map(
-                _resymmetrize, grads, pspecs,
-                is_leaf=lambda x: x is None,
-            )
+            grads = resym(grads)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         if dp is not None:
@@ -146,7 +170,124 @@ def make_gpt_train_step(
     # level (halves HBM traffic for the weight/optimizer buffers)
     return (
         jax.jit(sharded, donate_argnums=(0, 1)),
-        params, opt_state, batch_sharding,
+        params, opt_state, NamedSharding(mesh, batch_spec),
+    )
+
+
+def make_bert_train_step(
+    cfg: BertConfig,
+    mesh: Mesh,
+    base_tx: optax.GradientTransformation,
+    compression_params: Optional[Dict[str, Any]] = None,
+    partition_bytes: Optional[int] = None,
+):
+    """``step(params, opt_state, tokens, targets, mask)`` — MLM pretraining
+    step (BASELINE config 3 shape), same sharding story as GPT."""
+    dp, tp, sp = _axis(mesh, "dp"), _axis(mesh, "tp"), _axis(mesh, "sp")
+    use_vma = compression_params is None
+    _check_compression_mesh(use_vma, tp, sp)
+    pspecs = bert_param_specs(cfg, tp)
+    params = bert_init(jax.random.PRNGKey(0), cfg)
+    tx, params, opt_state, ospecs = _setup_optimizer(
+        mesh, base_tx, params, pspecs, compression_params, partition_bytes,
+        dp,
+    )
+    batch_spec = P(dp, sp)
+    resym = _make_resymmetrize(pspecs, dp)
+    loss_fn = functools.partial(
+        bert_mlm_loss, cfg=cfg, dp_axis=None, tp_axis=tp, sp_axis=sp
+    )
+
+    def per_device_step(params, opt_state, tokens, targets, mask):
+        grad_params = _pcast_dp(params, dp, mesh, use_vma)
+        loss, grads = jax.value_and_grad(loss_fn)(
+            grad_params, tokens, targets, mask
+        )
+        if use_vma:
+            grads = resym(grads)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        if dp is not None:
+            loss = jax.lax.pmean(loss, dp)
+        return loss, params, opt_state
+
+    sharded = jax.shard_map(
+        per_device_step,
+        mesh=mesh,
+        in_specs=(pspecs, ospecs, batch_spec, batch_spec, batch_spec),
+        out_specs=(P(), pspecs, ospecs),
+        check_vma=use_vma,
+    )
+    return (
+        jax.jit(sharded, donate_argnums=(0, 1)),
+        params, opt_state, NamedSharding(mesh, batch_spec),
+    )
+
+
+def make_resnet_train_step(
+    cfg: ResNetConfig,
+    mesh: Mesh,
+    base_tx: optax.GradientTransformation,
+    compression_params: Optional[Dict[str, Any]] = None,
+    partition_bytes: Optional[int] = None,
+):
+    """``step(params, opt_state, bn_state, images, labels) ->
+    (loss, params, opt_state, bn_state)`` — dp-only conv family
+    (BASELINE config 2 shape); BN stats are dp-synced (SyncBN) so the
+    replicated bn_state stays identical everywhere.
+    """
+    dp = _axis(mesh, "dp")
+    use_vma = compression_params is None
+    params, bn_state = resnet_init(jax.random.PRNGKey(0), cfg)
+    pspecs = resnet_param_specs(cfg, params)
+    tx, params, opt_state, ospecs = _setup_optimizer(
+        mesh, base_tx, params, pspecs, compression_params, partition_bytes,
+        dp,
+    )
+    sspecs = jax.tree.map(lambda _: P(), bn_state)
+    bn_state = jax.device_put(
+        bn_state, jax.tree.map(lambda s: NamedSharding(mesh, s), sspecs)
+    )
+    batch_spec = P(dp)
+    resym = _make_resymmetrize(pspecs, dp)
+
+    def loss_fn(params, bn_state, images, labels):
+        return resnet_loss(params, bn_state, images, labels, cfg,
+                           dp_axis=dp, train=True)
+
+    def per_device_step(params, opt_state, bn_state, images, labels):
+        grad_params = _pcast_dp(params, dp, mesh, use_vma)
+        (loss, new_bn), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            grad_params, bn_state, images, labels
+        )
+        if use_vma:
+            grads = resym(grads)
+            # SyncBN pmean makes stats unvarying, but conservative VMA can
+            # widen the state type the same way it widens grads
+            new_bn = jax.tree.map(
+                lambda s: jax.lax.pmean(
+                    s, tuple(sorted(
+                        a for a in (getattr(jax.typeof(s), "vma", ()) or ())
+                    ))
+                ) if (getattr(jax.typeof(s), "vma", ()) or ()) else s,
+                new_bn,
+            )
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        if dp is not None:
+            loss = jax.lax.pmean(loss, dp)
+        return loss, params, opt_state, new_bn
+
+    sharded = jax.shard_map(
+        per_device_step,
+        mesh=mesh,
+        in_specs=(pspecs, ospecs, sspecs, batch_spec, batch_spec),
+        out_specs=(P(), pspecs, ospecs, sspecs),
+        check_vma=use_vma,
+    )
+    return (
+        jax.jit(sharded, donate_argnums=(0, 1, 2)),
+        params, opt_state, bn_state, NamedSharding(mesh, batch_spec),
     )
 
 
@@ -157,3 +298,14 @@ def synthetic_batch(
     synthetic data too — example/pytorch/benchmark_byteps.py)."""
     toks = jax.random.randint(rng, (batch, seq + 1), 0, cfg.vocab_size)
     return toks[:, :-1], toks[:, 1:]
+
+
+def synthetic_mlm_batch(rng: jnp.ndarray, cfg: BertConfig, batch: int,
+                        seq: int, mask_rate: float = 0.15):
+    """(corrupted tokens, targets, mask) for MLM pretraining."""
+    k1, k2 = jax.random.split(rng)
+    targets = jax.random.randint(k1, (batch, seq), 0, cfg.vocab_size)
+    mask = jax.random.bernoulli(k2, mask_rate, (batch, seq))
+    mask_id = cfg.vocab_size - 1  # last id doubles as [MASK] in synthetic data
+    tokens = jnp.where(mask, mask_id, targets)
+    return tokens, targets, mask.astype(jnp.int32)

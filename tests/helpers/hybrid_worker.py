@@ -1,0 +1,69 @@
+"""Worker body for the hybrid two-tier (ICI + DCN) integration test.
+
+Each worker process is one "pod": 4 virtual CPU devices on a dp mesh.
+push_pull must return the global sum across pods × pod devices
+(reference hybrid path: NCCL reduce → PS push/pull → broadcast).
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.environ["BPS_REPO"])
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+import byteps_tpu.jax as bps
+
+
+def main():
+    bps.init()
+    wid = bps.rank()
+    assert bps.pod_size() == 4
+    assert bps.size() == 8  # 2 pods x 4 devices
+
+    # rows distinct per (pod, device): value = pod*4 + row
+    base = jnp.arange(4, dtype=jnp.float32) + 4 * wid
+    x = jnp.broadcast_to(base[:, None], (4, 1000)) * jnp.ones((4, 1000))
+
+    out = bps.push_pull(x, average=False, name="g0")
+    want = float(sum(range(8)))  # 0+1+...+7 = 28
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6)
+
+    out = bps.push_pull(x, average=True, name="g1")
+    np.testing.assert_allclose(np.asarray(out), want / 8, rtol=1e-6)
+
+    # multi-round consistency (accumulator resets server-side)
+    for r in range(3):
+        out = bps.push_pull(x + r, average=False, name="g2")
+        np.testing.assert_allclose(np.asarray(out), want + 8 * r, rtol=1e-6)
+
+    # broadcast from global rank 5 = pod 1, row 1 → value 5
+    params = {"w": x}
+    got = bps.broadcast_parameters(params, root_rank=5)
+    np.testing.assert_allclose(np.asarray(got["w"]), 5.0, rtol=1e-6)
+
+    # second broadcast with DIFFERENT leaf shapes (params → optimizer state
+    # workflow; regression: per-call unique names, no re-declare crash)
+    opt_like = {"mu": x[:, :7] + wid, "count": jnp.zeros((4, 1)) + wid}
+    got2 = bps.broadcast_parameters(opt_like, root_rank=0)
+    np.testing.assert_allclose(np.asarray(got2["count"]), 0.0, atol=1e-6)
+
+    # multi-partition tensor (exercises partitioned DCN pipeline): with
+    # BYTEPS_PARTITION_BYTES small, this splits into many chunks
+    big = jnp.ones((4, 50000), jnp.float32) * (wid + 1)
+    out = bps.push_pull(big, average=False, name="big")
+    np.testing.assert_allclose(np.asarray(out), 4 * 1 + 4 * 2, rtol=1e-6)
+
+    bps.shutdown()
+    print(f"HYBRID_WORKER_{wid}_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""Hybrid two-tier aggregation: 2 worker pods (4 virtual CPU devices each)
++ 1 native summation server — BASELINE config 5's topology on localhost
+(reference: hybrid PS with intra-node NCCL reduce, SURVEY §2.7 flavor 2)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HELPER = os.path.join(REPO, "tests", "helpers", "hybrid_worker.py")
+PORT = 19800
+
+
+def test_two_pods_hybrid_push_pull():
+    env_base = {
+        **os.environ,
+        "BPS_REPO": REPO,
+        "PYTHONPATH": REPO,
+        "DMLC_NUM_WORKER": "2",
+        "DMLC_NUM_SERVER": "1",
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(PORT),
+        "BYTEPS_PARTITION_BYTES": "65536",
+    }
+    server = subprocess.Popen(
+        [sys.executable, "-m", "byteps_tpu.launcher"],
+        env={**env_base, "DMLC_ROLE": "server", "JAX_PLATFORMS": "cpu"},
+        cwd=REPO,
+    )
+    workers = []
+    try:
+        for wid in range(2):
+            workers.append(subprocess.Popen(
+                [sys.executable, HELPER],
+                env={**env_base, "DMLC_ROLE": "worker",
+                     "DMLC_WORKER_ID": str(wid)},
+                cwd=REPO, stdout=subprocess.PIPE, text=True,
+            ))
+        outs = []
+        for w in workers:
+            out, _ = w.communicate(timeout=180)
+            outs.append(out)
+            assert w.returncode == 0, out
+        combined = "".join(outs)
+        assert "HYBRID_WORKER_0_OK" in combined
+        assert "HYBRID_WORKER_1_OK" in combined
+        server.wait(timeout=30)
+        assert server.returncode == 0
+    finally:
+        for p in workers + [server]:
+            if p.poll() is None:
+                p.kill()
