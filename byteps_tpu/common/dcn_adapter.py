@@ -1,0 +1,99 @@
+"""Shared worker-side core for host-framework adapters (torch, tensorflow).
+
+Reference analog: the common machinery both ``byteps/torch/ops.cc`` and
+``byteps/tensorflow/ops.cc`` call into (``EnqueueTensor`` + queue lists,
+``operations.cc``): tensor declaration/partitioning, the credit-scheduled
+PUSH/PULL pipeline against the DCN summation servers, and handle assembly.
+Framework adapters only convert tensors to/from flat numpy fp32.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from byteps_tpu.common.config import get_config
+from byteps_tpu.common.logging import get_logger
+from byteps_tpu.common.partition import TensorRegistry
+from byteps_tpu.common.scheduler import (
+    Handle,
+    PartitionTask,
+    PipelineScheduler,
+    Stage,
+)
+from byteps_tpu.common.tracing import get_tracer
+from byteps_tpu.server import PSWorker
+
+log = get_logger("dcn_adapter")
+
+
+class DcnCore:
+    """One per process; drives flat fp32 buffers through PUSH/PULL."""
+
+    def __init__(self) -> None:
+        cfg = get_config()
+        self.cfg = cfg
+        self.worker = PSWorker()
+        self.registry = TensorRegistry()
+        self.scheduler = PipelineScheduler(
+            stages=[
+                Stage("PUSH", self._push_stage, credited=True, pool_size=4),
+                Stage("PULL", self._pull_stage, pool_size=4),
+            ],
+            credit=cfg.scheduling_credit,
+            tracer=get_tracer(),
+        )
+        self._inited_keys = set()
+        self._key_lock = threading.Lock()
+        self.worker.barrier()
+
+    # -- stages -------------------------------------------------------------
+    def _push_stage(self, task: PartitionTask):
+        p = task.partition
+        flat: np.ndarray = task.context["flat"]
+        chunk = np.ascontiguousarray(flat[p.offset:p.offset + p.length])
+        with self._key_lock:
+            needs_init = p.key not in self._inited_keys
+            if needs_init:
+                self._inited_keys.add(p.key)
+        if needs_init:
+            # no cross-worker barrier needed: server-side init is idempotent
+            # and never resets an existing store, so only THIS worker's init
+            # must precede its own push (serial on this connection)
+            self.worker.init_key(p.key, p.length * 4)
+        return self.worker.push(p.key, chunk)
+
+    def _pull_stage(self, task: PartitionTask):
+        p = task.partition
+        return self.worker.pull(p.key, p.length, task.payload)
+
+    # -- public -------------------------------------------------------------
+    def push_pull_async(self, flat: np.ndarray, name: str,
+                        priority: Optional[int] = None) -> Handle:
+        """Enqueue a flat fp32 vector; returns a Handle whose results are
+        per-partition summed numpy chunks."""
+        ctx = self.registry.declare(name, (flat.size,), np.float32)
+        handle = Handle(name, len(ctx.partitions))
+        shared = {"flat": flat}
+        tasks = []
+        for p in ctx.partitions:
+            if priority is not None:
+                p = type(p)(key=p.key, tensor_id=p.tensor_id,
+                            part_idx=p.part_idx, offset=p.offset,
+                            length=p.length, priority=priority)
+            tasks.append(PartitionTask(partition=p, name=name, handle=handle,
+                                       context=shared))
+        self.scheduler.enqueue(tasks)
+        return handle
+
+    @staticmethod
+    def assemble(handle: Handle, timeout: Optional[float] = 120.0) -> np.ndarray:
+        results = handle.wait(timeout)
+        parts = [results[i] for i in sorted(results)]
+        return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+    def shutdown(self) -> None:
+        self.scheduler.shutdown()
+        self.worker.shutdown()

@@ -1,0 +1,31 @@
+"""byteps_tpu.mxnet — MXNet adapter surface (gated).
+
+Reference analog: ``byteps/mxnet/`` (DistributedTrainer over gluon,
+byteps_declare_tensor + push_pull in ``_allreduce_grads``). MXNet reached
+end-of-life upstream (retired from Apache in 2023) and is not part of this
+image's supported stack; the adapter surface is declared for reference
+parity and raises with guidance at import-use time. The torch and
+tensorflow adapters cover the host-framework capability; ``byteps_tpu.jax``
+is the native path.
+"""
+
+from __future__ import annotations
+
+_MSG = (
+    "MXNet is end-of-life and not installed in this environment. Use "
+    "byteps_tpu.torch, byteps_tpu.tensorflow, or byteps_tpu.jax instead. "
+    "(If you vendor MXNet yourself, the DcnCore in "
+    "byteps_tpu/common/dcn_adapter.py is the integration point — see the "
+    "torch adapter for the ~200-line pattern.)"
+)
+
+try:  # pragma: no cover - exercised only where mxnet exists
+    import mxnet  # noqa: F401
+
+    _HAVE_MXNET = True
+except ImportError:
+    _HAVE_MXNET = False
+
+
+def __getattr__(name: str):
+    raise ImportError(_MSG)

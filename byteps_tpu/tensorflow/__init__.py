@@ -1,0 +1,213 @@
+"""byteps_tpu.tensorflow — the TensorFlow framework adapter (TF2 eager).
+
+Reference analog: ``byteps/tensorflow/__init__.py`` + ``ops.cc`` — same
+public surface: ``init``, ``rank``/``size``, ``push_pull``,
+``DistributedGradientTape``, ``DistributedOptimizer``,
+``broadcast_variables``, Keras ``BroadcastGlobalVariablesCallback``. CPU
+workers over the DCN summation service via the shared adapter core (the
+TPU compute path lives in ``byteps_tpu.jax``; this exists for capability
+parity with the reference's TF users, e.g.
+example/tensorflow/synthetic_benchmark.py).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import numpy as np
+import tensorflow as tf
+
+from byteps_tpu.common.config import get_config
+from byteps_tpu.common.dcn_adapter import DcnCore
+from byteps_tpu.common.logging import bps_check, get_logger
+from byteps_tpu.common.scheduler import Handle
+
+log = get_logger("tensorflow")
+
+
+class _TfState:
+    def __init__(self) -> None:
+        self.initialized = False
+        self.cfg = None
+        self.core: Optional[DcnCore] = None
+
+
+_state = _TfState()
+
+
+def init() -> None:
+    """Reference: ``byteps_init`` (env-driven topology, DMLC_*)."""
+    if _state.initialized:
+        return
+    _state.cfg = get_config()
+    _state.core = DcnCore()
+    _state.initialized = True
+    log.info("byteps_tpu.tensorflow initialized: worker %d/%d",
+             _state.cfg.worker_id, _state.cfg.num_worker)
+
+
+def shutdown() -> None:
+    if not _state.initialized:
+        return
+    _state.core.shutdown()
+    _state.initialized = False
+
+
+def _require_init() -> None:
+    bps_check(_state.initialized, "call byteps_tpu.tensorflow.init() first")
+
+
+def rank() -> int:
+    _require_init()
+    return _state.cfg.worker_id
+
+
+def size() -> int:
+    _require_init()
+    return _state.cfg.num_worker
+
+
+def local_rank() -> int:
+    _require_init()
+    return _state.cfg.local_rank
+
+
+def local_size() -> int:
+    _require_init()
+    return _state.cfg.local_size
+
+
+def push_pull_async(tensor: tf.Tensor, average: bool = True,
+                    name: Optional[str] = None,
+                    priority: Optional[int] = None) -> Handle:
+    """Async sum/mean across workers; returns a Handle for
+    :func:`synchronize` (reference: the BytePSPushPull AsyncOpKernel)."""
+    _require_init()
+    bps_check(name is not None, "byteps_tpu.tensorflow.push_pull requires "
+                                "a tensor name (keys must agree across "
+                                "workers)")
+    flat = np.asarray(tf.reshape(tf.cast(tensor, tf.float32), [-1]))
+    handle = _state.core.push_pull_async(flat, name, priority)
+    handle.shape = tensor.shape        # type: ignore[attr-defined]
+    handle.dtype = tensor.dtype        # type: ignore[attr-defined]
+    handle.average = average           # type: ignore[attr-defined]
+    return handle
+
+
+def synchronize(handle: Handle, timeout: Optional[float] = 120.0) -> tf.Tensor:
+    flat = DcnCore.assemble(handle, timeout)
+    if handle.average:  # type: ignore[attr-defined]
+        flat = flat / size()
+    out = tf.reshape(tf.convert_to_tensor(flat), handle.shape)  # type: ignore[attr-defined]
+    return tf.cast(out, handle.dtype)  # type: ignore[attr-defined]
+
+
+def push_pull(tensor: tf.Tensor, average: bool = True,
+              name: Optional[str] = None,
+              priority: Optional[int] = None) -> tf.Tensor:
+    return synchronize(push_pull_async(tensor, average, name, priority))
+
+
+class DistributedGradientTape:
+    """Wrap a ``tf.GradientTape``: ``gradient()`` returns push_pull'd
+    (averaged) gradients (reference: DistributedGradientTape for eager
+    mode)."""
+
+    def __init__(self, tape: tf.GradientTape, compression=None):
+        self._tape = tape
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources,
+                                    output_gradients=output_gradients)
+        handles = []
+        for i, g in enumerate(grads):
+            if g is None:
+                handles.append(None)
+                continue
+            if isinstance(g, tf.IndexedSlices):
+                g = tf.convert_to_tensor(g)
+            handles.append(push_pull_async(
+                g, average=True, name=f"byteps_push_pull.grad_{i}",
+            ))
+        return [None if h is None else synchronize(h) for h in handles]
+
+
+class DistributedOptimizer(tf.keras.optimizers.Optimizer):
+    """Wrap a keras optimizer: ``apply_gradients`` push_pulls each gradient
+    first (reference: DistributedOptimizer wrapping compute_gradients)."""
+
+    def __init__(self, optimizer, name: str = "BytePSDistributedOptimizer",
+                 **kwargs):
+        super().__init__(name=name, learning_rate=1.0)
+        self._opt = optimizer
+
+    def apply_gradients(self, grads_and_vars, **kwargs):
+        gv = list(grads_and_vars)
+        handles = []
+        for i, (g, v) in enumerate(gv):
+            if g is None:
+                handles.append(None)
+                continue
+            if isinstance(g, tf.IndexedSlices):
+                g = tf.convert_to_tensor(g)
+            # Keras 3 variable .name is unscoped ("kernel"); .path is the
+            # unique scoped name ("sequential/dense/kernel")
+            vname = getattr(v, "path", v.name).replace(":", "_")
+            handles.append(push_pull_async(
+                g, average=True, name=f"byteps_push_pull.{vname}",
+            ))
+        new_gv = [
+            (g if h is None else synchronize(h), v)
+            for h, (g, v) in zip(handles, gv)
+        ]
+        return self._opt.apply_gradients(new_gv, **kwargs)
+
+    def update_step(self, gradient, variable, learning_rate=None):
+        raise NotImplementedError(
+            "use apply_gradients (this wrapper delegates to the inner "
+            "optimizer)"
+        )
+
+    def get_config(self):  # pragma: no cover
+        return {"name": self.name}
+
+
+def broadcast_variables(variables: Iterable[tf.Variable],
+                        root_rank: int = 0) -> None:
+    """Assign root's values to all workers' variables, in place (reference:
+    broadcast_global_variables; zero-on-non-root + summed push_pull)."""
+    _require_init()
+    handles = []
+    var_list = list(variables)
+    for i, v in enumerate(var_list):
+        # keras-3 Variables expose .value as a property, tf.Variable as a
+        # method — convert_to_tensor handles both
+        val = (tf.convert_to_tensor(v) if rank() == root_rank
+               else tf.zeros_like(v))
+        vname = getattr(v, "path", None) or f"{v.name}.{i}"
+        handles.append(push_pull_async(
+            val, average=False, name=f"byteps_broadcast.{vname}",
+        ))
+    for v, h in zip(var_list, handles):
+        v.assign(synchronize(h))
+
+
+broadcast_global_variables = broadcast_variables
+
+
+class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
+    """Keras callback: broadcast weights from root at train start
+    (reference: byteps/tensorflow/keras callbacks)."""
+
+    def __init__(self, root_rank: int = 0):
+        super().__init__()
+        self._root = root_rank
+        self._done = False
+
+    def on_train_begin(self, logs=None):
+        if not self._done:
+            broadcast_variables(self.model.variables, self._root)
+            self._done = True

@@ -14,23 +14,15 @@ adapter exists for capability parity with the reference's torch users
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, Iterable, Optional, Tuple
 
 import numpy as np
 import torch
 
 from byteps_tpu.common.config import get_config
+from byteps_tpu.common.dcn_adapter import DcnCore
 from byteps_tpu.common.logging import bps_check, get_logger
-from byteps_tpu.common.partition import TensorRegistry
-from byteps_tpu.common.scheduler import (
-    Handle,
-    PartitionTask,
-    PipelineScheduler,
-    Stage,
-)
-from byteps_tpu.common.tracing import get_tracer
-from byteps_tpu.server import PSWorker
+from byteps_tpu.common.scheduler import Handle
 
 log = get_logger("torch")
 
@@ -49,11 +41,7 @@ class _TorchState:
     def __init__(self) -> None:
         self.initialized = False
         self.cfg = None
-        self.worker: Optional[PSWorker] = None
-        self.registry: Optional[TensorRegistry] = None
-        self.scheduler: Optional[PipelineScheduler] = None
-        self.inited_keys = set()
-        self.key_lock = threading.Lock()
+        self.core: Optional[DcnCore] = None
 
 
 _state = _TorchState()
@@ -67,17 +55,7 @@ def init() -> None:
         return
     cfg = get_config()
     _state.cfg = cfg
-    _state.worker = PSWorker()
-    _state.registry = TensorRegistry()
-    _state.scheduler = PipelineScheduler(
-        stages=[
-            Stage("PUSH", _push_stage, credited=True, pool_size=4),
-            Stage("PULL", _pull_stage, pool_size=4),
-        ],
-        credit=cfg.scheduling_credit,
-        tracer=get_tracer(),
-    )
-    _state.worker.barrier()
+    _state.core = DcnCore()
     _state.initialized = True
     log.info("byteps_tpu.torch initialized: worker %d/%d",
              cfg.worker_id, cfg.num_worker)
@@ -86,10 +64,8 @@ def init() -> None:
 def shutdown() -> None:
     if not _state.initialized:
         return
-    _state.scheduler.shutdown()
-    _state.worker.shutdown()
+    _state.core.shutdown()
     _state.initialized = False
-    _state.inited_keys.clear()
 
 
 def _require_init() -> None:
@@ -116,29 +92,6 @@ def local_size() -> int:
     return _state.cfg.local_size
 
 
-# --- pipeline stages --------------------------------------------------------
-def _push_stage(task: PartitionTask):
-    p = task.partition
-    flat: np.ndarray = task.context["flat"]
-    chunk = np.ascontiguousarray(flat[p.offset:p.offset + p.length])
-    with _state.key_lock:
-        needs_init = p.key not in _state.inited_keys
-        if needs_init:
-            _state.inited_keys.add(p.key)
-    if needs_init:
-        # no cross-worker barrier needed: server-side init is idempotent
-        # and never resets an existing store, so only THIS worker's init
-        # must precede its own push (serial on this connection)
-        _state.worker.init_key(p.key, p.length * 4)
-    return _state.worker.push(p.key, chunk)
-
-
-def _pull_stage(task: PartitionTask):
-    p = task.partition
-    version = task.payload
-    return _state.worker.pull(p.key, p.length, version)
-
-
 # --- push_pull --------------------------------------------------------------
 def push_pull_async(
     tensor: torch.Tensor,
@@ -160,29 +113,16 @@ def push_pull_async(
     flat = t.to(torch.float32).contiguous().view(-1).numpy()
     if compression == Compression.fp16:
         flat = flat.astype(np.float16).astype(np.float32)
-    ctx = _state.registry.declare(name, (flat.size,), np.float32)
-    handle = Handle(name, len(ctx.partitions))
+    handle = _state.core.push_pull_async(flat, name, priority)
     handle.tensor = tensor          # type: ignore[attr-defined]
     handle.average = average        # type: ignore[attr-defined]
-    shared = {"flat": flat}
-    tasks = []
-    for p in ctx.partitions:
-        if priority is not None:
-            p = type(p)(key=p.key, tensor_id=p.tensor_id,
-                        part_idx=p.part_idx, offset=p.offset,
-                        length=p.length, priority=priority)
-        tasks.append(PartitionTask(partition=p, name=name, handle=handle,
-                                   context=shared))
-    _state.scheduler.enqueue(tasks)
     return handle
 
 
 def synchronize(handle: Handle, timeout: Optional[float] = 120.0) -> torch.Tensor:
     """Wait and write the aggregated value back into the original tensor
     (reference: ``synchronize``/``wait_and_clear``)."""
-    results = handle.wait(timeout)
-    parts = [results[i] for i in sorted(results)]
-    flat = parts[0] if len(parts) == 1 else np.concatenate(parts)
+    flat = DcnCore.assemble(handle, timeout)
     if handle.average:  # type: ignore[attr-defined]
         flat = flat / size()
     tensor: torch.Tensor = handle.tensor  # type: ignore[attr-defined]
@@ -285,7 +225,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             if p.requires_grad:
                 name = f"byteps_push_pull.{pname}"
                 self._names[p] = name
-                _state.registry.declare(name, (p.numel(),), np.float32)
+                _state.core.registry.declare(name, (p.numel(),), np.float32)
         for pname, p in named:
             if p.requires_grad:
                 self._hooks.append(p.register_post_accumulate_grad_hook(

@@ -1,0 +1,63 @@
+"""TensorFlow synthetic benchmark (reference:
+example/tensorflow/synthetic_benchmark.py — measures img/s on random data
+with DistributedGradientTape)."""
+
+import argparse
+import os
+import sys
+import time
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import numpy as np
+import tensorflow as tf
+
+import byteps_tpu.tensorflow as bps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-iters", type=int, default=10)
+    ap.add_argument("--num-warmup", type=int, default=3)
+    args = ap.parse_args()
+
+    bps.init()
+    tf.keras.utils.set_random_seed(0)
+    model = tf.keras.Sequential([
+        tf.keras.layers.Conv2D(32, 3, activation="relu"),
+        tf.keras.layers.Conv2D(64, 3, activation="relu"),
+        tf.keras.layers.GlobalAveragePooling2D(),
+        tf.keras.layers.Dense(10),
+    ])
+    opt = tf.keras.optimizers.SGD(0.01)
+    data = tf.random.normal((args.batch_size, 32, 32, 3))
+    target = tf.random.uniform((args.batch_size,), 0, 10, tf.int64)
+    loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True)
+
+    def step():
+        with tf.GradientTape() as tape:
+            loss = loss_fn(target, model(data))
+        tape = bps.DistributedGradientTape(tape)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+
+    step()
+    bps.broadcast_variables(model.variables, root_rank=0)
+    for _ in range(args.num_warmup):
+        step()
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        step()
+    dt = (time.perf_counter() - t0) / args.num_iters
+    if bps.rank() == 0:
+        print(f"img/s per worker: {args.batch_size / dt:.1f} "
+              f"({bps.size()} workers, total "
+              f"{args.batch_size / dt * bps.size():.1f})", flush=True)
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

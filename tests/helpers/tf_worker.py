@@ -1,0 +1,77 @@
+"""Worker body for the tensorflow-adapter localhost integration test
+(mirrors tests/helpers/torch_worker.py)."""
+
+import os
+import sys
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+sys.path.insert(0, os.environ["BPS_REPO"])
+
+import numpy as np
+import tensorflow as tf
+
+import byteps_tpu.tensorflow as bps
+
+
+def make_model():
+    tf.keras.utils.set_random_seed(0)
+    return tf.keras.Sequential([
+        tf.keras.layers.Dense(16, activation="relu", input_shape=(8,)),
+        tf.keras.layers.Dense(4),
+    ])
+
+
+def main():
+    bps.init()
+    r, n = bps.rank(), bps.size()
+
+    # 1. push_pull correctness
+    x = tf.fill((5, 3), float(r + 1))
+    out = bps.push_pull(x, average=False, name="t0")
+    want = sum(float(i + 1) for i in range(n))
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6)
+    out = bps.push_pull(x, average=True, name="t1")
+    np.testing.assert_allclose(np.asarray(out), want / n, rtol=1e-6)
+
+    # 2. broadcast_variables
+    model = make_model()
+    for v in model.variables:
+        v.assign_add(tf.ones_like(v) * 10 * r)  # desync non-root
+    bps.broadcast_variables(model.variables, root_rank=0)
+    gold = make_model()
+    for v, g in zip(model.variables, gold.variables):
+        np.testing.assert_allclose(np.asarray(v), np.asarray(g), rtol=1e-6)
+
+    # 3. DistributedGradientTape training == single-process gold on the
+    # combined batch
+    rng = np.random.RandomState(42)
+    full_x = rng.randn(8 * n, 8).astype(np.float32)
+    full_y = rng.randn(8 * n, 4).astype(np.float32)
+    my_x, my_y = full_x[r * 8:(r + 1) * 8], full_y[r * 8:(r + 1) * 8]
+
+    model = make_model()
+    opt = tf.keras.optimizers.SGD(0.1)
+    for _ in range(5):
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_mean((model(my_x) - my_y) ** 2)
+        tape = bps.DistributedGradientTape(tape)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+
+    gold = make_model()
+    gopt = tf.keras.optimizers.SGD(0.1)
+    for _ in range(5):
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_mean((gold(full_x) - full_y) ** 2)
+        grads = tape.gradient(loss, gold.trainable_variables)
+        gopt.apply_gradients(zip(grads, gold.trainable_variables))
+    for v, g in zip(model.trainable_variables, gold.trainable_variables):
+        np.testing.assert_allclose(np.asarray(v), np.asarray(g),
+                                   rtol=1e-4, atol=1e-5)
+
+    bps.shutdown()
+    print(f"TF_WORKER_{r}_OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""TensorFlow adapter: localhost server + 2 CPU workers (reference pattern,
+mirroring tests/test_torch_integration.py)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HELPER = os.path.join(REPO, "tests", "helpers", "tf_worker.py")
+PORT = 19900
+
+
+def test_two_tf_workers_one_server():
+    env_base = {
+        **os.environ,
+        "BPS_REPO": REPO,
+        "PYTHONPATH": REPO,
+        "DMLC_NUM_WORKER": "2",
+        "DMLC_NUM_SERVER": "1",
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(PORT),
+        "BYTEPS_PARTITION_BYTES": "256",
+        "JAX_PLATFORMS": "cpu",
+    }
+    server = subprocess.Popen(
+        [sys.executable, "-m", "byteps_tpu.launcher"],
+        env={**env_base, "DMLC_ROLE": "server"}, cwd=REPO,
+    )
+    workers = []
+    try:
+        for wid in range(2):
+            workers.append(subprocess.Popen(
+                [sys.executable, HELPER],
+                env={**env_base, "DMLC_ROLE": "worker",
+                     "DMLC_WORKER_ID": str(wid)},
+                cwd=REPO, stdout=subprocess.PIPE, text=True,
+            ))
+        outs = []
+        for w in workers:
+            out, _ = w.communicate(timeout=180)
+            outs.append(out)
+            assert w.returncode == 0, out
+        combined = "".join(outs)
+        assert "TF_WORKER_0_OK" in combined
+        assert "TF_WORKER_1_OK" in combined
+        server.wait(timeout=30)
+        assert server.returncode == 0
+    finally:
+        for p in workers + [server]:
+            if p.poll() is None:
+                p.kill()
