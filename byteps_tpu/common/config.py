@@ -75,6 +75,10 @@ class Config:
     enable_async: bool = False
     enable_ipc: bool = False
     server_engine_threads: int = DEFAULT_SERVER_ENGINE_THREADS
+    # Server expires pulls waiting longer than this with an error so a dead
+    # worker fails the job fast instead of hanging its peers (reference
+    # analog: ps-lite heartbeat/resender timeouts). 0 disables.
+    pull_timeout_ms: int = 60000
     log_level: str = "INFO"
     # compression: compress only partitions >= this many bytes (reference
     # BYTEPS_MIN_COMPRESS_BYTES semantics: tiny tensors aren't worth it).
@@ -114,6 +118,7 @@ class Config:
             enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),
             enable_ipc=_env_bool("BYTEPS_ENABLE_IPC"),
             server_engine_threads=_env_int("BYTEPS_SERVER_ENGINE_THREAD", DEFAULT_SERVER_ENGINE_THREADS),
+            pull_timeout_ms=_env_int("BYTEPS_SERVER_PULL_TIMEOUT_MS", 60000),
             log_level=_env_str("BYTEPS_LOG_LEVEL", "INFO").upper(),
             min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES", 65536),
             trace_on=_env_bool("BYTEPS_TRACE_ON"),

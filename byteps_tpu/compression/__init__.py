@@ -18,6 +18,7 @@ from byteps_tpu.compression.base import (  # noqa: F401
     get_compressor,
     register_compressor,
 )
+from byteps_tpu.compression.fp16 import Fp16Compressor  # noqa: F401
 from byteps_tpu.compression.onebit import OnebitCompressor  # noqa: F401
 from byteps_tpu.compression.topk import TopkCompressor  # noqa: F401
 from byteps_tpu.compression.randomk import RandomkCompressor  # noqa: F401

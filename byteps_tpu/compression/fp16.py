@@ -1,0 +1,36 @@
+"""IEEE-half compression (reference: the Python-level ``Compression.fp16``
+shim in ``byteps/torch/compression.py`` / ``byteps/tensorflow/compression.py``
+— there a dtype cast around push_pull; here a first-class registry compressor
+so it also rides the DCN wire at half the bytes via ``wire.Fp16Wire``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from byteps_tpu.compression.base import Compressor, Payload, register_compressor
+
+
+@register_compressor("fp16")
+class Fp16Compressor(Compressor):
+    name = "fp16"
+    presummable = True  # linear codec: positional sums commute with decode
+
+    def __init__(self, **_ignored):
+        pass
+
+    def compress(self, x: jnp.ndarray, rng: Optional[jnp.ndarray] = None) -> Payload:
+        return {"values": x.astype(jnp.float16)}
+
+    def decompress(
+        self,
+        payload: Payload,
+        n: int,
+        dtype=jnp.float32,
+        rng: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        return payload["values"].astype(dtype)
+
+    def compressed_bytes(self, n: int, itemsize: int = 4) -> int:
+        return n * 2

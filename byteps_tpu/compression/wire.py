@@ -1,0 +1,329 @@
+"""Host-side (numpy) wire codecs for the DCN parameter-server tier.
+
+Reference analog: the worker half of byteps's compression feature — the
+COMPRESS/DECOMPRESS stages around PUSH/PULL in
+``byteps/common/core_loops.cc``, whose byte formats the server
+(``byteps/server/server.cc``) decompresses, fp32-sums, and re-compresses.
+The byte layouts here must match ``server/csrc/codec.cc`` bit-exactly; the
+formats are documented in ``server/csrc/codec.h``.
+
+These are deliberately *numpy* (host) implementations: the hybrid pipeline's
+COMPRESS stage runs after COPYD2H on scheduler pool threads, off the TPU —
+the Pallas/jnp compressors in this package serve the fused ICI tier instead.
+Stochastic choices (randomk support, dithering rounding) derive only from a
+caller-supplied integer seed so every pod agrees where it must.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from byteps_tpu.compression.error_feedback import CompressionSpec
+
+# Codec ids — must match server/csrc/codec.h Codec enum.
+WIRE_RAW = 0
+WIRE_FP16 = 1
+WIRE_ONEBIT = 2
+WIRE_TOPK = 3
+WIRE_DITHER = 4
+
+_DITHER_NATURAL = 0x1
+_DITHER_MAXNORM = 0x2
+
+
+class WireCodec:
+    """Encode/decode one partition for the DCN wire (fp32 both ends)."""
+
+    codec_id = WIRE_RAW
+
+    def encode(self, x: np.ndarray, seed: int = 0) -> np.ndarray:
+        """fp32 vector -> uint8 wire bytes."""
+        return np.ascontiguousarray(x, np.float32).view(np.uint8).ravel()
+
+    def decode(self, buf: np.ndarray, n: int, seed: int = 0) -> np.ndarray:
+        """uint8 wire bytes -> fp32 vector of length n."""
+        return np.ascontiguousarray(buf[: n * 4]).view(np.float32).copy()
+
+    def store_elems(self, n: int) -> int:
+        """Dense fp32 elements the server must allocate for this key."""
+        return n
+
+    def wire_bytes(self, n: int) -> int:
+        return n * 4
+
+
+class Fp16Wire(WireCodec):
+    """IEEE binary16 wire — halves every push/pull byte (the reference's
+    fp16 Compression shim, byteps/torch/compression.py, with real wire
+    savings rather than a round-trip simulation)."""
+
+    codec_id = WIRE_FP16
+
+    def encode(self, x: np.ndarray, seed: int = 0) -> np.ndarray:
+        return (
+            np.ascontiguousarray(x, np.float32)
+            .astype(np.float16)
+            .view(np.uint8)
+            .ravel()
+        )
+
+    def decode(self, buf: np.ndarray, n: int, seed: int = 0) -> np.ndarray:
+        return (
+            np.ascontiguousarray(buf[: n * 2])
+            .view(np.float16)
+            .astype(np.float32)
+        )
+
+    def wire_bytes(self, n: int) -> int:
+        return n * 2
+
+
+class OnebitWire(WireCodec):
+    """[f32 scale][ceil(n/32) u32 words]; bit (i&31) of word i>>5 set means
+    x[i] >= +0.0 (signbit semantics, so -0.0 encodes negative)."""
+
+    codec_id = WIRE_ONEBIT
+
+    def __init__(self, scaling: bool = True):
+        self.scaling = bool(scaling)
+
+    def encode(self, x: np.ndarray, seed: int = 0) -> np.ndarray:
+        xf = np.ascontiguousarray(x, np.float32)
+        n = xf.size
+        scale = np.float32(np.mean(np.abs(xf)) if self.scaling and n else 1.0)
+        bits = ~np.signbit(xf)
+        nwords = (n + 31) // 32
+        packed = np.packbits(bits, bitorder="little")
+        words = np.zeros(nwords * 4, np.uint8)
+        words[: packed.size] = packed
+        out = np.empty(4 + nwords * 4, np.uint8)
+        out[:4] = np.frombuffer(np.float32(scale).tobytes(), np.uint8)
+        out[4:] = words
+        return out
+
+    def decode(self, buf: np.ndarray, n: int, seed: int = 0) -> np.ndarray:
+        buf = np.ascontiguousarray(buf)
+        scale = buf[:4].view(np.float32)[0]
+        bits = np.unpackbits(buf[4:], bitorder="little")[:n]
+        return np.where(bits, scale, -scale).astype(np.float32)
+
+    def wire_bytes(self, n: int) -> int:
+        return 4 + 4 * ((n + 31) // 32)
+
+
+class TopkWire(WireCodec):
+    """[u32 k][k u32 indices][k f32 values]; server scatter-adds."""
+
+    codec_id = WIRE_TOPK
+
+    def __init__(self, k=0.01):
+        self.k = k
+
+    def _k(self, n: int) -> int:
+        from byteps_tpu.compression.topk import resolve_k
+
+        return resolve_k(self.k, n)
+
+    def encode(self, x: np.ndarray, seed: int = 0) -> np.ndarray:
+        xf = np.ascontiguousarray(x, np.float32)
+        n = xf.size
+        k = self._k(n)
+        idx = np.argpartition(np.abs(xf), n - k)[n - k:].astype(np.uint32)
+        out = np.empty(4 + k * 8, np.uint8)
+        out[:4] = np.frombuffer(np.uint32(k).tobytes(), np.uint8)
+        out[4:4 + k * 4] = idx.view(np.uint8)
+        out[4 + k * 4:] = xf[idx].view(np.uint8)
+        return out
+
+    def decode(self, buf: np.ndarray, n: int, seed: int = 0) -> np.ndarray:
+        buf = np.ascontiguousarray(buf)
+        k = int(buf[:4].view(np.uint32)[0])
+        idx = buf[4:4 + k * 4].view(np.uint32).astype(np.int64)
+        val = buf[4 + k * 4:4 + k * 8].view(np.float32)
+        dense = np.zeros(n, np.float32)
+        np.add.at(dense, idx, val)
+        return dense
+
+    def wire_bytes(self, n: int) -> int:
+        return 4 + self._k(n) * 8
+
+
+class RandomkWire(WireCodec):
+    """Values-only wire for seed-synced randomk: every pod derives the same
+    k indices from the shared seed, so the server positional-sums k floats
+    without ever seeing indices (the reference's synced-PRNG trick); the
+    store for this key is k elements, not n."""
+
+    codec_id = WIRE_RAW  # positional fp32 sum on the server
+
+    def __init__(self, k=0.01, scale: bool = True):
+        self.k = k
+        self.scale = bool(scale)
+
+    def _k(self, n: int) -> int:
+        from byteps_tpu.compression.topk import resolve_k
+
+        return resolve_k(self.k, n)
+
+    def _indices(self, n: int, seed: int) -> np.ndarray:
+        rng = np.random.Generator(np.random.PCG64(seed))
+        return rng.choice(n, size=self._k(n), replace=False)
+
+    def encode(self, x: np.ndarray, seed: int = 0) -> np.ndarray:
+        xf = np.ascontiguousarray(x, np.float32)
+        n = xf.size
+        k = self._k(n)
+        vals = xf[self._indices(n, seed)]
+        if self.scale:
+            vals = vals * np.float32(n / k)
+        return vals.astype(np.float32).view(np.uint8).ravel()
+
+    def decode(self, buf: np.ndarray, n: int, seed: int = 0) -> np.ndarray:
+        buf = np.ascontiguousarray(buf)
+        vals = buf.view(np.float32)
+        dense = np.zeros(n, np.float32)
+        dense[self._indices(n, seed)] = vals
+        return dense
+
+    def store_elems(self, n: int) -> int:
+        return self._k(n)
+
+    def wire_bytes(self, n: int) -> int:
+        return self._k(n) * 4
+
+
+class DitherWire(WireCodec):
+    """[u8 flags][u8 s][u16 0][f32 norm][n i8 levels] — stochastic
+    quantization; flags bit0 = natural (powers-of-two) levels, bit1 =
+    max-norm. Level mapping matches DitheringCompressor and codec.cc."""
+
+    codec_id = WIRE_DITHER
+
+    def __init__(self, s: int = 127, partition: str = "linear",
+                 normalize: str = "l2"):
+        self.s = int(s)
+        self.natural = partition == "natural"
+        self.maxnorm = normalize == "max"
+
+    @property
+    def _flags(self) -> int:
+        return (_DITHER_NATURAL if self.natural else 0) | (
+            _DITHER_MAXNORM if self.maxnorm else 0
+        )
+
+    def encode(self, x: np.ndarray, seed: int = 0) -> np.ndarray:
+        xf = np.ascontiguousarray(x, np.float32)
+        n = xf.size
+        s = self.s
+        norm = np.float32(
+            np.max(np.abs(xf)) if self.maxnorm
+            else np.sqrt(np.sum(xf.astype(np.float64) ** 2))
+        ) if n else np.float32(0)
+        safe = norm if norm > 0 else np.float32(1)
+        p = np.abs(xf) / safe
+        u = np.random.Generator(np.random.PCG64(seed)).random(
+            n, dtype=np.float32
+        )
+        if not self.natural:
+            y = np.minimum(p, 1.0) * s
+            lo = np.floor(y)
+            level = lo + (u < (y - lo))
+        else:
+            tiny = np.float32(2.0 ** (-(s - 1)))
+            pc = np.clip(p, tiny, 1.0)
+            e = np.floor(np.log2(pc))
+            base = np.exp2(e)
+            frac = pc / base - 1.0
+            q = base * np.where(u < frac, 2.0, 1.0)
+            level = np.rint(np.log2(q)) + (s - 1) + 1
+            level = np.minimum(level, s)
+            below = p < tiny
+            level = np.where(
+                below, np.where(u < p / tiny, 1.0, 0.0), level
+            )
+        levels = (np.where(np.signbit(xf), -level, level)).astype(np.int8)
+        out = np.empty(8 + n, np.uint8)
+        out[0] = self._flags
+        out[1] = s
+        out[2:4] = 0
+        out[4:8] = np.frombuffer(np.float32(norm).tobytes(), np.uint8)
+        out[8:] = levels.view(np.uint8)
+        return out
+
+    def decode(self, buf: np.ndarray, n: int, seed: int = 0) -> np.ndarray:
+        buf = np.ascontiguousarray(buf)
+        flags = int(buf[0])
+        s = int(buf[1])
+        norm = buf[4:8].view(np.float32)[0]
+        lv = buf[8:8 + n].view(np.int8).astype(np.float32)
+        mag = np.abs(lv)
+        if flags & _DITHER_NATURAL:
+            p = np.where(mag > 0, np.exp2(mag - 1 - (s - 1)), 0.0)
+        else:
+            p = mag / s
+        return (np.sign(lv) * p * norm).astype(np.float32)
+
+    def wire_bytes(self, n: int) -> int:
+        return 8 + n
+
+
+@dataclasses.dataclass
+class WirePlan:
+    """How one tensor travels the DCN: push codec + pull codec (two-way
+    compression re-compresses the pull direction, reference server
+    behavior; one-way pulls raw fp32). For store-compacted codecs
+    (randomk), the "raw" pull is already the compact positional sum and is
+    decoded by the codec regardless of two_way."""
+
+    codec: WireCodec
+    two_way: bool
+
+    @property
+    def compacted(self) -> bool:
+        # store_elems < n ⇒ the raw store itself is the compressed form
+        return type(self.codec).store_elems is not WireCodec.store_elems
+
+    @property
+    def pull_codec_id(self) -> int:
+        return (
+            self.codec.codec_id
+            if (self.two_way and not self.compacted)
+            else WIRE_RAW
+        )
+
+    def pull_capacity(self, n: int) -> int:
+        store = self.codec.store_elems(n)
+        return max(store * 4, self.codec.wire_bytes(n) if self.two_way else 0)
+
+    def decode_pull(self, buf: np.ndarray, n: int, seed: int) -> np.ndarray:
+        if self.compacted or self.two_way:
+            return self.codec.decode(buf, n, seed)
+        return np.ascontiguousarray(buf[: n * 4]).view(np.float32).copy()
+
+
+def make_wire_codec(spec: CompressionSpec) -> Optional[WireCodec]:
+    """Map a resolved CompressionSpec to its DCN wire codec (None = raw)."""
+    c = spec.compressor
+    name = c.name
+    if name == "identity":
+        return None
+    if name == "onebit":
+        return OnebitWire(scaling=getattr(c, "scaling", True))
+    if name == "topk":
+        return TopkWire(k=getattr(c, "k", 0.01))
+    if name == "randomk":
+        return RandomkWire(
+            k=getattr(c, "k", 0.01), scale=getattr(c, "scale", True)
+        )
+    if name == "dithering":
+        return DitherWire(
+            s=getattr(c, "s", 127),
+            partition=getattr(c, "partition", "linear"),
+            normalize=getattr(c, "normalize", "l2"),
+        )
+    if name == "fp16":
+        return Fp16Wire()
+    raise ValueError(f"no DCN wire codec for compressor '{name}'")

@@ -112,12 +112,14 @@ def init(
     tracer = get_tracer()
     if cfg.is_distributed:
         # Hybrid two-tier pipeline (reference root-GPU queue list,
-        # operations.cc GetPushQueueList: REDUCE → COPYD2H → PUSH → PULL →
-        # COPYH2D; BROADCAST is implicit — the H2D value is the replicated
-        # result). Intra-pod reduction rides ICI; only this controller
-        # pushes the pod-sum per partition over DCN to the summation
-        # servers, which is what makes the hybrid topology
-        # bandwidth-optimal (SURVEY §5.8).
+        # operations.cc GetPushQueueList: REDUCE → COPYD2H → COMPRESS →
+        # PUSH → PULL → DECOMPRESS → COPYH2D; BROADCAST is implicit — the
+        # H2D value is the replicated result). Intra-pod reduction rides
+        # ICI uncompressed (the reference's NCCL tier is uncompressed too);
+        # compression applies to the DCN wire, where the summation servers
+        # decompress→fp32-sum→recompress (SURVEY §2.2/§3.3). Only this
+        # controller pushes the pod-sum per partition, which is what makes
+        # the hybrid topology bandwidth-optimal (SURVEY §5.8).
         from byteps_tpu.server import PSWorker
 
         _state.psworker = PSWorker()
@@ -125,8 +127,10 @@ def init(
             stages=[
                 Stage("REDUCE", _reduce_stage, pool_size=1),
                 Stage("COPYD2H", _d2h_stage, pool_size=2),
+                Stage("COMPRESS", _compress_stage, pool_size=2),
                 Stage("PUSH", _dcn_push_stage, credited=True, pool_size=4),
                 Stage("PULL", _dcn_pull_stage, pool_size=4),
+                Stage("DECOMPRESS", _decompress_stage, pool_size=2),
                 Stage("COPYH2D", _h2d_stage, pool_size=2),
             ],
             credit=cfg.scheduling_credit,
@@ -310,21 +314,93 @@ def _d2h_stage(task: PartitionTask):
     return np.asarray(task.payload, dtype=np.float32)
 
 
+def _wire_seed(task: PartitionTask) -> int:
+    """Deterministic per (tensor, version, partition) seed shared by the
+    COMPRESS and DECOMPRESS stages on every pod — the reference's
+    synchronized compressor PRNG (randomk index agreement, dithering)."""
+    import zlib
+
+    base = zlib.crc32(task.name.encode()) & 0xFFFFFFFF
+    spec = task.context["spec"]
+    return (
+        base * 1000003
+        + task.context["version"] * 8191
+        + task.partition.part_idx
+        + spec.seed
+    ) % (2**63)
+
+
+def _compress_stage(task: PartitionTask):
+    """Host-side momentum → error-feedback → wire encode (reference
+    COMPRESS stage, core_loops.cc RunCompressLoopOnce; the decorator order
+    matches the reference's momentum/EF wrappers around the compressor)."""
+    p = task.partition
+    plan = task.context["plans"][p.part_idx]
+    x = task.payload  # np fp32 pod-sum
+    if plan is None:
+        return x.view(np.uint8).ravel()
+    spec = task.context["spec"]
+    seed = _wire_seed(task)
+    skey = (task.name, p.part_idx)
+    if spec.momentum:
+        m = _state.mom_state.get(skey)
+        if m is None:
+            m = np.zeros_like(x)
+        m_new = spec.mu * m + x
+        x = x + spec.mu * m_new
+        _state.mom_state[skey] = m_new
+    if spec.ef:
+        e = _state.ef_state.get(skey)
+        if e is None:
+            e = np.zeros_like(x)
+        corrected = x + e
+        payload = plan.codec.encode(corrected, seed)
+        approx = plan.codec.decode(payload, x.size, seed)
+        _state.ef_state[skey] = corrected - approx
+        return payload
+    return plan.codec.encode(x, seed)
+
+
 def _dcn_push_stage(task: PartitionTask):
     p = task.partition
+    plan = task.context["plans"][p.part_idx]
+    store_bytes = (
+        plan.codec.store_elems(p.length) * 4 if plan is not None
+        else p.length * 4
+    )
     with _state.lock:
         needs_init = p.key not in _state.inited_keys
         if needs_init:
             _state.inited_keys.add(p.key)
     if needs_init:
-        _state.psworker.init_key(p.key, p.length * 4)
-    version = _state.psworker.push(p.key, task.payload)
+        _state.psworker.init_key(p.key, store_bytes)
+    codec_id = plan.codec.codec_id if plan is not None else 0
+    version = _state.psworker.push_bytes(p.key, task.payload, codec_id)
     return version
 
 
 def _dcn_pull_stage(task: PartitionTask):
     p = task.partition
-    return _state.psworker.pull(p.key, p.length, task.payload)
+    plan = task.context["plans"][p.part_idx]
+    if plan is None:
+        return _state.psworker.pull_bytes(
+            p.key, p.length * 4, task.payload, 0
+        )
+    return _state.psworker.pull_bytes(
+        p.key, plan.pull_capacity(p.length), task.payload,
+        plan.pull_codec_id,
+    )
+
+
+def _decompress_stage(task: PartitionTask):
+    """Wire decode of the pulled round result (reference DECOMPRESS stage)."""
+    p = task.partition
+    plan = task.context["plans"][p.part_idx]
+    buf = task.payload
+    if plan is None:
+        return np.ascontiguousarray(buf).view(np.float32).copy()
+    return plan.decode_pull(np.ascontiguousarray(buf), p.length,
+                            _wire_seed(task))
 
 
 def _h2d_stage(task: PartitionTask):
@@ -388,17 +464,36 @@ def push_pull_async(
             )
             push_pull_async._warned_anon_state = True  # type: ignore[attr-defined]
         spec = _dc.replace(spec, ef=False, momentum=False)
-    if spec.enabled and _state.cfg.is_distributed:
-        # the DCN wire is fp32-only for now (the C++ summation service has
-        # no decompress engine yet); ICI-tier compression still applies in
-        # single-pod mode
-        if not getattr(push_pull_async, "_warned_dcn_comp", False):
-            log.warning("compression is not yet supported on the hybrid "
-                        "DCN path — sending fp32")
-            push_pull_async._warned_dcn_comp = True  # type: ignore[attr-defined]
-        spec = from_params(None)
+    plans = None
+    if _state.cfg.is_distributed:
+        # Hybrid mode compresses the DCN wire per partition (the server
+        # decompresses, fp32-sums, recompresses). Partitions below
+        # BYTEPS_MIN_COMPRESS_BYTES ride raw fp32 — tiny chunks expand
+        # under onebit's word floor and aren't worth the codec time.
+        from byteps_tpu.compression.wire import WirePlan, make_wire_codec
+
+        codec = None
+        if spec.enabled:
+            try:
+                codec = make_wire_codec(spec)
+            except ValueError:
+                # custom registry compressors without a DCN byte format
+                # degrade to fp32 on the wire instead of crashing the job
+                if not getattr(push_pull_async, "_warned_nowire", False):
+                    log.warning(
+                        "compressor '%s' has no DCN wire codec — hybrid "
+                        "pushes for it ride fp32", spec.compressor.name,
+                    )
+                    push_pull_async._warned_nowire = True  # type: ignore[attr-defined]
+        plans = [
+            None
+            if codec is None
+            or p.length * 4 < _state.cfg.min_compress_bytes
+            else WirePlan(codec, spec.two_way)
+            for p in ctx.partitions
+        ]
     # Skip compression for tiny tensors (reference: BYTEPS_MIN_COMPRESS_BYTES)
-    if spec.enabled and L * np.dtype(x.dtype).itemsize < _state.cfg.min_compress_bytes:
+    elif spec.enabled and L * np.dtype(x.dtype).itemsize < _state.cfg.min_compress_bytes:
         spec = from_params(None)
     x2d = x.reshape(n, L)
     handle = Handle(name, len(ctx.partitions))
@@ -408,6 +503,8 @@ def push_pull_async(
         "x2d": x2d,
         "spec": spec,
         "average": average,
+        "version": version,
+        "plans": plans,
         "rng": _tensor_rng(name, version, spec.seed),
     }
     tasks = []

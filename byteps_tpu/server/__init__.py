@@ -11,6 +11,11 @@ Partition keys are assigned to servers by ``key % num_server`` — the
 reference's key→server hash placement. There is no separate scheduler
 process: ``jax.distributed`` (or the launcher) does rendezvous, which is the
 TPU-native simplification of ps-lite's scheduler node (SURVEY §5.8).
+
+Pushes and pulls carry a wire-codec id (``compression/wire.py`` formats):
+the server decompresses each push into an fp32 accumulator and re-compresses
+round results for compressed pulls — the reference server's
+decompress→sum→recompress engine (SURVEY §2.2/§3.3).
 """
 
 from __future__ import annotations
@@ -22,7 +27,12 @@ import numpy as np
 
 from byteps_tpu.common.config import Config, get_config
 from byteps_tpu.common.logging import get_logger
-from byteps_tpu.server.native import NativeClient, load_lib, reduce_sum_f32
+from byteps_tpu.server.native import (
+    WIRE_RAW,
+    NativeClient,
+    load_lib,
+    reduce_sum_f32,
+)
 
 log = get_logger("server")
 
@@ -38,14 +48,22 @@ def server_addresses(cfg: Optional[Config] = None) -> List[Tuple[str, int]]:
     return [(cfg.ps_root_uri, cfg.ps_root_port + 1 + i) for i in range(num)]
 
 
+# server_id of the summation service running in THIS process, if any —
+# lets PSWorker route that server's keys through the in-process fast path
+# (BYTEPS_ENABLE_IPC) instead of TCP loopback.
+_INPROC_SERVER_ID: Optional[int] = None
+
+
 def start_server(
     port: Optional[int] = None,
     num_workers: Optional[int] = None,
     engine_threads: Optional[int] = None,
     async_mode: Optional[bool] = None,
     server_id: int = 0,
+    pull_timeout_ms: Optional[int] = None,
 ) -> int:
     """Start the native summation service in this process (non-blocking)."""
+    global _INPROC_SERVER_ID
     cfg = get_config()
     lib = load_lib()
     port = port if port is not None else cfg.ps_root_port + 1 + server_id
@@ -56,15 +74,28 @@ def start_server(
         else cfg.server_engine_threads,
         1 if (async_mode if async_mode is not None else cfg.enable_async)
         else 0,
+        pull_timeout_ms if pull_timeout_ms is not None
+        else cfg.pull_timeout_ms,
+        server_id,
     )
     if rc != 0:
         raise RuntimeError(f"bps_server_start failed (rc={rc}, port={port})")
+    _INPROC_SERVER_ID = server_id
+    if cfg.trace_on:
+        lib.bps_server_trace_enable(1)
     log.info("summation server listening on :%d", port)
     return port
 
 
 def stop_server() -> None:
+    global _INPROC_SERVER_ID
     load_lib().bps_server_stop()
+    _INPROC_SERVER_ID = None
+
+
+def dump_server_trace(path: str) -> int:
+    """Write the server's chrome trace JSON; returns event count."""
+    return load_lib().bps_server_trace_dump(path.encode())
 
 
 def serve_forever(server_id: Optional[int] = None) -> None:
@@ -72,37 +103,63 @@ def serve_forever(server_id: Optional[int] = None) -> None:
     shut down (reference: ``import byteps.server`` → ``StartPS`` blocks)."""
     import os
 
+    cfg = get_config()
     sid = (
         server_id if server_id is not None
         else int(os.environ.get("DMLC_SERVER_ID", "0"))
     )
     start_server(server_id=sid)
     load_lib().bps_server_wait()
+    if cfg.trace_on:
+        os.makedirs(cfg.trace_dir, exist_ok=True)
+        path = os.path.join(cfg.trace_dir, f"trace_server{sid}.json")
+        n = dump_server_trace(path)
+        log.info("dumped %d server trace events to %s", n, path)
     log.info("summation server stopped")
 
 
 class PSWorker:
     """Worker-side facade: key→server placement, per-key round tracking,
-    connection-per-thread for pipelined push/pull.
+    connection-per-thread for pipelined push/pull, wire-byte accounting.
 
     Each OS thread (one per scheduler pool slot) gets its own serial
     connection to each server, so a pull blocked on a slow round never
     stalls another partition's push — the deadlock-freedom argument of the
     reference's separate PUSH/PULL core loops.
+
+    With ``BYTEPS_ENABLE_IPC`` and a summation server running in THIS
+    process (joint role), pushes/pulls for locally-owned keys skip TCP and
+    access the store directly (the reference's colocated shared-memory
+    fast path, ps-lite ``BYTEPS_ENABLE_IPC``).
     """
 
     def __init__(
         self,
         servers: Optional[Sequence[Tuple[str, int]]] = None,
         timeout_ms: int = 60000,
+        recv_timeout_ms: int = 120000,
+        worker_id: Optional[int] = None,
+        use_ipc: Optional[bool] = None,
     ):
+        cfg = get_config()
         self._servers = list(servers) if servers else server_addresses()
         self._timeout = timeout_ms
+        self._recv_timeout = recv_timeout_ms
+        self._worker_id = (
+            worker_id if worker_id is not None else cfg.worker_id
+        )
         self._tls = threading.local()
         self._versions: Dict[int, int] = {}
         self._vlock = threading.Lock()
         self._all_conns: List[NativeClient] = []
         self._conn_lock = threading.Lock()
+        self._closed = False
+        # wire accounting (compression tests / docs assert against these)
+        self.bytes_pushed = 0
+        self.bytes_pulled = 0
+        self._ipc = (
+            use_ipc if use_ipc is not None else cfg.enable_ipc
+        ) and _INPROC_SERVER_ID is not None
 
     # -- connection management ----------------------------------------------
     def _conn(self, sidx: int) -> NativeClient:
@@ -112,8 +169,10 @@ class PSWorker:
             self._tls.conns = pool
         c = pool.get(sidx)
         if c is None:
+            if self._closed:
+                raise RuntimeError("PSWorker is shut down")
             host, port = self._servers[sidx]
-            c = NativeClient(host, port, self._timeout)
+            c = NativeClient(host, port, self._timeout, self._recv_timeout)
             pool[sidx] = c
             with self._conn_lock:
                 self._all_conns.append(c)
@@ -122,24 +181,67 @@ class PSWorker:
     def server_for(self, key: int) -> int:
         return key % len(self._servers)
 
+    def _is_local(self, sidx: int) -> bool:
+        return self._ipc and sidx == _INPROC_SERVER_ID
+
     # -- data plane ---------------------------------------------------------
     def init_key(self, key: int, nbytes: int) -> None:
-        self._conn(self.server_for(key)).init_key(key, nbytes)
+        sidx = self.server_for(key)
+        if self._is_local(sidx):
+            rc = load_lib().bps_local_init(key, nbytes)
+            if rc != 0:
+                raise RuntimeError(f"local init failed (rc={rc})")
+            return
+        self._conn(sidx).init_key(key, nbytes)
 
-    def push(self, key: int, data: np.ndarray) -> int:
-        """Push this worker's fp32 partition; returns the round number the
-        matching pull must wait for."""
-        data = np.ascontiguousarray(data, dtype=np.float32)
+    def push_bytes(self, key: int, buf: np.ndarray,
+                   codec: int = WIRE_RAW) -> int:
+        """Push codec-encoded bytes; returns the round number the matching
+        pull must wait for."""
         with self._vlock:
             version = self._versions.get(key, 0) + 1
             self._versions[key] = version
-        self._conn(self.server_for(key)).push(key, data)
+        sidx = self.server_for(key)
+        if self._is_local(sidx):
+            b = np.ascontiguousarray(buf)
+            rc = load_lib().bps_local_push(
+                self._worker_id, key, codec,
+                b.ctypes.data, b.nbytes,
+            )
+            if rc != 0:
+                raise RuntimeError(f"local push failed (rc={rc})")
+        else:
+            self._conn(sidx).push(key, buf, codec, self._worker_id)
+        with self._vlock:
+            self.bytes_pushed += int(np.asarray(buf).nbytes)
         return version
 
+    def pull_bytes(self, key: int, capacity: int, version: int,
+                   codec: int = WIRE_RAW) -> np.ndarray:
+        """Pull the round result as codec-encoded bytes."""
+        out = np.empty(capacity, np.uint8)
+        sidx = self.server_for(key)
+        if self._is_local(sidx):
+            got = load_lib().bps_local_pull(
+                key, codec, version, self._recv_timeout,
+                out.ctypes.data, out.nbytes,
+            )
+            if got < 0:
+                raise RuntimeError(f"local pull failed (rc={got})")
+        else:
+            got = self._conn(sidx).pull(key, out, version, codec)
+        with self._vlock:
+            self.bytes_pulled += int(got)
+        return out[:got]
+
+    def push(self, key: int, data: np.ndarray) -> int:
+        """Push this worker's fp32 partition (raw wire)."""
+        data = np.ascontiguousarray(data, dtype=np.float32)
+        return self.push_bytes(key, data.view(np.uint8).ravel(), WIRE_RAW)
+
     def pull(self, key: int, nelems: int, version: int) -> np.ndarray:
-        out = np.empty(nelems, np.float32)
-        self._conn(self.server_for(key)).pull(key, out, version)
-        return out
+        buf = self.pull_bytes(key, nelems * 4, version, WIRE_RAW)
+        return buf.view(np.float32).copy()
 
     def push_pull(self, key: int, data: np.ndarray) -> np.ndarray:
         v = self.push(key, data)
@@ -150,28 +252,44 @@ class PSWorker:
         Postoffice::Barrier via the scheduler)."""
         self._conn(0).barrier()
 
+    def ping(self, sidx: int = 0) -> Tuple[int, int]:
+        """(server CLOCK_REALTIME ns, rtt ns) for clock alignment of merged
+        worker/server traces (SURVEY §5.1 dPRO clock-offset capability)."""
+        return self._conn(sidx).ping()
+
+    def clock_offset_ns(self, sidx: int = 0) -> int:
+        """Estimated server_clock − local_clock in ns (RTT/2 method)."""
+        import time
+
+        server_ns, rtt = self.ping(sidx)
+        return server_ns + rtt // 2 - time.time_ns()
+
     def shutdown(self) -> None:
         """Tell every server this worker is done (server exits once all
         workers said so), then drop connections."""
-        done = set()
+        if self._closed:
+            return
+        self._closed = True
+        # one shutdown per server (not per connection): servers count
+        # shutdowns against DMLC_NUM_WORKER. Use this thread's pool
+        # (creating connections as needed), then close EVERY connection
+        # ever created — snapshot taken after the shutdown round so none
+        # created during it escape.
+        pool = getattr(self._tls, "conns", {})
+        for sidx in range(len(self._servers)):
+            try:
+                c = pool.get(sidx)
+                if c is None:
+                    host, port = self._servers[sidx]
+                    c = NativeClient(host, port, 2000, self._recv_timeout)
+                    with self._conn_lock:
+                        self._all_conns.append(c)
+                c.shutdown()
+            except Exception:  # noqa: BLE001 - server may already be gone
+                pass
         with self._conn_lock:
             conns = list(self._all_conns)
             self._all_conns.clear()
-        # one shutdown per server (not per connection): servers count
-        # shutdowns against DMLC_NUM_WORKER
-        for sidx in range(len(self._servers)):
-            try:
-                self._conn(sidx)  # ensure a conn exists on this thread
-            except ConnectionError:
-                continue
-        pool = getattr(self._tls, "conns", {})
-        for sidx, c in pool.items():
-            if sidx not in done:
-                try:
-                    c.shutdown()
-                    done.add(sidx)
-                except Exception:  # noqa: BLE001
-                    pass
         for c in conns:
             c.close()
         self._tls.conns = {}

@@ -2,6 +2,8 @@
 // Reference analog: the extern "C" block of byteps/common/operations.h plus
 // byteps/server's StartPS entry.
 #include <cstdint>
+#include <cstring>
+#include <vector>
 
 #include "client.h"
 #include "server.h"
@@ -9,18 +11,49 @@
 extern "C" {
 
 int bps_server_start(uint16_t port, int num_workers, int engine_threads,
-                     int async_mode) {
-  return bps::StartServer(port, num_workers, engine_threads,
-                          async_mode != 0);
+                     int async_mode, int pull_timeout_ms, int server_id) {
+  return bps::StartServer(port, num_workers, engine_threads, async_mode != 0,
+                          pull_timeout_ms, server_id);
 }
 
 void bps_server_wait() { bps::WaitServer(); }
 
 void bps_server_stop() { bps::StopServer(); }
 
-void* bps_client_connect(const char* host, uint16_t port, int timeout_ms) {
+void bps_server_trace_enable(int on) { bps::ServerTraceEnable(on != 0); }
+
+int bps_server_trace_dump(const char* path) {
+  return bps::ServerTraceDump(path);
+}
+
+// ---- in-process (IPC) fast path -------------------------------------------
+int bps_local_init(uint64_t key, uint64_t nbytes) {
+  return bps::LocalInit(key, nbytes);
+}
+
+int bps_local_push(uint16_t worker, uint64_t key, uint8_t codec,
+                   const void* buf, uint64_t nbytes) {
+  return bps::LocalPush(worker, key, codec,
+                        static_cast<const char*>(buf), nbytes);
+}
+
+// Fills out (capacity cap); returns actual bytes >= 0, or negative error
+// (-4 timeout, -5 buffer too small, -10 no server in this process).
+int64_t bps_local_pull(uint64_t key, uint8_t codec, uint64_t version,
+                       int timeout_ms, void* out, uint64_t cap) {
+  std::vector<char> blob;
+  int rc = bps::LocalPull(key, codec, version, timeout_ms, &blob);
+  if (rc != 0) return rc;
+  if (blob.size() > cap) return -5;
+  std::memcpy(out, blob.data(), blob.size());
+  return static_cast<int64_t>(blob.size());
+}
+
+// ---- TCP client -----------------------------------------------------------
+void* bps_client_connect(const char* host, uint16_t port, int timeout_ms,
+                         int recv_timeout_ms) {
   auto* c = new bps::Client();
-  if (c->Connect(host, port, timeout_ms) != 0) {
+  if (c->Connect(host, port, timeout_ms, recv_timeout_ms) != 0) {
     delete c;
     return nullptr;
   }
@@ -32,13 +65,15 @@ int bps_client_init_key(void* client, uint64_t key, uint64_t nbytes) {
 }
 
 int bps_client_push(void* client, uint64_t key, const void* data,
-                    uint64_t nbytes) {
-  return static_cast<bps::Client*>(client)->Push(key, data, nbytes);
+                    uint64_t nbytes, uint8_t codec, uint16_t worker_id) {
+  return static_cast<bps::Client*>(client)->Push(key, data, nbytes, codec,
+                                                 worker_id);
 }
 
 int bps_client_pull(void* client, uint64_t key, void* data, uint64_t nbytes,
-                    uint64_t version) {
-  return static_cast<bps::Client*>(client)->Pull(key, data, nbytes, version);
+                    uint64_t version, uint8_t codec, uint64_t* out_bytes) {
+  return static_cast<bps::Client*>(client)->Pull(key, data, nbytes, version,
+                                                 codec, out_bytes);
 }
 
 int bps_client_barrier(void* client) {
@@ -47,6 +82,14 @@ int bps_client_barrier(void* client) {
 
 int bps_client_shutdown(void* client) {
   return static_cast<bps::Client*>(client)->Shutdown();
+}
+
+int bps_client_ping(void* client, int64_t* server_ns, int64_t* rtt_ns) {
+  return static_cast<bps::Client*>(client)->Ping(server_ns, rtt_ns);
+}
+
+const char* bps_client_last_error(void* client) {
+  return static_cast<bps::Client*>(client)->last_error();
 }
 
 void bps_client_free(void* client) {

@@ -29,19 +29,28 @@ int ConnectOnce(const std::string& host, uint16_t port) {
   ::freeaddrinfo(res);
   return fd;
 }
+
+int64_t steady_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
 }  // namespace
 
 Client::~Client() {
   if (fd_ >= 0) ::close(fd_);
 }
 
-int Client::Connect(const std::string& host, uint16_t port, int timeout_ms) {
+int Client::Connect(const std::string& host, uint16_t port, int timeout_ms,
+                    int recv_timeout_ms) {
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
   for (;;) {
     int fd = ConnectOnce(host, port);
     if (fd >= 0) {
       set_nodelay(fd);
+      set_bufsizes(fd);
+      set_recv_timeout(fd, recv_timeout_ms);
       fd_ = fd;
       return 0;
     }
@@ -50,59 +59,89 @@ int Client::Connect(const std::string& host, uint16_t port, int timeout_ms) {
   }
 }
 
-// Serial request → response. Returns 0 ok, negative on transport error,
-// positive on server kErr.
-static int Roundtrip(int fd, Cmd cmd, uint64_t key, uint64_t version,
-                     const void* out, uint32_t out_len, void* in,
-                     uint64_t in_len) {
-  if (!send_frame(fd, cmd, key, version, out, out_len)) return -2;
+// Serial request → response. Negative on transport error, positive on
+// server kErr (message in last_err), 0 ok. `in`/`in_cap` receive a kResp
+// payload; *got gets the actual size. kAck payloads are drained; a
+// too-large kResp is drained too, keeping the stream framed (-5).
+int Client::Roundtrip(Cmd cmd, uint64_t key, uint64_t version,
+                      const void* req, uint32_t req_len, void* in,
+                      uint64_t in_cap, uint64_t* got, uint8_t flags,
+                      uint16_t reserved, uint64_t* resp_version) {
+  if (!send_frame(fd_, cmd, key, version, req, req_len, flags, reserved)) {
+    return -2;
+  }
   FrameHeader h;
-  if (!recv_all(fd, &h, sizeof(h))) return -3;
+  if (!recv_all(fd_, &h, sizeof(h))) {
+    return (errno == EAGAIN || errno == EWOULDBLOCK) ? -7 : -3;
+  }
   if (h.magic != kMagic) return -4;
   if (h.cmd == kErr) {
     std::vector<char> msg(h.len);
-    recv_all(fd, msg.data(), h.len);
+    if (h.len > 0 && !recv_all(fd_, msg.data(), h.len)) return -3;
+    last_err_.assign(msg.begin(), msg.end());
     return 1;
   }
+  if (resp_version != nullptr) *resp_version = h.version;
   if (h.cmd == kResp) {
-    if (h.len != in_len || in == nullptr) return -5;
-    if (!recv_all(fd, in, h.len)) return -6;
+    if (in == nullptr || h.len > in_cap) {
+      if (!drain_bytes(fd_, h.len)) return -3;
+      return -5;
+    }
+    if (h.len > 0 && !recv_all(fd_, in, h.len)) {
+      return (errno == EAGAIN || errno == EWOULDBLOCK) ? -7 : -3;
+    }
+    if (got != nullptr) *got = h.len;
     return 0;
   }
   // kAck
-  if (h.len > 0) {
-    std::vector<char> skip(h.len);
-    if (!recv_all(fd, skip.data(), h.len)) return -6;
-  }
+  if (h.len > 0 && !drain_bytes(fd_, h.len)) return -3;
   return 0;
 }
 
 int Client::InitKey(uint64_t key, uint64_t nbytes) {
   std::lock_guard<std::mutex> lk(mu_);
   // nbytes rides the version field (payload-free frame)
-  return Roundtrip(fd_, kInit, key, nbytes, nullptr, 0, nullptr, 0);
+  return Roundtrip(kInit, key, nbytes, nullptr, 0, nullptr, 0, nullptr,
+                   0, 0, nullptr);
 }
 
-int Client::Push(uint64_t key, const void* data, uint64_t nbytes) {
+int Client::Push(uint64_t key, const void* data, uint64_t nbytes,
+                 uint8_t codec, uint16_t worker_id) {
   std::lock_guard<std::mutex> lk(mu_);
-  return Roundtrip(fd_, kPush, key, 0, data,
-                   static_cast<uint32_t>(nbytes), nullptr, 0);
+  return Roundtrip(kPush, key, 0, data, static_cast<uint32_t>(nbytes),
+                   nullptr, 0, nullptr, codec, worker_id, nullptr);
 }
 
-int Client::Pull(uint64_t key, void* data, uint64_t nbytes,
-                 uint64_t version) {
+int Client::Pull(uint64_t key, void* data, uint64_t nbytes, uint64_t version,
+                 uint8_t codec, uint64_t* out_bytes) {
   std::lock_guard<std::mutex> lk(mu_);
-  return Roundtrip(fd_, kPull, key, version, nullptr, 0, data, nbytes);
+  return Roundtrip(kPull, key, version, nullptr, 0, data, nbytes,
+                   out_bytes, codec, 0, nullptr);
 }
 
 int Client::Barrier() {
   std::lock_guard<std::mutex> lk(mu_);
-  return Roundtrip(fd_, kBarrier, 0, 0, nullptr, 0, nullptr, 0);
+  return Roundtrip(kBarrier, 0, 0, nullptr, 0, nullptr, 0, nullptr, 0,
+                   0, nullptr);
 }
 
 int Client::Shutdown() {
   std::lock_guard<std::mutex> lk(mu_);
-  return Roundtrip(fd_, kShutdown, 0, 0, nullptr, 0, nullptr, 0);
+  return Roundtrip(kShutdown, 0, 0, nullptr, 0, nullptr, 0, nullptr, 0,
+                   0, nullptr);
+}
+
+int Client::Ping(int64_t* server_ns, int64_t* rtt_ns) {
+  std::lock_guard<std::mutex> lk(mu_);
+  const int64_t t0 = steady_ns();
+  uint64_t sv = 0;
+  int rc = Roundtrip(kPing, 0, 0, nullptr, 0, nullptr, 0, nullptr, 0,
+                     0, &sv);
+  if (rc == 0) {
+    if (server_ns != nullptr) *server_ns = static_cast<int64_t>(sv);
+    if (rtt_ns != nullptr) *rtt_ns = steady_ns() - t0;
+  }
+  return rc;
 }
 
 }  // namespace bps

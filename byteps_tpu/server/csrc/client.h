@@ -3,11 +3,18 @@
 // needs. One Client = one TCP connection with strictly serial
 // request/response (parallelism = several Client instances, one per
 // scheduler pool thread, mirroring ps-lite's per-thread customers).
+//
+// Return codes: 0 ok; >0 server kErr (message via last_error());
+// -2 send failed; -3 recv failed/closed; -4 bad magic; -5 response larger
+// than the caller's buffer (stream drained, still framed); -7 receive
+// timeout (dead/stalled server).
 #pragma once
 
 #include <cstdint>
 #include <mutex>
 #include <string>
+
+#include "common.h"
 
 namespace bps {
 
@@ -16,18 +23,33 @@ class Client {
   ~Client();
   // Retries until the server accepts or timeout_ms elapses (workers may
   // start before servers; ps-lite's scheduler rendezvous absorbs this in
-  // the reference).
-  int Connect(const std::string& host, uint16_t port, int timeout_ms);
+  // the reference). recv_timeout_ms > 0 arms SO_RCVTIMEO so a pull against
+  // a dead server errors instead of blocking a scheduler thread forever.
+  int Connect(const std::string& host, uint16_t port, int timeout_ms,
+              int recv_timeout_ms);
   int InitKey(uint64_t key, uint64_t nbytes);
-  int Push(uint64_t key, const void* data, uint64_t nbytes);
-  // Blocks until the server completed round `version` for this key.
-  int Pull(uint64_t key, void* data, uint64_t nbytes, uint64_t version);
+  // Push `nbytes` of codec-encoded payload as `worker_id`.
+  int Push(uint64_t key, const void* data, uint64_t nbytes, uint8_t codec,
+           uint16_t worker_id);
+  // Blocks until the server completed round `version`; response encoded as
+  // `codec` is written into data (capacity `nbytes`); *out_bytes = actual.
+  int Pull(uint64_t key, void* data, uint64_t nbytes, uint64_t version,
+           uint8_t codec, uint64_t* out_bytes);
   int Barrier();
   int Shutdown();
+  // Clock-offset probe: *server_ns = server CLOCK_REALTIME at serve time,
+  // *rtt_ns = local round-trip (offset ≈ server_ns + rtt/2 − local_now).
+  int Ping(int64_t* server_ns, int64_t* rtt_ns);
+  const char* last_error() const { return last_err_.c_str(); }
 
  private:
+  int Roundtrip(Cmd cmd, uint64_t key, uint64_t version, const void* req,
+                uint32_t req_len, void* in, uint64_t in_cap, uint64_t* got,
+                uint8_t flags, uint16_t reserved, uint64_t* resp_version);
+
   int fd_ = -1;
   std::mutex mu_;
+  std::string last_err_;
 };
 
 }  // namespace bps

@@ -7,6 +7,13 @@
 // Frame layout (32 bytes header):
 //   u32 magic 'BPS1'  | u8 cmd | u8 flags | u16 reserved
 //   u64 key           | u64 version       | u32 payload_len | u32 pad
+//
+// Field use per command:
+//   kInit     version = dense store bytes (payload empty)
+//   kPush     flags = codec, reserved = worker_id, payload = encoded data
+//   kPull     flags = desired response codec, version = min round
+//   kResp     flags = codec, version = round, payload = encoded result
+//   kPing     -> kAck with version = server CLOCK_REALTIME ns (clock align)
 #pragma once
 
 #include <cerrno>
@@ -24,15 +31,22 @@ namespace bps {
 
 constexpr uint32_t kMagic = 0x31535042;  // "BPS1"
 
+// Upper bound on any frame payload and on a kInit store allocation: a
+// malformed header must not drive a multi-GiB resize (the reference caps
+// implicitly via BYTEPS_PARTITION_BYTES; 256 MB is ~64x the default 4 MB
+// partition).
+constexpr uint32_t kMaxFrameLen = 256u * 1024 * 1024;
+
 enum Cmd : uint8_t {
-  kInit = 1,      // allocate store[key] of payload_len bytes (payload empty)
-  kPush = 2,      // payload = fp32 data to sum into store[key]
+  kInit = 1,      // allocate store[key] (dense bytes in `version`)
+  kPush = 2,      // payload = codec-encoded data to sum into store[key]
   kPull = 3,      // wait until store[key].version >= version, then kResp
-  kResp = 4,      // payload = fp32 result
+  kResp = 4,      // payload = codec-encoded result
   kBarrier = 5,   // block until num_workers barriers arrive
   kShutdown = 6,  // connection is done
   kAck = 7,       // empty acknowledgement
   kErr = 8,       // payload = error string
+  kPing = 9,      // clock-offset probe
 };
 
 #pragma pack(push, 1)
@@ -65,6 +79,8 @@ inline bool send_all(int fd, const void* buf, size_t n) {
   return true;
 }
 
+// Returns false on error/close; a receive timeout (SO_RCVTIMEO expiry)
+// leaves errno == EAGAIN/EWOULDBLOCK for the caller to distinguish.
 inline bool recv_all(int fd, void* buf, size_t n) {
   char* p = static_cast<char*>(buf);
   while (n > 0) {
@@ -80,10 +96,26 @@ inline bool recv_all(int fd, void* buf, size_t n) {
   return true;
 }
 
+// Read and discard n payload bytes so the stream stays framed after an
+// unexpected-length response (a desynchronized connection would misparse
+// every later header).
+inline bool drain_bytes(int fd, size_t n) {
+  char sink[4096];
+  while (n > 0) {
+    size_t chunk = n < sizeof(sink) ? n : sizeof(sink);
+    if (!recv_all(fd, sink, chunk)) return false;
+    n -= chunk;
+  }
+  return true;
+}
+
 inline bool send_frame(int fd, Cmd cmd, uint64_t key, uint64_t version,
-                       const void* payload, uint32_t len) {
+                       const void* payload, uint32_t len, uint8_t flags = 0,
+                       uint16_t reserved = 0) {
   FrameHeader h;
   h.cmd = cmd;
+  h.flags = flags;
+  h.reserved = reserved;
   h.key = key;
   h.version = version;
   h.len = len;
@@ -95,6 +127,21 @@ inline bool send_frame(int fd, Cmd cmd, uint64_t key, uint64_t version,
 inline void set_nodelay(int fd) {
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+// Large socket buffers: a 4 MB partition should stream without the default
+// ~200 KB windows throttling loopback throughput.
+inline void set_bufsizes(int fd, int bytes = 8 * 1024 * 1024) {
+  ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bytes, sizeof(bytes));
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bytes, sizeof(bytes));
+}
+
+inline void set_recv_timeout(int fd, int timeout_ms) {
+  if (timeout_ms <= 0) return;
+  timeval tv;
+  tv.tv_sec = timeout_ms / 1000;
+  tv.tv_usec = (timeout_ms % 1000) * 1000;
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
 }
 
 }  // namespace bps

@@ -1,6 +1,7 @@
 #include "server.h"
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdio>
 #include <cstring>
@@ -10,38 +11,91 @@
 #include <unordered_map>
 #include <vector>
 
+#include "codec.h"
 #include "common.h"
-#include "reducer.h"
 #include "threadpool.h"
 
 namespace bps {
 namespace {
 
+int64_t realtime_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+int64_t steady_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
 struct PendingPull {
   int fd;
   uint64_t version;  // respond when store version >= this
+  uint8_t codec;     // response encoding the worker asked for
+  int64_t enq_ms;    // steady clock, for the timeout sweep
 };
 
-// Double-buffered per-key state (reference: BytePSArray store + the
-// "all workers arrived → answer queued pulls" logic in BytePSHandler).
-// `accum` receives the in-progress round; on completion it is copied to
-// `result` and zeroed. A worker cannot start round v+2 before every worker
-// pulled round v+1 (its own pull gates it), so `result` is never
-// overwritten while still being served.
+struct DeferredPush {
+  uint16_t worker;
+  uint8_t codec;
+  std::shared_ptr<std::vector<char>> buf;
+};
+
+// Per-key state (reference: BytePSArray store + the "all workers arrived →
+// answer queued pulls" logic in BytePSHandler). `accum` receives the
+// in-progress round; on completion it is MOVED into an immutable
+// shared_ptr snapshot (`result`) and a fresh zeroed accumulator allocated,
+// so responses serialize from the snapshot OUTSIDE the key mutex — large
+// sends never stall other consumers of the key.
 struct KeyStore {
   std::mutex mu;
+  std::condition_variable cv;  // local (in-process) pulls wait here
   std::vector<float> accum;
-  std::vector<float> result;
+  std::shared_ptr<const std::vector<float>> result;
   uint64_t version = 0;
   uint32_t arrived = 0;
+  std::vector<uint8_t> pushed;         // per-worker arrival bitmap (sync)
+  std::vector<DeferredPush> deferred;  // next-round pushes that came early
+  CodecHint hint;
   std::vector<PendingPull> pending;
+  // one re-encode per (version, codec): every worker pulls the same round
+  uint64_t cache_version = 0;
+  uint8_t cache_codec = 0xFF;
+  std::shared_ptr<const std::vector<char>> cache_blob;
 };
+
+// Server-side chrome-trace stages (SURVEY §5.1 — the fork's server-side
+// timestamp capability). Timestamps are absolute CLOCK_REALTIME so worker
+// traces (which record their wall-clock origin) can be aligned.
+enum TraceStage : uint8_t {
+  kTrPushRecv = 0,
+  kTrSum = 1,
+  kTrPullResp = 2,
+  kTrRound = 3,
+};
+const char* kTraceStageName[] = {"PUSH_RECV", "SUM", "PULL_RESP", "ROUND"};
+
+struct TraceEv {
+  int64_t ts_us;
+  int32_t dur_us;
+  uint64_t key;
+  uint32_t len;
+  uint8_t stage;
+  uint8_t codec;
+};
+
+constexpr size_t kMaxTraceEvents = 1u << 21;
 
 class Server {
  public:
-  int Start(uint16_t port, int num_workers, int engine_threads, bool async) {
+  int Start(uint16_t port, int num_workers, int engine_threads, bool async,
+            int pull_timeout_ms, int server_id) {
     num_workers_ = num_workers;
     async_ = async;
+    pull_timeout_ms_ = pull_timeout_ms;
+    server_id_ = server_id;
     engine_ = std::make_unique<ThreadPool>(engine_threads);
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     if (listen_fd_ < 0) return -1;
@@ -62,6 +116,9 @@ class Server {
     }
     running_ = true;
     accept_thread_ = std::thread([this] { AcceptLoop(); });
+    if (pull_timeout_ms_ > 0) {
+      sweep_thread_ = std::thread([this] { SweepLoop(); });
+    }
     return 0;
   }
 
@@ -87,6 +144,7 @@ class Server {
         accept_thread_.get_id() != std::this_thread::get_id()) {
       accept_thread_.join();
     }
+    if (sweep_thread_.joinable()) sweep_thread_.join();
     for (auto& t : conn_threads_) {
       if (t.joinable() && t.get_id() != std::this_thread::get_id()) t.join();
     }
@@ -101,15 +159,116 @@ class Server {
       conns_.clear();
       send_mu_.clear();
     }
+    // wake any in-process pulls so joint-role callers fail fast
+    {
+      std::lock_guard<std::mutex> lk(store_mu_);
+      for (auto& [k, ks] : store_) ks->cv.notify_all();
+    }
     done_cv_.notify_all();
   }
 
+  void TraceEnable(bool on) { trace_on_ = on; }
+
+  int TraceDump(const char* path) {
+    std::vector<TraceEv> evs;
+    {
+      std::lock_guard<std::mutex> lk(trace_mu_);
+      evs = trace_;
+    }
+    FILE* f = std::fopen(path, "w");
+    if (f == nullptr) return -1;
+    // pid 10000+server_id keeps server rows apart from worker ranks when
+    // traces are merged
+    std::fprintf(f, "{\"traceEvents\":[");
+    for (size_t i = 0; i < evs.size(); ++i) {
+      const auto& e = evs[i];
+      std::fprintf(
+          f,
+          "%s{\"name\":\"key%llu\",\"cat\":\"byteps_server\",\"ph\":\"X\","
+          "\"ts\":%lld,\"dur\":%d,\"pid\":%d,\"tid\":\"%s\","
+          "\"args\":{\"key\":%llu,\"len\":%u,\"codec\":%u}}",
+          i ? "," : "", static_cast<unsigned long long>(e.key),
+          static_cast<long long>(e.ts_us), e.dur_us, 10000 + server_id_,
+          kTraceStageName[e.stage],
+          static_cast<unsigned long long>(e.key), e.len, e.codec);
+    }
+    std::fprintf(f,
+                 "],\"displayTimeUnit\":\"ms\",\"metadata\":{"
+                 "\"role\":\"server\",\"server_id\":%d,"
+                 "\"clock\":\"CLOCK_REALTIME_us\"}}",
+                 server_id_);
+    std::fclose(f);
+    return static_cast<int>(evs.size());
+  }
+
+  // ---- in-process (IPC) fast path ----------------------------------------
+  int LocalInit(uint64_t key, uint64_t nbytes) {
+    if (nbytes == 0 || nbytes > kMaxFrameLen || nbytes % 4 != 0) return -1;
+    KeyStore* ks = GetOrCreate(key, nbytes / 4);
+    return ks->accum.size() * 4 == nbytes ? 0 : -2;
+  }
+
+  int LocalPush(uint16_t worker, uint64_t key, uint8_t codec,
+                const char* buf, size_t len) {
+    KeyStore* ks = Get(key);
+    if (ks == nullptr) return -1;
+    if (!async_ && worker >= num_workers_) return -2;
+    const int64_t n = static_cast<int64_t>(ks->accum.size());
+    if (!validate_payload(codec, buf, len, n)) return -3;
+    auto owned = std::make_shared<std::vector<char>>(buf, buf + len);
+    ApplyPush(ks, key, worker, codec, std::move(owned));
+    return 0;
+  }
+
+  int LocalPull(uint64_t key, uint8_t codec, uint64_t version,
+                int timeout_ms, std::vector<char>* out) {
+    KeyStore* ks = Get(key);
+    if (ks == nullptr) return -1;
+    std::shared_ptr<const std::vector<float>> snap;
+    uint64_t v = 0;
+    {
+      std::unique_lock<std::mutex> lk(ks->mu);
+      const auto deadline = std::chrono::steady_clock::now() +
+                            std::chrono::milliseconds(timeout_ms);
+      while (running_ &&
+             !(async_ ? ks->version > 0 : ks->version >= version)) {
+        if (ks->cv.wait_until(lk, deadline) == std::cv_status::timeout) {
+          return -4;
+        }
+      }
+      if (!running_) return -5;
+      v = ks->version;
+      if (async_) {
+        snap = std::make_shared<const std::vector<float>>(ks->accum);
+      } else {
+        snap = ks->result;
+      }
+    }
+    *out = *EncodeResponse(ks, snap, v, codec);
+    return 0;
+  }
+
  private:
+  void Trace(uint8_t stage, uint64_t key, uint32_t len, uint8_t codec,
+             int64_t t0_ns) {
+    if (!trace_on_.load(std::memory_order_relaxed)) return;
+    TraceEv e;
+    e.ts_us = t0_ns / 1000;
+    e.dur_us = static_cast<int32_t>((realtime_ns() - t0_ns) / 1000);
+    e.key = key;
+    e.len = len;
+    e.stage = stage;
+    e.codec = codec;
+    std::lock_guard<std::mutex> lk(trace_mu_);
+    if (trace_.size() < kMaxTraceEvents) trace_.push_back(e);
+  }
+
   void AcceptLoop() {
     while (running_) {
       int fd = ::accept(listen_fd_, nullptr, nullptr);
       if (fd < 0) break;
       set_nodelay(fd);
+      set_bufsizes(fd);
       {
         std::lock_guard<std::mutex> lk(conn_mu_);
         conns_.push_back(fd);
@@ -120,7 +279,7 @@ class Server {
   }
 
   void SendFrame(int fd, Cmd cmd, uint64_t key, uint64_t version,
-                 const void* payload, uint32_t len) {
+                 const void* payload, uint32_t len, uint8_t flags = 0) {
     std::mutex* mu = nullptr;
     {
       std::lock_guard<std::mutex> lk(conn_mu_);
@@ -129,7 +288,12 @@ class Server {
       mu = it->second.get();
     }
     std::lock_guard<std::mutex> lk(*mu);
-    send_frame(fd, cmd, key, version, payload, len);
+    send_frame(fd, cmd, key, version, payload, len, flags);
+  }
+
+  void SendErr(int fd, uint64_t key, const char* msg) {
+    SendFrame(fd, kErr, key, 0, msg,
+              static_cast<uint32_t>(std::strlen(msg)));
   }
 
   KeyStore* GetOrCreate(uint64_t key, size_t nfloats) {
@@ -138,7 +302,9 @@ class Server {
     if (!slot) {
       slot = std::make_unique<KeyStore>();
       slot->accum.assign(nfloats, 0.f);
-      slot->result.assign(nfloats, 0.f);
+      slot->result =
+          std::make_shared<const std::vector<float>>(nfloats, 0.f);
+      slot->pushed.assign(num_workers_, 0);
     }
     return slot.get();
   }
@@ -149,66 +315,168 @@ class Server {
     return it == store_.end() ? nullptr : it->second.get();
   }
 
-  void HandlePush(int fd, uint64_t key, std::shared_ptr<std::vector<char>> buf) {
-    engine_->Submit([this, fd, key, buf] {
-      KeyStore* ks = Get(key);
-      if (ks == nullptr) {
-        SendFrame(fd, kErr, key, 0, "push before init", 16);
-        return;
-      }
-      const auto n = static_cast<int64_t>(buf->size() / sizeof(float));
-      const float* src = reinterpret_cast<const float*>(buf->data());
-      std::vector<std::pair<int, uint64_t>> ready;  // (fd, version) to answer
-      uint64_t v = 0;
-      {
-        std::lock_guard<std::mutex> lk(ks->mu);
-        if (async_) {
-          // async mode: accumulate into the served buffer immediately, no
-          // per-round barrier (reference BYTEPS_ENABLE_ASYNC)
-          reduce_sum_f32(ks->result.data(), src, n);
-          ks->version++;
-        } else {
-          reduce_sum_f32(ks->accum.data(), src, n);
-          if (++ks->arrived == static_cast<uint32_t>(num_workers_)) {
-            std::memcpy(ks->result.data(), ks->accum.data(),
-                        ks->accum.size() * sizeof(float));
-            std::memset(ks->accum.data(), 0,
-                        ks->accum.size() * sizeof(float));
-            ks->arrived = 0;
-            ks->version++;
-          }
-        }
-        v = ks->version;
-        auto it = ks->pending.begin();
-        while (it != ks->pending.end()) {
-          if (v >= it->version || async_) {
-            ready.emplace_back(it->fd, v);
-            it = ks->pending.erase(it);
-          } else {
-            ++it;
-          }
-        }
-        for (auto& [rfd, rv] : ready) {
-          SendFrame(rfd, kResp, key, rv, ks->result.data(),
-                    static_cast<uint32_t>(ks->result.size() * sizeof(float)));
-        }
-      }
-      SendFrame(fd, kAck, key, v, nullptr, 0);
-    });
-  }
+  // A pull whose round is ready, with the (version, snapshot) captured
+  // under ks->mu AT THE MOMENT the round closed — a later round closing
+  // before the response is sent must not substitute its own sum.
+  struct ReadyResp {
+    int fd;
+    uint8_t codec;
+    uint64_t version;
+    std::shared_ptr<const std::vector<float>> snap;
+  };
 
-  void HandlePull(int fd, uint64_t key, uint64_t version) {
-    KeyStore* ks = Get(key);
-    if (ks == nullptr) {
-      SendFrame(fd, kErr, key, 0, "pull before init", 16);
+  // Decode+sum one arrived push under ks->mu. A worker that pushes round
+  // v+1 before round v closed (pipelined pushes are legal — the ack no
+  // longer waits for the sum) is deferred and re-applied at round close.
+  // Pulls satisfied by a closing round are appended to `ready` with that
+  // round's snapshot.
+  void ApplyPushLocked(KeyStore* ks, uint16_t worker, uint8_t codec,
+                       std::shared_ptr<std::vector<char>> buf,
+                       std::vector<ReadyResp>* ready) {
+    const int64_t n = static_cast<int64_t>(ks->accum.size());
+    if (!async_ && ks->pushed[worker]) {
+      ks->deferred.push_back({worker, codec, std::move(buf)});
       return;
     }
-    std::lock_guard<std::mutex> lk(ks->mu);
-    if (ks->version >= version || (async_ && ks->version > 0)) {
-      SendFrame(fd, kResp, key, ks->version, ks->result.data(),
-                static_cast<uint32_t>(ks->result.size() * sizeof(float)));
-    } else {
-      ks->pending.push_back({fd, version});
+    decode_sum(codec, buf->data(), buf->size(), ks->accum.data(), n);
+    update_hint(codec, buf->data(), buf->size(), &ks->hint);
+    if (async_) {
+      ks->version++;
+      ks->cv.notify_all();
+      return;
+    }
+    ks->pushed[worker] = 1;
+    if (++ks->arrived == static_cast<uint32_t>(num_workers_)) {
+      // round complete: snapshot by MOVE, fresh zeroed accumulator
+      auto snap = std::make_shared<std::vector<float>>(std::move(ks->accum));
+      ks->accum.assign(snap->size(), 0.f);
+      ks->result = std::move(snap);
+      ks->version++;
+      ks->arrived = 0;
+      std::fill(ks->pushed.begin(), ks->pushed.end(), 0);
+      ks->cache_codec = 0xFF;
+      ks->cv.notify_all();
+      // hand this round's snapshot to the pulls it satisfies BEFORE
+      // applying deferred pushes (which may immediately close the next
+      // round and overwrite ks->result)
+      auto it = ks->pending.begin();
+      while (it != ks->pending.end()) {
+        if (ks->version >= it->version) {
+          ready->push_back({it->fd, it->codec, ks->version, ks->result});
+          it = ks->pending.erase(it);
+        } else {
+          ++it;
+        }
+      }
+      auto deferred = std::move(ks->deferred);
+      ks->deferred.clear();
+      for (auto& d : deferred) {
+        ApplyPushLocked(ks, d.worker, d.codec, std::move(d.buf), ready);
+      }
+    }
+  }
+
+  void ApplyPush(KeyStore* ks, uint64_t key, uint16_t worker, uint8_t codec,
+                 std::shared_ptr<std::vector<char>> buf) {
+    const int64_t t0 = realtime_ns();
+    const uint32_t len = static_cast<uint32_t>(buf->size());
+    std::vector<ReadyResp> ready;
+    {
+      std::lock_guard<std::mutex> lk(ks->mu);
+      ApplyPushLocked(ks, worker, codec, std::move(buf), &ready);
+      if (async_) {
+        auto it = ks->pending.begin();
+        while (it != ks->pending.end()) {
+          ready.push_back(
+              {it->fd, it->codec, ks->version,
+               std::make_shared<const std::vector<float>>(ks->accum)});
+          it = ks->pending.erase(it);
+        }
+      }
+    }
+    Trace(kTrSum, key, len, codec, t0);
+    for (auto& p : ready) {
+      // parallel fan-out: each response encodes+sends on its own engine slot
+      engine_->Submit([this, ks, key, p = std::move(p)] {
+        RespondPull(p.fd, key, ks, p.codec, p.version, p.snap);
+      });
+    }
+  }
+
+  // Encode the round result for one pull. Cached per (version, codec) so a
+  // round's W pulls cost one re-compression, not W; cache hits share the
+  // immutable blob (zero-copy into SendFrame).
+  std::shared_ptr<const std::vector<char>> EncodeResponse(
+      KeyStore* ks, const std::shared_ptr<const std::vector<float>>& snap,
+      uint64_t version, uint8_t codec) {
+    CodecHint hint;
+    {
+      std::lock_guard<std::mutex> lk(ks->mu);
+      if (!async_ && ks->cache_version == version &&
+          ks->cache_codec == codec && ks->cache_blob) {
+        return ks->cache_blob;
+      }
+      hint = ks->hint;
+    }
+    // deterministic stochastic-rounding seed per round
+    auto blob = std::make_shared<const std::vector<char>>(
+        encode(codec, snap->data(), static_cast<int64_t>(snap->size()),
+               hint, version * 0x9E3779B97F4A7C15ull + 12345));
+    if (!async_) {
+      std::lock_guard<std::mutex> lk(ks->mu);
+      ks->cache_version = version;
+      ks->cache_codec = codec;
+      ks->cache_blob = blob;
+    }
+    return blob;
+  }
+
+  void RespondPull(int fd, uint64_t key, KeyStore* ks, uint8_t codec,
+                   uint64_t version,
+                   std::shared_ptr<const std::vector<float>> snap) {
+    const int64_t t0 = realtime_ns();
+    if (codec == kCodecRaw) {
+      // zero-copy from the immutable snapshot
+      SendFrame(fd, kResp, key, version, snap->data(),
+                static_cast<uint32_t>(snap->size() * sizeof(float)),
+                kCodecRaw);
+      Trace(kTrPullResp, key,
+            static_cast<uint32_t>(snap->size() * sizeof(float)), kCodecRaw,
+            t0);
+      return;
+    }
+    auto blob = EncodeResponse(ks, snap, version, codec);
+    SendFrame(fd, kResp, key, version, blob->data(),
+              static_cast<uint32_t>(blob->size()), codec);
+    Trace(kTrPullResp, key, static_cast<uint32_t>(blob->size()), codec, t0);
+  }
+
+  void HandlePull(int fd, uint64_t key, uint64_t version, uint8_t codec) {
+    KeyStore* ks = Get(key);
+    if (ks == nullptr) {
+      SendErr(fd, key, "pull before init");
+      return;
+    }
+    bool ready;
+    uint64_t v = 0;
+    std::shared_ptr<const std::vector<float>> snap;
+    {
+      std::lock_guard<std::mutex> lk(ks->mu);
+      ready = async_ ? ks->version > 0 : ks->version >= version;
+      if (!ready) {
+        ks->pending.push_back({fd, version, codec, steady_ms()});
+      } else {
+        v = ks->version;
+        snap = async_
+                   ? std::make_shared<const std::vector<float>>(ks->accum)
+                   : ks->result;
+      }
+    }
+    if (ready) {
+      engine_->Submit([this, fd, key, ks, codec, v,
+                       snap = std::move(snap)] {
+        RespondPull(fd, key, ks, codec, v, snap);
+      });
     }
   }
 
@@ -224,28 +492,101 @@ class Server {
     for (int rfd : release) SendFrame(rfd, kAck, 0, 0, nullptr, 0);
   }
 
+  // Expire pulls stuck past the deadline: a dead worker otherwise leaves
+  // its peers blocked forever (reference failure story: ps-lite heartbeat).
+  void SweepLoop() {
+    while (running_) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(200));
+      const int64_t now = steady_ms();
+      std::vector<std::pair<uint64_t, KeyStore*>> stores;
+      {
+        std::lock_guard<std::mutex> lk(store_mu_);
+        stores.reserve(store_.size());
+        for (auto& [k, ks] : store_) stores.emplace_back(k, ks.get());
+      }
+      std::vector<std::pair<int, uint64_t>> expired;  // (fd, key)
+      for (auto& [key, ks] : stores) {
+        std::lock_guard<std::mutex> lk(ks->mu);
+        auto it = ks->pending.begin();
+        while (it != ks->pending.end()) {
+          if (now - it->enq_ms > pull_timeout_ms_) {
+            expired.emplace_back(it->fd, key);
+            it = ks->pending.erase(it);
+          } else {
+            ++it;
+          }
+        }
+      }
+      for (auto& [fd, key] : expired) {
+        SendErr(fd, key, "pull timeout: a worker likely died");
+      }
+    }
+  }
+
   void ConnLoop(int fd) {
     FrameHeader h;
     while (running_ && recv_all(fd, &h, sizeof(h))) {
-      if (h.magic != kMagic) break;
+      if (h.magic != kMagic || h.len > kMaxFrameLen) break;
+      const int64_t t_recv = realtime_ns();
       auto payload = std::make_shared<std::vector<char>>();
       if (h.len > 0) {
         payload->resize(h.len);
         if (!recv_all(fd, payload->data(), h.len)) break;
       }
       switch (h.cmd) {
-        case kInit:
-          GetOrCreate(h.key, h.version / sizeof(float));
+        case kInit: {
+          if (h.version == 0 || h.version > kMaxFrameLen ||
+              h.version % 4 != 0) {
+            SendErr(fd, h.key, "bad init size");
+            break;
+          }
+          KeyStore* ks = GetOrCreate(h.key, h.version / sizeof(float));
+          if (ks->accum.size() * sizeof(float) != h.version) {
+            // mismatched partition config across pods — fail loudly
+            // instead of letting a later push corrupt the store
+            SendErr(fd, h.key, "init size mismatch");
+          } else {
+            SendFrame(fd, kAck, h.key, 0, nullptr, 0);
+          }
+          break;
+        }
+        case kPush: {
+          KeyStore* ks = Get(h.key);
+          if (ks == nullptr) {
+            SendErr(fd, h.key, "push before init");
+            break;
+          }
+          if (!async_ && h.reserved >= num_workers_) {
+            SendErr(fd, h.key, "worker id out of range");
+            break;
+          }
+          if (!validate_payload(h.flags, payload->data(), h.len,
+                                static_cast<int64_t>(ks->accum.size()))) {
+            SendErr(fd, h.key, "payload does not match store size");
+            break;
+          }
+          // ack on receipt — the pull's version gate provides the round
+          // barrier, so the worker can pipeline its next push while the
+          // engine sums this one
           SendFrame(fd, kAck, h.key, 0, nullptr, 0);
+          Trace(kTrPushRecv, h.key, h.len, h.flags, t_recv);
+          const uint16_t worker = h.reserved;
+          const uint8_t codec = h.flags;
+          engine_->Submit([this, ks, key = h.key, worker, codec,
+                           buf = std::move(payload)]() mutable {
+            ApplyPush(ks, key, worker, codec, std::move(buf));
+          });
           break;
-        case kPush:
-          HandlePush(fd, h.key, std::move(payload));
-          break;
+        }
         case kPull:
-          HandlePull(fd, h.key, h.version);
+          HandlePull(fd, h.key, h.version, h.flags);
           break;
         case kBarrier:
           HandleBarrier(fd);
+          break;
+        case kPing:
+          SendFrame(fd, kAck, h.key,
+                    static_cast<uint64_t>(realtime_ns()), nullptr, 0);
           break;
         case kShutdown: {
           SendFrame(fd, kAck, 0, 0, nullptr, 0);
@@ -256,7 +597,7 @@ class Server {
           return;
         }
         default:
-          SendFrame(fd, kErr, h.key, 0, "bad cmd", 7);
+          SendErr(fd, h.key, "bad cmd");
           break;
       }
     }
@@ -265,10 +606,13 @@ class Server {
   int listen_fd_ = -1;
   int num_workers_ = 1;
   bool async_ = false;
+  int pull_timeout_ms_ = 0;
+  int server_id_ = 0;
   std::atomic<bool> running_{false};
   std::atomic<int> shutdown_count_{0};
   std::unique_ptr<ThreadPool> engine_;
   std::thread accept_thread_;
+  std::thread sweep_thread_;
   std::vector<std::thread> conn_threads_;
   std::vector<int> conns_;
   std::mutex conn_mu_;
@@ -280,19 +624,28 @@ class Server {
   std::mutex stop_mu_;
   std::mutex done_mu_;
   std::condition_variable done_cv_;
+  std::atomic<bool> trace_on_{false};
+  std::mutex trace_mu_;
+  std::vector<TraceEv> trace_;
 };
 
 Server* g_server = nullptr;
 std::mutex g_server_mu;
 
+Server* GetServer() {
+  std::lock_guard<std::mutex> lk(g_server_mu);
+  return g_server;
+}
+
 }  // namespace
 
 int StartServer(uint16_t port, int num_workers, int engine_threads,
-                bool async) {
+                bool async, int pull_timeout_ms, int server_id) {
   std::lock_guard<std::mutex> lk(g_server_mu);
   if (g_server != nullptr) return -10;  // already running
   auto* s = new Server();
-  int rc = s->Start(port, num_workers, engine_threads, async);
+  int rc = s->Start(port, num_workers, engine_threads, async,
+                    pull_timeout_ms, server_id);
   if (rc != 0) {
     delete s;
     return rc;
@@ -302,11 +655,7 @@ int StartServer(uint16_t port, int num_workers, int engine_threads,
 }
 
 void WaitServer() {
-  Server* s;
-  {
-    std::lock_guard<std::mutex> lk(g_server_mu);
-    s = g_server;
-  }
+  Server* s = GetServer();
   if (s != nullptr) s->Wait();
 }
 
@@ -321,6 +670,34 @@ void StopServer() {
     s->Stop();
     delete s;
   }
+}
+
+void ServerTraceEnable(bool on) {
+  Server* s = GetServer();
+  if (s != nullptr) s->TraceEnable(on);
+}
+
+int ServerTraceDump(const char* path) {
+  Server* s = GetServer();
+  return s != nullptr ? s->TraceDump(path) : -2;
+}
+
+int LocalInit(uint64_t key, uint64_t nbytes) {
+  Server* s = GetServer();
+  return s != nullptr ? s->LocalInit(key, nbytes) : -10;
+}
+
+int LocalPush(uint16_t worker, uint64_t key, uint8_t codec, const char* buf,
+              size_t len) {
+  Server* s = GetServer();
+  return s != nullptr ? s->LocalPush(worker, key, codec, buf, len) : -10;
+}
+
+int LocalPull(uint64_t key, uint8_t codec, uint64_t version, int timeout_ms,
+              std::vector<char>* out) {
+  Server* s = GetServer();
+  return s != nullptr ? s->LocalPull(key, codec, version, timeout_ms, out)
+                      : -10;
 }
 
 }  // namespace bps

@@ -1,21 +1,47 @@
 // DCN summation service — the reference's byteps/server/server.{h,cc}
 // (BytePSServer + BytePSHandler over ps::KVServer<char>) rebuilt on a plain
-// TCP van: workers INIT/PUSH/PULL fp32 partitions by u64 key; the server
-// sums pushes in fp32 on an engine thread pool and answers pulls when all
-// DMLC_NUM_WORKER workers contributed the round (sync) or immediately
-// (BYTEPS_ENABLE_ASYNC).
+// TCP van: workers INIT/PUSH/PULL codec-encoded partitions by u64 key; the
+// server decodes each push into an fp32 accumulator on an engine thread
+// pool (decompress→sum, reference server.cc push handler), and answers
+// pulls when all DMLC_NUM_WORKER workers contributed the round (sync) or
+// immediately (BYTEPS_ENABLE_ASYNC), re-encoding the result with the
+// requested codec (recompress-before-pull, SURVEY §2.2/§3.3).
 #pragma once
 
+#include <cstddef>
 #include <cstdint>
+#include <vector>
 
 namespace bps {
 
 // Returns 0 on success. num_workers: pushes per round per key; engine
-// threads: summation pool size; async: no per-round barrier.
+// threads: decode/sum pool size; async: no per-round barrier.
+// `pull_timeout_ms` > 0 expires pulls waiting past the deadline with kErr
+// (dead-worker fail-fast; reference analog: ps-lite heartbeat/resender,
+// SURVEY §5.3). `server_id` labels trace output.
 int StartServer(uint16_t port, int num_workers, int engine_threads,
-                bool async);
+                bool async, int pull_timeout_ms, int server_id);
 // Blocks until the server stops (all workers sent kShutdown, or StopServer).
 void WaitServer();
 void StopServer();
+
+// Chrome-trace collection (reference: BYTEPS_TRACE_* server-side timestamps,
+// the joapolarbear fork's defining capability). Events carry absolute
+// CLOCK_REALTIME microseconds so they merge with worker traces.
+void ServerTraceEnable(bool on);
+// Writes chrome trace JSON; returns events dumped, negative on I/O error.
+int ServerTraceDump(const char* path);
+
+// In-process (colocated) fast path — BYTEPS_ENABLE_IPC: a worker living in
+// the same process as the server (joint role) reads/writes the store
+// directly instead of looping through TCP. Round completion still answers
+// remote TCP pulls.
+int LocalInit(uint64_t key, uint64_t nbytes);
+int LocalPush(uint16_t worker, uint64_t key, uint8_t codec, const char* buf,
+              size_t len);
+// Blocks up to timeout_ms for round `version`; fills `out` with the
+// response encoded as `codec`.
+int LocalPull(uint64_t key, uint8_t codec, uint64_t version, int timeout_ms,
+              std::vector<char>* out);
 
 }  // namespace bps

@@ -12,7 +12,7 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import Optional
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -25,6 +25,13 @@ _SO = os.path.join(_CSRC, "libbyteps_tpu_server.so")
 
 _lib = None
 _lib_lock = threading.Lock()
+
+# Wire codec ids — must match csrc/codec.h Codec enum.
+WIRE_RAW = 0
+WIRE_FP16 = 1
+WIRE_ONEBIT = 2
+WIRE_TOPK = 3
+WIRE_DITHER = 4
 
 
 def _build() -> None:
@@ -45,12 +52,28 @@ def load_lib() -> ctypes.CDLL:
         lib = ctypes.CDLL(_SO)
         lib.bps_server_start.argtypes = [
             ctypes.c_uint16, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int,
         ]
         lib.bps_server_start.restype = ctypes.c_int
         lib.bps_server_wait.argtypes = []
         lib.bps_server_stop.argtypes = []
+        lib.bps_server_trace_enable.argtypes = [ctypes.c_int]
+        lib.bps_server_trace_dump.argtypes = [ctypes.c_char_p]
+        lib.bps_server_trace_dump.restype = ctypes.c_int
+        lib.bps_local_init.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+        lib.bps_local_init.restype = ctypes.c_int
+        lib.bps_local_push.argtypes = [
+            ctypes.c_uint16, ctypes.c_uint64, ctypes.c_uint8,
+            ctypes.c_void_p, ctypes.c_uint64,
+        ]
+        lib.bps_local_push.restype = ctypes.c_int
+        lib.bps_local_pull.argtypes = [
+            ctypes.c_uint64, ctypes.c_uint8, ctypes.c_uint64, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_uint64,
+        ]
+        lib.bps_local_pull.restype = ctypes.c_int64
         lib.bps_client_connect.argtypes = [
-            ctypes.c_char_p, ctypes.c_uint16, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_uint16, ctypes.c_int, ctypes.c_int,
         ]
         lib.bps_client_connect.restype = ctypes.c_void_p
         lib.bps_client_init_key.argtypes = [
@@ -59,18 +82,26 @@ def load_lib() -> ctypes.CDLL:
         lib.bps_client_init_key.restype = ctypes.c_int
         lib.bps_client_push.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
-            ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_uint8, ctypes.c_uint16,
         ]
         lib.bps_client_push.restype = ctypes.c_int
         lib.bps_client_pull.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
-            ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint8,
+            ctypes.POINTER(ctypes.c_uint64),
         ]
         lib.bps_client_pull.restype = ctypes.c_int
         lib.bps_client_barrier.argtypes = [ctypes.c_void_p]
         lib.bps_client_barrier.restype = ctypes.c_int
         lib.bps_client_shutdown.argtypes = [ctypes.c_void_p]
         lib.bps_client_shutdown.restype = ctypes.c_int
+        lib.bps_client_ping.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.bps_client_ping.restype = ctypes.c_int
+        lib.bps_client_last_error.argtypes = [ctypes.c_void_p]
+        lib.bps_client_last_error.restype = ctypes.c_char_p
         lib.bps_client_free.argtypes = [ctypes.c_void_p]
         lib.bps_reduce_sum_f32.argtypes = [
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
@@ -100,10 +131,11 @@ class NativeClient:
     thread for parallelism.
     """
 
-    def __init__(self, host: str, port: int, timeout_ms: int = 30000):
+    def __init__(self, host: str, port: int, timeout_ms: int = 30000,
+                 recv_timeout_ms: int = 120000):
         self._lib = load_lib()
         self._h: Optional[int] = self._lib.bps_client_connect(
-            host.encode(), port, timeout_ms
+            host.encode(), port, timeout_ms, recv_timeout_ms
         )
         if not self._h:
             raise ConnectionError(f"cannot reach bps server {host}:{port}")
@@ -112,26 +144,49 @@ class NativeClient:
         self._check(self._lib.bps_client_init_key(self._h, key, nbytes),
                     "init")
 
-    def push(self, key: int, data: np.ndarray) -> None:
-        assert data.dtype == np.float32 and data.flags.c_contiguous
+    def push(self, key: int, data, codec: int = WIRE_RAW,
+             worker_id: int = 0) -> None:
+        """Push codec-encoded bytes (np array of any contiguous dtype)."""
+        buf = np.ascontiguousarray(data)
+        self._require_open()
         self._check(
             self._lib.bps_client_push(
-                self._h, key, data.ctypes.data, data.nbytes
+                self._h, key, buf.ctypes.data, buf.nbytes, codec, worker_id
             ),
             "push",
         )
 
-    def pull(self, key: int, out: np.ndarray, version: int) -> None:
-        assert out.dtype == np.float32 and out.flags.c_contiguous
+    def pull(self, key: int, out: np.ndarray, version: int,
+             codec: int = WIRE_RAW) -> int:
+        """Pull into `out` (capacity buffer); returns actual bytes."""
+        assert out.flags.c_contiguous
+        self._require_open()
+        got = ctypes.c_uint64(0)
         self._check(
             self._lib.bps_client_pull(
-                self._h, key, out.ctypes.data, out.nbytes, version
+                self._h, key, out.ctypes.data, out.nbytes, version, codec,
+                ctypes.byref(got),
             ),
             "pull",
         )
+        return int(got.value)
 
     def barrier(self) -> None:
+        self._require_open()
         self._check(self._lib.bps_client_barrier(self._h), "barrier")
+
+    def ping(self) -> Tuple[int, int]:
+        """(server CLOCK_REALTIME ns, round-trip ns) — clock alignment."""
+        self._require_open()
+        sns = ctypes.c_int64(0)
+        rtt = ctypes.c_int64(0)
+        self._check(
+            self._lib.bps_client_ping(
+                self._h, ctypes.byref(sns), ctypes.byref(rtt)
+            ),
+            "ping",
+        )
+        return int(sns.value), int(rtt.value)
 
     def shutdown(self) -> None:
         if self._h:
@@ -142,7 +197,18 @@ class NativeClient:
             self._lib.bps_client_free(self._h)
             self._h = None
 
+    def _require_open(self) -> None:
+        if not self._h:
+            raise RuntimeError("NativeClient is closed")
+
     def _check(self, rc: int, op: str) -> None:
+        if rc > 0:  # server-side kErr with a message
+            msg = self._lib.bps_client_last_error(self._h) or b""
+            raise RuntimeError(f"bps {op} rejected: {msg.decode()}")
+        if rc == -7:
+            raise TimeoutError(
+                f"bps {op} receive timeout (server dead or stalled)"
+            )
         if rc != 0:
             raise RuntimeError(f"bps {op} failed (rc={rc})")
 

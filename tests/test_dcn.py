@@ -35,7 +35,7 @@ def test_reduce_sum_golden():
 
 
 def _push_pull_worker(servers, key_data, results, idx):
-    w = PSWorker(servers=servers)
+    w = PSWorker(servers=servers, worker_id=idx)
     for key, data in key_data.items():
         w.init_key(key, data.nbytes)
     w.barrier()
@@ -137,3 +137,175 @@ def test_key_sharding_across_servers():
     finally:
         if proc.poll() is None:
             proc.kill()
+
+
+# ---- wire codecs: numpy <-> C++ server interop ------------------------------
+def _serve(port, num_workers=1, **kw):
+    start_server(port=port, num_workers=num_workers, engine_threads=2,
+                 async_mode=False, **kw)
+    return [("127.0.0.1", port)]
+
+
+def test_wire_codecs_roundtrip():
+    from byteps_tpu.compression import wire
+
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal(257).astype(np.float32)
+    # raw / fp16
+    raw = wire.WireCodec()
+    np.testing.assert_array_equal(raw.decode(raw.encode(x), x.size), x)
+    f16 = wire.Fp16Wire()
+    np.testing.assert_allclose(
+        f16.decode(f16.encode(x), x.size), x, rtol=1e-3, atol=1e-3)
+    # onebit: decode = ±mean|x|
+    ob = wire.OnebitWire(scaling=True)
+    dec = ob.decode(ob.encode(x), x.size)
+    np.testing.assert_allclose(np.abs(dec), np.mean(np.abs(x)), rtol=1e-6)
+    np.testing.assert_array_equal(np.sign(dec), np.where(x >= 0, 1, -1))
+    assert ob.encode(x).nbytes == 4 + 4 * ((x.size + 31) // 32)
+    # topk: k largest magnitudes survive
+    tk = wire.TopkWire(k=10)
+    dec = tk.decode(tk.encode(x), x.size)
+    kept = np.nonzero(dec)[0]
+    assert kept.size == 10
+    top = np.argsort(np.abs(x))[-10:]
+    assert set(kept) == set(top)
+    # randomk: same seed -> same support; values survive (scaled n/k)
+    rk = wire.RandomkWire(k=16, scale=False)
+    payload = rk.encode(x, seed=42)
+    assert payload.nbytes == 16 * 4
+    dec = rk.decode(payload, x.size, seed=42)
+    assert np.count_nonzero(dec) <= 16
+    nz = np.nonzero(dec)[0]
+    np.testing.assert_allclose(dec[nz], x[nz], rtol=1e-6)
+    # dithering linear: unbiased-ish, magnitude bounded by norm
+    dw = wire.DitherWire(s=127, partition="linear", normalize="l2")
+    dec = dw.decode(dw.encode(x, seed=7), x.size)
+    assert np.corrcoef(dec, x)[0, 1] > 0.99
+    # dithering natural
+    dn = wire.DitherWire(s=16, partition="natural", normalize="max")
+    dec = dn.decode(dn.encode(x, seed=8), x.size)
+    assert np.corrcoef(dec, x)[0, 1] > 0.9
+
+
+def test_server_decompress_sum_and_recompress_onebit():
+    from byteps_tpu.compression import wire
+
+    port = BASE_PORT + 6
+    servers = _serve(port, num_workers=2)
+    ob = wire.OnebitWire(scaling=True)
+    rng = np.random.default_rng(4)
+    n = 100
+    xs = [rng.standard_normal(n).astype(np.float32) for _ in range(2)]
+    ws = [PSWorker(servers=servers, worker_id=i) for i in range(2)]
+    for w in ws:
+        w.init_key(0, n * 4)
+    vs = [w.push_bytes(0, ob.encode(x), wire.WIRE_ONEBIT)
+          for w, x in zip(ws, xs)]
+    # expected fp32 store: sum of decompressed pushes
+    want = sum(ob.decode(ob.encode(x), n) for x in xs)
+    # raw pull sees the dense fp32 sum
+    raw = ws[0].pull_bytes(0, n * 4, vs[0], wire.WIRE_RAW)
+    np.testing.assert_allclose(raw.view(np.float32), want, rtol=1e-5)
+    # compressed pull = server-side recompress of that sum
+    blob = ws[1].pull_bytes(0, ob.wire_bytes(n), vs[1], wire.WIRE_ONEBIT)
+    dec = ob.decode(blob, n)
+    np.testing.assert_allclose(
+        np.abs(dec), np.mean(np.abs(want)), rtol=1e-5)
+    np.testing.assert_array_equal(np.sign(dec), np.where(want >= 0, 1, -1))
+    # wire accounting: compressed push is ~32x smaller than fp32
+    assert ws[0].bytes_pushed == 4 + 4 * ((n + 31) // 32)
+    for w in ws:
+        w.shutdown()
+
+
+def test_server_topk_and_fp16_sum():
+    from byteps_tpu.compression import wire
+
+    port = BASE_PORT + 7
+    servers = _serve(port, num_workers=2)
+    n = 64
+    a = np.zeros(n, np.float32); a[3] = 5.0; a[10] = -2.0
+    b = np.zeros(n, np.float32); b[3] = 1.0; b[20] = 7.0
+    tk = wire.TopkWire(k=2)
+    ws = [PSWorker(servers=servers, worker_id=i) for i in range(2)]
+    for w in ws:
+        w.init_key(1, n * 4)
+        w.init_key(2, n * 4)
+    v0 = ws[0].push_bytes(1, tk.encode(a), wire.WIRE_TOPK)
+    ws[1].push_bytes(1, tk.encode(b), wire.WIRE_TOPK)
+    got = ws[0].pull_bytes(1, n * 4, v0, wire.WIRE_RAW).view(np.float32)
+    want = np.zeros(n, np.float32)
+    want[3], want[10], want[20] = 6.0, -2.0, 7.0
+    np.testing.assert_allclose(got, want)
+    # fp16 push, fp16 response
+    f16 = wire.Fp16Wire()
+    v0 = ws[0].push_bytes(2, f16.encode(a), wire.WIRE_FP16)
+    ws[1].push_bytes(2, f16.encode(b), wire.WIRE_FP16)
+    blob = ws[0].pull_bytes(2, n * 2, v0, wire.WIRE_FP16)
+    np.testing.assert_allclose(f16.decode(blob, n), want, rtol=1e-3)
+    for w in ws:
+        w.shutdown()
+
+
+def test_init_size_mismatch_rejected():
+    port = BASE_PORT + 8
+    servers = _serve(port)
+    w = PSWorker(servers=servers)
+    w.init_key(5, 64)
+    with pytest.raises(RuntimeError, match="init size mismatch"):
+        w.init_key(5, 128)  # different partitioning => loud error
+    stop_server()
+
+
+def test_push_payload_size_validated():
+    port = BASE_PORT + 9
+    servers = _serve(port)
+    w = PSWorker(servers=servers)
+    w.init_key(6, 64)  # 16 floats
+    with pytest.raises(RuntimeError, match="does not match store"):
+        w.push(6, np.ones(32, np.float32))  # twice the store size
+    stop_server()
+
+
+def test_pull_timeout_fails_fast_when_worker_dies():
+    port = BASE_PORT + 10
+    # 2 workers expected; only one shows up -> its pull must error out
+    # within the server's pull deadline instead of hanging forever
+    servers = _serve(port, num_workers=2, pull_timeout_ms=800)
+    w = PSWorker(servers=servers, worker_id=0)
+    x = np.ones(8, np.float32)
+    w.init_key(3, x.nbytes)
+    v = w.push(3, x)
+    import time
+    t0 = time.time()
+    with pytest.raises(RuntimeError, match="pull timeout"):
+        w.pull(3, 8, v)
+    assert time.time() - t0 < 10
+    stop_server()
+
+
+def test_ping_clock_offset():
+    port = BASE_PORT + 11
+    servers = _serve(port)
+    w = PSWorker(servers=servers)
+    server_ns, rtt = w.ping(0)
+    assert rtt >= 0
+    # same host, same clock: offset within a second
+    assert abs(w.clock_offset_ns(0)) < 1e9
+    stop_server()
+
+
+def test_ipc_local_fast_path():
+    from byteps_tpu.server import _INPROC_SERVER_ID  # noqa: F401
+
+    port = BASE_PORT + 12
+    _serve(port, num_workers=1)
+    w = PSWorker(servers=[("127.0.0.1", port)], use_ipc=True)
+    x = np.arange(32, dtype=np.float32)
+    w.init_key(4, x.nbytes)
+    out = w.push_pull(4, x)
+    np.testing.assert_allclose(out, x)
+    # the data plane never opened a TCP connection
+    assert not w._all_conns
+    stop_server()

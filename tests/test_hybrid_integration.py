@@ -50,3 +50,49 @@ def test_two_pods_hybrid_push_pull():
         for p in workers + [server]:
             if p.poll() is None:
                 p.kill()
+
+
+def test_two_pods_hybrid_compressed_wire():
+    """Onebit (+EF), randomk, fp16 across 2 pods through the native server:
+    COMPRESS/PUSH/PULL/DECOMPRESS stages with wire-byte accounting asserted
+    (reference: server decompress→fp32-sum→recompress, SURVEY §2.2/§3.3)."""
+    env_base = {
+        **os.environ,
+        "BPS_REPO": REPO,
+        "PYTHONPATH": REPO,
+        "DMLC_NUM_WORKER": "2",
+        "DMLC_NUM_SERVER": "1",
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(PORT + 10),
+        "BYTEPS_PARTITION_BYTES": "65536",
+        "BYTEPS_MIN_COMPRESS_BYTES": "0",
+        "BPS_TEST_COMPRESSED": "1",
+    }
+    server = subprocess.Popen(
+        [sys.executable, "-m", "byteps_tpu.launcher"],
+        env={**env_base, "DMLC_ROLE": "server", "JAX_PLATFORMS": "cpu"},
+        cwd=REPO,
+    )
+    workers = []
+    try:
+        for wid in range(2):
+            workers.append(subprocess.Popen(
+                [sys.executable, HELPER],
+                env={**env_base, "DMLC_ROLE": "worker",
+                     "DMLC_WORKER_ID": str(wid)},
+                cwd=REPO, stdout=subprocess.PIPE, text=True,
+            ))
+        outs = []
+        for w in workers:
+            out, _ = w.communicate(timeout=180)
+            outs.append(out)
+            assert w.returncode == 0, out
+        combined = "".join(outs)
+        assert "HYBRID_WORKER_0_OK" in combined
+        assert "HYBRID_WORKER_1_OK" in combined
+        server.wait(timeout=30)
+        assert server.returncode == 0
+    finally:
+        for p in workers + [server]:
+            if p.poll() is None:
+                p.kill()
