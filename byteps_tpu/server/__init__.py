@@ -108,8 +108,13 @@ def serve_forever(server_id: Optional[int] = None) -> None:
         server_id if server_id is not None
         else int(os.environ.get("DMLC_SERVER_ID", "0"))
     )
+    global _INPROC_SERVER_ID
     start_server(server_id=sid)
     load_lib().bps_server_wait()
+    # the native server stopped (worker-driven shutdown); make sure no
+    # later PSWorker(use_ipc=True) in this process routes into its leaked
+    # store (the native Local* entries also refuse once stopped)
+    _INPROC_SERVER_ID = None
     if cfg.trace_on:
         os.makedirs(cfg.trace_dir, exist_ok=True)
         path = os.path.join(cfg.trace_dir, f"trace_server{sid}.json")
@@ -168,6 +173,12 @@ class PSWorker:
             pool = {}
             self._tls.conns = pool
         c = pool.get(sidx)
+        if c is not None and c.is_dead():
+            # a timeout/desync killed the socket (native side closes it so
+            # no stale frame can be misread); evict so this thread's next
+            # op reconnects instead of failing rc=-2 forever
+            self._evict(sidx, c)
+            c = None
         if c is None:
             if self._closed:
                 raise RuntimeError("PSWorker is shut down")
@@ -177,6 +188,17 @@ class PSWorker:
             with self._conn_lock:
                 self._all_conns.append(c)
         return c
+
+    def _evict(self, sidx: int, c: NativeClient) -> None:
+        pool = getattr(self._tls, "conns", {})
+        if pool.get(sidx) is c:
+            del pool[sidx]
+        with self._conn_lock:
+            try:
+                self._all_conns.remove(c)
+            except ValueError:
+                pass
+        c.close()
 
     def server_for(self, key: int) -> int:
         return key % len(self._servers)
@@ -279,6 +301,10 @@ class PSWorker:
         for sidx in range(len(self._servers)):
             try:
                 c = pool.get(sidx)
+                if c is not None and c.is_dead():
+                    c = None  # killed socket cannot carry the kShutdown —
+                    # send it on a fresh connection or the server's
+                    # shutdown count never completes and serve_forever hangs
                 if c is None:
                     host, port = self._servers[sidx]
                     c = NativeClient(host, port, 2000, self._recv_timeout)

@@ -92,6 +92,10 @@ const char* bps_client_last_error(void* client) {
   return static_cast<bps::Client*>(client)->last_error();
 }
 
+int bps_client_is_dead(void* client) {
+  return static_cast<bps::Client*>(client)->dead() ? 1 : 0;
+}
+
 void bps_client_free(void* client) {
   delete static_cast<bps::Client*>(client);
 }

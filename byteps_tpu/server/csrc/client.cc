@@ -59,42 +59,76 @@ int Client::Connect(const std::string& host, uint16_t port, int timeout_ms,
   }
 }
 
+void Client::Kill() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
 // Serial request → response. Negative on transport error, positive on
 // server kErr (message in last_err), 0 ok. `in`/`in_cap` receive a kResp
 // payload; *got gets the actual size. kAck payloads are drained; a
-// too-large kResp is drained too, keeping the stream framed (-5).
+// too-large kResp is drained too, keeping the stream framed (-5). Every
+// server response echoes the request key, which is verified here — a
+// mismatch means the stream carries a stale frame (e.g. a late response
+// after a timeout) and the connection is closed rather than trusted.
 int Client::Roundtrip(Cmd cmd, uint64_t key, uint64_t version,
                       const void* req, uint32_t req_len, void* in,
                       uint64_t in_cap, uint64_t* got, uint8_t flags,
                       uint16_t reserved, uint64_t* resp_version) {
+  if (fd_ < 0) return -2;
   if (!send_frame(fd_, cmd, key, version, req, req_len, flags, reserved)) {
+    Kill();
     return -2;
   }
   FrameHeader h;
   if (!recv_all(fd_, &h, sizeof(h))) {
-    return (errno == EAGAIN || errno == EWOULDBLOCK) ? -7 : -3;
+    int rc = (errno == EAGAIN || errno == EWOULDBLOCK) ? -7 : -3;
+    Kill();
+    return rc;
   }
-  if (h.magic != kMagic) return -4;
+  if (h.magic != kMagic) {
+    Kill();
+    return -4;
+  }
+  if (h.key != key) {
+    // stale frame from a previous (timed-out) request, or a server bug —
+    // either way the stream can no longer be trusted
+    Kill();
+    return -6;
+  }
   if (h.cmd == kErr) {
     std::vector<char> msg(h.len);
-    if (h.len > 0 && !recv_all(fd_, msg.data(), h.len)) return -3;
+    if (h.len > 0 && !recv_all(fd_, msg.data(), h.len)) {
+      Kill();
+      return -3;
+    }
     last_err_.assign(msg.begin(), msg.end());
     return 1;
   }
   if (resp_version != nullptr) *resp_version = h.version;
   if (h.cmd == kResp) {
     if (in == nullptr || h.len > in_cap) {
-      if (!drain_bytes(fd_, h.len)) return -3;
+      if (!drain_bytes(fd_, h.len)) {
+        Kill();
+        return -3;
+      }
       return -5;
     }
     if (h.len > 0 && !recv_all(fd_, in, h.len)) {
-      return (errno == EAGAIN || errno == EWOULDBLOCK) ? -7 : -3;
+      int rc = (errno == EAGAIN || errno == EWOULDBLOCK) ? -7 : -3;
+      Kill();
+      return rc;
     }
     if (got != nullptr) *got = h.len;
     return 0;
   }
   // kAck
-  if (h.len > 0 && !drain_bytes(fd_, h.len)) return -3;
+  if (h.len > 0 && !drain_bytes(fd_, h.len)) {
+    Kill();
+    return -3;
+  }
   return 0;
 }
 

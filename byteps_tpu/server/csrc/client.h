@@ -5,9 +5,16 @@
 // scheduler pool thread, mirroring ps-lite's per-thread customers).
 //
 // Return codes: 0 ok; >0 server kErr (message via last_error());
-// -2 send failed; -3 recv failed/closed; -4 bad magic; -5 response larger
-// than the caller's buffer (stream drained, still framed); -7 receive
-// timeout (dead/stalled server).
+// -2 send failed / connection dead; -3 recv failed/closed; -4 bad magic;
+// -5 response larger than the caller's buffer (stream drained, still
+// framed); -6 response key does not match the request (desynchronized
+// stream); -7 receive timeout (dead/stalled server).
+//
+// Any error that can leave bytes of a late/foreign frame in the stream
+// (-3/-4/-6/-7) closes the connection: a timed-out response would
+// otherwise be consumed by the NEXT request on this client and silently
+// return another round's (or key's) data. Subsequent calls fail fast
+// with -2; the owner reconnects or reports.
 #pragma once
 
 #include <cstdint>
@@ -41,11 +48,17 @@ class Client {
   // *rtt_ns = local round-trip (offset ≈ server_ns + rtt/2 − local_now).
   int Ping(int64_t* server_ns, int64_t* rtt_ns);
   const char* last_error() const { return last_err_.c_str(); }
+  // True once a desynchronizing error closed the socket; the owner should
+  // drop this client and connect a fresh one.
+  bool dead() const { return fd_ < 0; }
 
  private:
   int Roundtrip(Cmd cmd, uint64_t key, uint64_t version, const void* req,
                 uint32_t req_len, void* in, uint64_t in_cap, uint64_t* got,
                 uint8_t flags, uint16_t reserved, uint64_t* resp_version);
+  // Close the socket after a stream-desynchronizing error; later calls
+  // return -2 instead of misparsing stale frames.
+  void Kill();
 
   int fd_ = -1;
   std::mutex mu_;

@@ -5,6 +5,8 @@
 #include <condition_variable>
 #include <cstdio>
 #include <cstring>
+#include <deque>
+#include <functional>
 #include <memory>
 #include <mutex>
 #include <thread>
@@ -30,8 +32,36 @@ int64_t steady_ms() {
       .count();
 }
 
+// Ordered executor over the shared engine pool, one per (key, worker).
+// A worker's pushes for one key are applied in RECEIVE order: two
+// pipelined pushes (rounds v and v+1) submitted to an unordered pool could
+// otherwise swap, crediting v+1's payload to round v and corrupting both
+// sums. Keyed by (key, worker) — NOT by connection — so the ordering
+// survives a client reconnect (a timed-out socket is killed client-side
+// and the next push arrives on a fresh connection, but must still land
+// after the old connection's queued push). Different keys and different
+// workers fan out across the pool in parallel.
+struct Strand {
+  std::mutex mu;
+  std::deque<std::function<void()>> q;
+  bool running = false;
+};
+
+// Per-connection state. shared_ptr-owned by the conn thread, pending
+// pulls, barrier waiters, and in-flight responses, so a response racing a
+// disconnect can never touch a freed mutex or a recycled fd number: the
+// `closed` flag (guarded by send_mu) gates every write, and the fd is only
+// closed under that same lock.
+struct Conn {
+  uint64_t id = 0;
+  int fd = -1;
+  std::mutex send_mu;  // serializes frame writes; also guards `closed`
+  bool closed = false;
+};
+using ConnPtr = std::shared_ptr<Conn>;
+
 struct PendingPull {
-  int fd;
+  ConnPtr conn;
   uint64_t version;  // respond when store version >= this
   uint8_t codec;     // response encoding the worker asked for
   int64_t enq_ms;    // steady clock, for the timeout sweep
@@ -58,12 +88,20 @@ struct KeyStore {
   uint32_t arrived = 0;
   std::vector<uint8_t> pushed;         // per-worker arrival bitmap (sync)
   std::vector<DeferredPush> deferred;  // next-round pushes that came early
-  CodecHint hint;
+  CodecHint hint;         // evolves with every push (current open round)
+  CodecHint result_hint;  // frozen copy of `hint` when `result`'s round
+                          // closed — responses for that round encode with
+                          // THIS, so a next-round push changing topk k or
+                          // dithering params cannot retro-change the wire
+                          // format of a round already being served
   std::vector<PendingPull> pending;
   // one re-encode per (version, codec): every worker pulls the same round
   uint64_t cache_version = 0;
   uint8_t cache_codec = 0xFF;
   std::shared_ptr<const std::vector<char>> cache_blob;
+  // per-worker push-ordering strands (see Strand)
+  std::mutex strands_mu;
+  std::unordered_map<uint16_t, std::shared_ptr<Strand>> strands;
 };
 
 // Server-side chrome-trace stages (SURVEY §5.1 — the fork's server-side
@@ -130,34 +168,40 @@ class Server {
   void Stop() {
     // serialize concurrent stops (worker-initiated auto-stop can race an
     // explicit StopServer); the loser blocks until teardown completes so
-    // the caller may safely delete the server afterwards
+    // the caller may safely retire the server afterwards
     std::lock_guard<std::mutex> stop_lk(stop_mu_);
     bool was = running_.exchange(false);
     if (!was) return;
     if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
     if (listen_fd_ >= 0) ::close(listen_fd_);
     {
+      // SHUT_RDWR (without close) unblocks every conn thread's recv AND
+      // any engine thread blocked in a send to a stopped reader. No
+      // send_mu here — a sender stuck in send_all() holds send_mu, and
+      // only this shutdown can unblock it (lock-free is safe: a conn
+      // still in the map has not run its teardown, whose erase-then-close
+      // sequence is ordered by conn_mu_, so the fd is still open).
       std::lock_guard<std::mutex> lk(conn_mu_);
-      for (int fd : conns_) ::shutdown(fd, SHUT_RDWR);
+      for (auto& [id, c] : conns_) ::shutdown(c->fd, SHUT_RDWR);
     }
     if (accept_thread_.joinable() &&
         accept_thread_.get_id() != std::this_thread::get_id()) {
       accept_thread_.join();
     }
     if (sweep_thread_.joinable()) sweep_thread_.join();
-    for (auto& t : conn_threads_) {
-      if (t.joinable() && t.get_id() != std::this_thread::get_id()) t.join();
+    {
+      // conn threads are detached (a long-running server must not accrete
+      // one joinable std::thread per reconnect); wait on the live count
+      std::unique_lock<std::mutex> lk(threads_mu_);
+      threads_cv_.wait(lk, [this] { return live_conn_threads_ == 0; });
     }
-    conn_threads_.clear();
     if (engine_) engine_->Stop();
     {
-      // close only after every conn thread exited — closing earlier would
-      // let the kernel reuse the fd number (e.g. for a Python socket in
-      // this process) while a stale shutdown() could still target it
+      // conn threads closed their own fds on exit; this sweeps any that
+      // never reached their cleanup (shouldn't happen, but harmless)
       std::lock_guard<std::mutex> lk(conn_mu_);
-      for (int fd : conns_) ::close(fd);
+      for (auto& [id, c] : conns_) CloseConn(c);
       conns_.clear();
-      send_mu_.clear();
     }
     // wake any in-process pulls so joint-role callers fail fast
     {
@@ -201,8 +245,14 @@ class Server {
     return static_cast<int>(evs.size());
   }
 
+  bool IsRunning() const { return running_.load(); }
+
   // ---- in-process (IPC) fast path ----------------------------------------
+  // Every entry checks running_: after a worker-driven shutdown stopped
+  // the server, a later joint-role PSWorker must fail loudly instead of
+  // silently reading/writing the stopped server's leaked store.
   int LocalInit(uint64_t key, uint64_t nbytes) {
+    if (!running_) return -10;
     if (nbytes == 0 || nbytes > kMaxFrameLen || nbytes % 4 != 0) return -1;
     KeyStore* ks = GetOrCreate(key, nbytes / 4);
     return ks->accum.size() * 4 == nbytes ? 0 : -2;
@@ -210,6 +260,7 @@ class Server {
 
   int LocalPush(uint16_t worker, uint64_t key, uint8_t codec,
                 const char* buf, size_t len) {
+    if (!running_) return -10;
     KeyStore* ks = Get(key);
     if (ks == nullptr) return -1;
     if (!async_ && worker >= num_workers_) return -2;
@@ -222,9 +273,11 @@ class Server {
 
   int LocalPull(uint64_t key, uint8_t codec, uint64_t version,
                 int timeout_ms, std::vector<char>* out) {
+    if (!running_) return -10;
     KeyStore* ks = Get(key);
     if (ks == nullptr) return -1;
     std::shared_ptr<const std::vector<float>> snap;
+    CodecHint hint;
     uint64_t v = 0;
     {
       std::unique_lock<std::mutex> lk(ks->mu);
@@ -240,11 +293,13 @@ class Server {
       v = ks->version;
       if (async_) {
         snap = std::make_shared<const std::vector<float>>(ks->accum);
+        hint = ks->hint;
       } else {
         snap = ks->result;
+        hint = ks->result_hint;
       }
     }
-    *out = *EncodeResponse(ks, snap, v, codec);
+    *out = *EncodeResponse(ks, snap, hint, v, codec);
     return 0;
   }
 
@@ -269,31 +324,89 @@ class Server {
       if (fd < 0) break;
       set_nodelay(fd);
       set_bufsizes(fd);
+      auto c = std::make_shared<Conn>();
+      c->fd = fd;
       {
         std::lock_guard<std::mutex> lk(conn_mu_);
-        conns_.push_back(fd);
-        send_mu_[fd] = std::make_unique<std::mutex>();
-        conn_threads_.emplace_back([this, fd] { ConnLoop(fd); });
+        c->id = next_conn_id_++;
+        conns_[c->id] = c;
+      }
+      {
+        std::lock_guard<std::mutex> lk(threads_mu_);
+        ++live_conn_threads_;
+      }
+      // detached: per-connection teardown reclaims everything (Conn, fd,
+      // live count); Stop() waits on the count, so no per-reconnect
+      // std::thread object accretes for the server's lifetime
+      std::thread([this, c] {
+        ConnLoop(c);
+        {
+          std::lock_guard<std::mutex> lk(threads_mu_);
+          --live_conn_threads_;
+        }
+        threads_cv_.notify_all();
+      }).detach();
+    }
+  }
+
+  // Mark closed and close the fd, exactly once, under send_mu so no frame
+  // write can race the close (or hit a recycled fd number).
+  static void CloseConn(const ConnPtr& c) {
+    std::lock_guard<std::mutex> lk(c->send_mu);
+    if (!c->closed) {
+      c->closed = true;
+      ::close(c->fd);
+    }
+  }
+
+  // Enqueue `fn` on the key's per-worker strand: tasks run on the engine
+  // pool but strictly in post order for that (key, worker).
+  void PostOrdered(KeyStore* ks, uint16_t worker,
+                   std::function<void()> fn) {
+    std::shared_ptr<Strand> st;
+    {
+      std::lock_guard<std::mutex> lk(ks->strands_mu);
+      auto& slot = ks->strands[worker];
+      if (!slot) slot = std::make_shared<Strand>();
+      st = slot;
+    }
+    bool start = false;
+    {
+      std::lock_guard<std::mutex> lk(st->mu);
+      st->q.push_back(std::move(fn));
+      if (!st->running) {
+        st->running = true;
+        start = true;
       }
     }
-  }
-
-  void SendFrame(int fd, Cmd cmd, uint64_t key, uint64_t version,
-                 const void* payload, uint32_t len, uint8_t flags = 0) {
-    std::mutex* mu = nullptr;
-    {
-      std::lock_guard<std::mutex> lk(conn_mu_);
-      auto it = send_mu_.find(fd);
-      if (it == send_mu_.end()) return;
-      mu = it->second.get();
+    if (start) {
+      engine_->Submit([st] {
+        for (;;) {
+          std::function<void()> task;
+          {
+            std::lock_guard<std::mutex> lk(st->mu);
+            if (st->q.empty()) {
+              st->running = false;
+              return;
+            }
+            task = std::move(st->q.front());
+            st->q.pop_front();
+          }
+          task();
+        }
+      });
     }
-    std::lock_guard<std::mutex> lk(*mu);
-    send_frame(fd, cmd, key, version, payload, len, flags);
   }
 
-  void SendErr(int fd, uint64_t key, const char* msg) {
-    SendFrame(fd, kErr, key, 0, msg,
-              static_cast<uint32_t>(std::strlen(msg)));
+  void SendFrame(const ConnPtr& c, Cmd cmd, uint64_t key, uint64_t version,
+                 const void* payload, uint32_t len, uint8_t flags = 0) {
+    std::lock_guard<std::mutex> lk(c->send_mu);
+    if (c->closed) return;  // peer went away; response is moot
+    send_frame(c->fd, cmd, key, version, payload, len, flags);
+  }
+
+  void SendErr(const ConnPtr& c, uint64_t key, const char* msg) {
+    SendFrame(c, kErr, key, 0, msg, static_cast<uint32_t>(std::strlen(msg)));
   }
 
   KeyStore* GetOrCreate(uint64_t key, size_t nfloats) {
@@ -315,14 +428,16 @@ class Server {
     return it == store_.end() ? nullptr : it->second.get();
   }
 
-  // A pull whose round is ready, with the (version, snapshot) captured
-  // under ks->mu AT THE MOMENT the round closed — a later round closing
-  // before the response is sent must not substitute its own sum.
+  // A pull whose round is ready, with the (version, snapshot, codec hint)
+  // captured under ks->mu AT THE MOMENT the round closed — a later round
+  // closing before the response is sent must not substitute its own sum
+  // or its own encoding parameters.
   struct ReadyResp {
-    int fd;
+    ConnPtr conn;
     uint8_t codec;
     uint64_t version;
     std::shared_ptr<const std::vector<float>> snap;
+    CodecHint hint;
   };
 
   // Decode+sum one arrived push under ks->mu. A worker that pushes round
@@ -347,10 +462,13 @@ class Server {
     }
     ks->pushed[worker] = 1;
     if (++ks->arrived == static_cast<uint32_t>(num_workers_)) {
-      // round complete: snapshot by MOVE, fresh zeroed accumulator
+      // round complete: snapshot by MOVE, fresh zeroed accumulator; the
+      // codec hint is frozen with the result so deferred next-round pushes
+      // below cannot change how THIS round's responses are encoded
       auto snap = std::make_shared<std::vector<float>>(std::move(ks->accum));
       ks->accum.assign(snap->size(), 0.f);
       ks->result = std::move(snap);
+      ks->result_hint = ks->hint;
       ks->version++;
       ks->arrived = 0;
       std::fill(ks->pushed.begin(), ks->pushed.end(), 0);
@@ -362,7 +480,8 @@ class Server {
       auto it = ks->pending.begin();
       while (it != ks->pending.end()) {
         if (ks->version >= it->version) {
-          ready->push_back({it->fd, it->codec, ks->version, ks->result});
+          ready->push_back({it->conn, it->codec, ks->version, ks->result,
+                            ks->result_hint});
           it = ks->pending.erase(it);
         } else {
           ++it;
@@ -388,8 +507,9 @@ class Server {
         auto it = ks->pending.begin();
         while (it != ks->pending.end()) {
           ready.push_back(
-              {it->fd, it->codec, ks->version,
-               std::make_shared<const std::vector<float>>(ks->accum)});
+              {it->conn, it->codec, ks->version,
+               std::make_shared<const std::vector<float>>(ks->accum),
+               ks->hint});
           it = ks->pending.erase(it);
         }
       }
@@ -398,25 +518,24 @@ class Server {
     for (auto& p : ready) {
       // parallel fan-out: each response encodes+sends on its own engine slot
       engine_->Submit([this, ks, key, p = std::move(p)] {
-        RespondPull(p.fd, key, ks, p.codec, p.version, p.snap);
+        RespondPull(p.conn, key, ks, p.codec, p.version, p.snap, p.hint);
       });
     }
   }
 
   // Encode the round result for one pull. Cached per (version, codec) so a
   // round's W pulls cost one re-compression, not W; cache hits share the
-  // immutable blob (zero-copy into SendFrame).
+  // immutable blob (zero-copy into SendFrame). `hint` is the codec hint
+  // snapshotted when `snap`'s round closed, NOT the live ks->hint.
   std::shared_ptr<const std::vector<char>> EncodeResponse(
       KeyStore* ks, const std::shared_ptr<const std::vector<float>>& snap,
-      uint64_t version, uint8_t codec) {
-    CodecHint hint;
+      const CodecHint& hint, uint64_t version, uint8_t codec) {
     {
       std::lock_guard<std::mutex> lk(ks->mu);
       if (!async_ && ks->cache_version == version &&
           ks->cache_codec == codec && ks->cache_blob) {
         return ks->cache_blob;
       }
-      hint = ks->hint;
     }
     // deterministic stochastic-rounding seed per round
     auto blob = std::make_shared<const std::vector<char>>(
@@ -431,13 +550,14 @@ class Server {
     return blob;
   }
 
-  void RespondPull(int fd, uint64_t key, KeyStore* ks, uint8_t codec,
-                   uint64_t version,
-                   std::shared_ptr<const std::vector<float>> snap) {
+  void RespondPull(const ConnPtr& c, uint64_t key, KeyStore* ks,
+                   uint8_t codec, uint64_t version,
+                   std::shared_ptr<const std::vector<float>> snap,
+                   const CodecHint& hint) {
     const int64_t t0 = realtime_ns();
     if (codec == kCodecRaw) {
       // zero-copy from the immutable snapshot
-      SendFrame(fd, kResp, key, version, snap->data(),
+      SendFrame(c, kResp, key, version, snap->data(),
                 static_cast<uint32_t>(snap->size() * sizeof(float)),
                 kCodecRaw);
       Trace(kTrPullResp, key,
@@ -445,51 +565,57 @@ class Server {
             t0);
       return;
     }
-    auto blob = EncodeResponse(ks, snap, version, codec);
-    SendFrame(fd, kResp, key, version, blob->data(),
+    auto blob = EncodeResponse(ks, snap, hint, version, codec);
+    SendFrame(c, kResp, key, version, blob->data(),
               static_cast<uint32_t>(blob->size()), codec);
     Trace(kTrPullResp, key, static_cast<uint32_t>(blob->size()), codec, t0);
   }
 
-  void HandlePull(int fd, uint64_t key, uint64_t version, uint8_t codec) {
+  void HandlePull(const ConnPtr& c, uint64_t key, uint64_t version,
+                  uint8_t codec) {
     KeyStore* ks = Get(key);
     if (ks == nullptr) {
-      SendErr(fd, key, "pull before init");
+      SendErr(c, key, "pull before init");
       return;
     }
     bool ready;
     uint64_t v = 0;
     std::shared_ptr<const std::vector<float>> snap;
+    CodecHint hint;
     {
       std::lock_guard<std::mutex> lk(ks->mu);
       ready = async_ ? ks->version > 0 : ks->version >= version;
       if (!ready) {
-        ks->pending.push_back({fd, version, codec, steady_ms()});
+        ks->pending.push_back({c, version, codec, steady_ms()});
       } else {
         v = ks->version;
-        snap = async_
-                   ? std::make_shared<const std::vector<float>>(ks->accum)
-                   : ks->result;
+        if (async_) {
+          snap = std::make_shared<const std::vector<float>>(ks->accum);
+          hint = ks->hint;
+        } else {
+          snap = ks->result;
+          hint = ks->result_hint;
+        }
       }
     }
     if (ready) {
-      engine_->Submit([this, fd, key, ks, codec, v,
+      engine_->Submit([this, c, key, ks, codec, v, hint,
                        snap = std::move(snap)] {
-        RespondPull(fd, key, ks, codec, v, snap);
+        RespondPull(c, key, ks, codec, v, snap, hint);
       });
     }
   }
 
-  void HandleBarrier(int fd) {
-    std::vector<int> release;
+  void HandleBarrier(const ConnPtr& c) {
+    std::vector<ConnPtr> release;
     {
       std::lock_guard<std::mutex> lk(barrier_mu_);
-      barrier_fds_.push_back(fd);
-      if (static_cast<int>(barrier_fds_.size()) == num_workers_) {
-        release.swap(barrier_fds_);
+      barrier_conns_.push_back(c);
+      if (static_cast<int>(barrier_conns_.size()) == num_workers_) {
+        release.swap(barrier_conns_);
       }
     }
-    for (int rfd : release) SendFrame(rfd, kAck, 0, 0, nullptr, 0);
+    for (auto& rc : release) SendFrame(rc, kAck, 0, 0, nullptr, 0);
   }
 
   // Expire pulls stuck past the deadline: a dead worker otherwise leaves
@@ -504,102 +630,118 @@ class Server {
         stores.reserve(store_.size());
         for (auto& [k, ks] : store_) stores.emplace_back(k, ks.get());
       }
-      std::vector<std::pair<int, uint64_t>> expired;  // (fd, key)
+      std::vector<std::pair<ConnPtr, uint64_t>> expired;  // (conn, key)
       for (auto& [key, ks] : stores) {
         std::lock_guard<std::mutex> lk(ks->mu);
         auto it = ks->pending.begin();
         while (it != ks->pending.end()) {
           if (now - it->enq_ms > pull_timeout_ms_) {
-            expired.emplace_back(it->fd, key);
+            expired.emplace_back(it->conn, key);
             it = ks->pending.erase(it);
           } else {
             ++it;
           }
         }
       }
-      for (auto& [fd, key] : expired) {
-        SendErr(fd, key, "pull timeout: a worker likely died");
+      for (auto& [c, key] : expired) {
+        SendErr(c, key, "pull timeout: a worker likely died");
       }
     }
   }
 
-  void ConnLoop(int fd) {
+  void ConnLoop(const ConnPtr& c) {
     FrameHeader h;
-    while (running_ && recv_all(fd, &h, sizeof(h))) {
+    bool stop_server_after = false;
+    while (running_ && recv_all(c->fd, &h, sizeof(h))) {
       if (h.magic != kMagic || h.len > kMaxFrameLen) break;
       const int64_t t_recv = realtime_ns();
       auto payload = std::make_shared<std::vector<char>>();
       if (h.len > 0) {
         payload->resize(h.len);
-        if (!recv_all(fd, payload->data(), h.len)) break;
+        if (!recv_all(c->fd, payload->data(), h.len)) break;
       }
+      bool done = false;
       switch (h.cmd) {
         case kInit: {
           if (h.version == 0 || h.version > kMaxFrameLen ||
               h.version % 4 != 0) {
-            SendErr(fd, h.key, "bad init size");
+            SendErr(c, h.key, "bad init size");
             break;
           }
           KeyStore* ks = GetOrCreate(h.key, h.version / sizeof(float));
           if (ks->accum.size() * sizeof(float) != h.version) {
             // mismatched partition config across pods — fail loudly
             // instead of letting a later push corrupt the store
-            SendErr(fd, h.key, "init size mismatch");
+            SendErr(c, h.key, "init size mismatch");
           } else {
-            SendFrame(fd, kAck, h.key, 0, nullptr, 0);
+            SendFrame(c, kAck, h.key, 0, nullptr, 0);
           }
           break;
         }
         case kPush: {
           KeyStore* ks = Get(h.key);
           if (ks == nullptr) {
-            SendErr(fd, h.key, "push before init");
+            SendErr(c, h.key, "push before init");
             break;
           }
           if (!async_ && h.reserved >= num_workers_) {
-            SendErr(fd, h.key, "worker id out of range");
+            SendErr(c, h.key, "worker id out of range");
             break;
           }
           if (!validate_payload(h.flags, payload->data(), h.len,
                                 static_cast<int64_t>(ks->accum.size()))) {
-            SendErr(fd, h.key, "payload does not match store size");
+            SendErr(c, h.key, "payload does not match store size");
             break;
           }
           // ack on receipt — the pull's version gate provides the round
           // barrier, so the worker can pipeline its next push while the
-          // engine sums this one
-          SendFrame(fd, kAck, h.key, 0, nullptr, 0);
+          // engine sums this one. Applications are ordered per
+          // (key, worker) strand: pipelined same-key pushes land in
+          // receive order (even across a reconnect) while distinct keys
+          // fan out across the pool.
+          SendFrame(c, kAck, h.key, 0, nullptr, 0);
           Trace(kTrPushRecv, h.key, h.len, h.flags, t_recv);
           const uint16_t worker = h.reserved;
           const uint8_t codec = h.flags;
-          engine_->Submit([this, ks, key = h.key, worker, codec,
-                           buf = std::move(payload)]() mutable {
-            ApplyPush(ks, key, worker, codec, std::move(buf));
-          });
+          PostOrdered(ks, worker,
+                      [this, ks, key = h.key, worker, codec,
+                       buf = std::move(payload)]() mutable {
+                        ApplyPush(ks, key, worker, codec, std::move(buf));
+                      });
           break;
         }
         case kPull:
-          HandlePull(fd, h.key, h.version, h.flags);
+          HandlePull(c, h.key, h.version, h.flags);
           break;
         case kBarrier:
-          HandleBarrier(fd);
+          HandleBarrier(c);
           break;
         case kPing:
-          SendFrame(fd, kAck, h.key,
+          SendFrame(c, kAck, h.key,
                     static_cast<uint64_t>(realtime_ns()), nullptr, 0);
           break;
         case kShutdown: {
-          SendFrame(fd, kAck, 0, 0, nullptr, 0);
+          SendFrame(c, kAck, 0, 0, nullptr, 0);
           int count = ++shutdown_count_;
-          if (count >= num_workers_) {
-            std::thread([this] { Stop(); }).detach();
-          }
-          return;
+          if (count >= num_workers_) stop_server_after = true;
+          done = true;
+          break;
         }
         default:
-          SendErr(fd, h.key, "bad cmd");
+          SendErr(c, h.key, "bad cmd");
           break;
       }
+      if (done) break;
+    }
+    // per-connection teardown: long-running servers with reconnecting
+    // workers must not accrete dead Conn entries or leak fds until Stop
+    {
+      std::lock_guard<std::mutex> lk(conn_mu_);
+      conns_.erase(c->id);
+    }
+    CloseConn(c);
+    if (stop_server_after) {
+      std::thread([this] { Stop(); }).detach();
     }
   }
 
@@ -613,14 +755,16 @@ class Server {
   std::unique_ptr<ThreadPool> engine_;
   std::thread accept_thread_;
   std::thread sweep_thread_;
-  std::vector<std::thread> conn_threads_;
-  std::vector<int> conns_;
+  std::mutex threads_mu_;
+  std::condition_variable threads_cv_;
+  int live_conn_threads_ = 0;  // guarded by threads_mu_
   std::mutex conn_mu_;
-  std::unordered_map<int, std::unique_ptr<std::mutex>> send_mu_;
+  uint64_t next_conn_id_ = 1;
+  std::unordered_map<uint64_t, ConnPtr> conns_;
   std::mutex store_mu_;
   std::unordered_map<uint64_t, std::unique_ptr<KeyStore>> store_;
   std::mutex barrier_mu_;
-  std::vector<int> barrier_fds_;
+  std::vector<ConnPtr> barrier_conns_;
   std::mutex stop_mu_;
   std::mutex done_mu_;
   std::condition_variable done_cv_;
@@ -630,6 +774,12 @@ class Server {
 };
 
 Server* g_server = nullptr;
+// Stopped servers are RETIRED, never deleted: a thread can still hold the
+// pointer it got from GetServer() (e.g. blocked in LocalPull's cv wait up
+// to its timeout) when a restart reclaims the singleton slot — deleting
+// would destroy mutexes/cvs under a waiter (UB). The leak is bounded by
+// the number of in-process restarts, which is ~0 outside tests.
+std::vector<Server*> g_retired;
 std::mutex g_server_mu;
 
 Server* GetServer() {
@@ -642,12 +792,19 @@ Server* GetServer() {
 int StartServer(uint16_t port, int num_workers, int engine_threads,
                 bool async, int pull_timeout_ms, int server_id) {
   std::lock_guard<std::mutex> lk(g_server_mu);
-  if (g_server != nullptr) return -10;  // already running
+  if (g_server != nullptr) {
+    if (g_server->IsRunning()) return -10;  // already running
+    // worker-driven shutdown stopped it but left the pointer; retire it so
+    // a fresh server can start in this process
+    g_server->Stop();  // idempotent; joins any remaining teardown
+    g_retired.push_back(g_server);
+    g_server = nullptr;
+  }
   auto* s = new Server();
   int rc = s->Start(port, num_workers, engine_threads, async,
                     pull_timeout_ms, server_id);
   if (rc != 0) {
-    delete s;
+    delete s;  // never published: no other thread can hold it
     return rc;
   }
   g_server = s;
@@ -668,7 +825,8 @@ void StopServer() {
   }
   if (s != nullptr) {
     s->Stop();
-    delete s;
+    std::lock_guard<std::mutex> lk(g_server_mu);
+    g_retired.push_back(s);  // see g_retired: concurrent holders may remain
   }
 }
 
@@ -679,7 +837,13 @@ void ServerTraceEnable(bool on) {
 
 int ServerTraceDump(const char* path) {
   Server* s = GetServer();
-  return s != nullptr ? s->TraceDump(path) : -2;
+  if (s == nullptr) {
+    // trace of the most recently retired server (dump-after-shutdown)
+    std::lock_guard<std::mutex> lk(g_server_mu);
+    if (g_retired.empty()) return -2;
+    s = g_retired.back();
+  }
+  return s->TraceDump(path);
 }
 
 int LocalInit(uint64_t key, uint64_t nbytes) {

@@ -102,6 +102,8 @@ def load_lib() -> ctypes.CDLL:
         lib.bps_client_ping.restype = ctypes.c_int
         lib.bps_client_last_error.argtypes = [ctypes.c_void_p]
         lib.bps_client_last_error.restype = ctypes.c_char_p
+        lib.bps_client_is_dead.argtypes = [ctypes.c_void_p]
+        lib.bps_client_is_dead.restype = ctypes.c_int
         lib.bps_client_free.argtypes = [ctypes.c_void_p]
         lib.bps_reduce_sum_f32.argtypes = [
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
@@ -134,6 +136,10 @@ class NativeClient:
     def __init__(self, host: str, port: int, timeout_ms: int = 30000,
                  recv_timeout_ms: int = 120000):
         self._lib = load_lib()
+        # serializes teardown (close/shutdown): an eviction on one thread
+        # can race PSWorker.shutdown() on another, and bps_client_free
+        # must run at most once (double delete = heap corruption)
+        self._teardown_lock = threading.Lock()
         self._h: Optional[int] = self._lib.bps_client_connect(
             host.encode(), port, timeout_ms, recv_timeout_ms
         )
@@ -188,14 +194,21 @@ class NativeClient:
         )
         return int(sns.value), int(rtt.value)
 
+    def is_dead(self) -> bool:
+        """True once a timeout/desync closed the underlying socket; the
+        owner should discard this client and connect a fresh one."""
+        return bool(self._h) and bool(self._lib.bps_client_is_dead(self._h))
+
     def shutdown(self) -> None:
-        if self._h:
-            self._lib.bps_client_shutdown(self._h)
+        with self._teardown_lock:
+            if self._h:
+                self._lib.bps_client_shutdown(self._h)
 
     def close(self) -> None:
-        if self._h:
-            self._lib.bps_client_free(self._h)
-            self._h = None
+        with self._teardown_lock:
+            h, self._h = self._h, None
+        if h:
+            self._lib.bps_client_free(h)
 
     def _require_open(self) -> None:
         if not self._h:
@@ -207,7 +220,13 @@ class NativeClient:
             raise RuntimeError(f"bps {op} rejected: {msg.decode()}")
         if rc == -7:
             raise TimeoutError(
-                f"bps {op} receive timeout (server dead or stalled)"
+                f"bps {op} receive timeout (server dead or stalled); "
+                "connection closed"
+            )
+        if rc == -6:
+            raise RuntimeError(
+                f"bps {op} response key mismatch (stale frame on a "
+                "desynchronized stream); connection closed"
             )
         if rc != 0:
             raise RuntimeError(f"bps {op} failed (rc={rc})")

@@ -285,6 +285,59 @@ def test_pull_timeout_fails_fast_when_worker_dies():
     stop_server()
 
 
+def test_connection_killed_after_recv_timeout_then_reconnects():
+    # A pull that times out at the SOCKET level (no server-side pull
+    # deadline) leaves the late response in flight; the client must close
+    # the connection so the NEXT request cannot consume the stale frame
+    # and silently return another round's data (ADVICE r2 #1). The worker
+    # then transparently reconnects on its next op.
+    port = BASE_PORT + 14
+    servers = _serve(port, num_workers=2)  # round never completes
+    w = PSWorker(servers=servers, worker_id=0, recv_timeout_ms=500)
+    x = np.ones(8, np.float32)
+    w.init_key(9, x.nbytes)
+    v = w.push(9, x)
+    with pytest.raises(TimeoutError, match="connection closed"):
+        w.pull(9, 8, v)
+    # the dead client was closed; a follow-up op reconnects (fresh socket,
+    # framed from byte 0) rather than consuming the stale response
+    dead = w._tls.conns[0]
+    assert dead.is_dead()
+    w.push(9, x)  # succeeds over a NEW connection
+    assert w._tls.conns[0] is not dead
+    stop_server()
+
+
+def test_local_path_refuses_after_worker_driven_shutdown():
+    # After all workers sent kShutdown the native server stops on a
+    # detached thread; a later in-process (IPC) worker must fail loudly
+    # instead of routing pushes into the stopped server's leaked store
+    # (ADVICE r2 #4), and a fresh start_server must reclaim the slot.
+    port = BASE_PORT + 15
+    _serve(port, num_workers=1)
+    w = PSWorker(servers=[("127.0.0.1", port)], use_ipc=True)
+    x = np.arange(8, dtype=np.float32)
+    w.init_key(11, x.nbytes)
+    np.testing.assert_allclose(w.push_pull(11, x), x)
+    w.shutdown()  # worker count reached -> server stops itself
+    import time
+
+    deadline = time.time() + 5
+    lib = load_lib()
+    while time.time() < deadline:
+        if lib.bps_local_init(12, 32) == -10:
+            break
+        time.sleep(0.05)
+    assert lib.bps_local_init(12, 32) == -10  # stopped server refuses
+    # restart in the same process reclaims the stopped singleton
+    start_server(port=port, num_workers=1, engine_threads=1,
+                 async_mode=False)
+    w2 = PSWorker(servers=[("127.0.0.1", port)], use_ipc=True)
+    w2.init_key(13, x.nbytes)
+    np.testing.assert_allclose(w2.push_pull(13, x), x)
+    w2.shutdown()
+
+
 def test_ping_clock_offset():
     port = BASE_PORT + 11
     servers = _serve(port)
