@@ -1,0 +1,35 @@
+"""Worker bootstrap: bring up ``jax.distributed`` BEFORE user code runs.
+
+Reference analog: ps-lite rendezvous happens inside ``byteps_init()``
+before any CUDA work; with a global-mesh job (``BYTEPS_JAX_DISTRIBUTED=1``)
+the JAX coordination service must likewise be joined before the user script
+touches any JAX backend, so ``bpslaunch`` interposes this module around the
+user command::
+
+    python -m byteps_tpu._jd_boot train.py args...
+
+User scripts need no changes: ``sys.argv`` is rewritten so the script sees
+exactly the argv it was launched with.
+"""
+
+from __future__ import annotations
+
+import runpy
+import sys
+
+
+def main() -> int:
+    from byteps_tpu.comm.distributed import maybe_init_distributed
+
+    maybe_init_distributed()
+    if len(sys.argv) < 2:
+        print("usage: python -m byteps_tpu._jd_boot script.py [args...]",
+              file=sys.stderr)
+        return 2
+    sys.argv = sys.argv[1:]
+    runpy.run_path(sys.argv[0], run_name="__main__")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

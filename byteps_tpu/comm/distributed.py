@@ -1,0 +1,87 @@
+"""Multi-host rendezvous: ``jax.distributed`` bring-up from ``DMLC_*`` env.
+
+Reference analog: ps-lite's scheduler node (``3rdparty/ps-lite``
+postoffice rendezvous) — every worker connects to ``DMLC_PS_ROOT_URI:PORT``,
+gets a rank, and joins the group before training starts (SURVEY §3.1).
+Here the same env vars feed ``jax.distributed.initialize``: worker
+``DMLC_WORKER_ID`` of ``DMLC_NUM_WORKER`` total joins the coordination
+service hosted by worker 0 at ``DMLC_PS_ROOT_URI:BYTEPS_JAX_COORD_PORT``
+(default: the DMLC root port — the exact address reference launch scripts
+already point at their scheduler).
+
+Two distributed topologies coexist (SURVEY §5.8 inter-node row):
+
+* **hybrid PS** (default when ``DMLC_NUM_WORKER > 1``): every worker is its
+  own JAX runtime over its own pod; pods aggregate through the C++
+  summation servers over DCN. No ``jax.distributed``.
+* **global mesh** (``BYTEPS_JAX_DISTRIBUTED=1``): the workers form ONE JAX
+  process group; ``device_mesh()`` spans all hosts and XLA collectives ride
+  ICI within a slice and DCN across slices (the "multislice collectives"
+  alternative the survey names). The PS tier is bypassed —
+  ``Config.is_distributed`` turns off so aggregation is pure collectives.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from byteps_tpu.common.config import Config, get_config
+from byteps_tpu.common.logging import get_logger
+
+log = get_logger("comm.distributed")
+
+_lock = threading.Lock()
+_initialized = False
+
+
+def maybe_init_distributed(cfg: Config | None = None) -> bool:
+    """Join the global JAX process group if this job asks for one.
+
+    Must run before the first JAX backend touch (the launcher interposes
+    ``byteps_tpu._jd_boot`` so this happens before user code; calling it
+    again from ``bps.init()`` is a no-op). Returns True when this process
+    is part of a multi-process group.
+    """
+    global _initialized
+    cfg = cfg or get_config()
+    if not cfg.jax_distributed or cfg.num_worker <= 1:
+        return False
+    with _lock:
+        if _initialized:
+            return True
+        import jax
+
+        try:  # user (or another launcher) may have initialized it already
+            if jax.distributed.is_initialized():
+                _initialized = True
+                return True
+        except AttributeError:  # older jax without is_initialized
+            pass
+        coordinator = f"{cfg.jax_coord_uri}:{cfg.jax_coord_port}"
+        log.info(
+            "jax.distributed.initialize(%s, num_processes=%d, process_id=%d)",
+            coordinator, cfg.num_worker, cfg.worker_id,
+        )
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=cfg.num_worker,
+            process_id=cfg.worker_id,
+        )
+        _initialized = True
+        # Deliberately NO device/process queries here: they would
+        # instantiate the backend NOW, locking in whatever platform the
+        # interpreter started with — before user code (or a launcher-run
+        # script) gets to pick one. The coordination service itself is
+        # backend-free.
+        log.info("joined jax.distributed group as process %d/%d",
+                 cfg.worker_id, cfg.num_worker)
+        return True
+
+
+def is_multiprocess() -> bool:
+    """True when this process runs inside a multi-process JAX group."""
+    if not _initialized:
+        return False
+    import jax
+
+    return jax.process_count() > 1

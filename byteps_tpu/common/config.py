@@ -64,6 +64,21 @@ class Config:
     ps_root_port: int = 9000
     worker_id: int = 0
     interface: str = ""
+    # Global-mesh mode (BYTEPS_JAX_DISTRIBUTED=1): the DMLC_NUM_WORKER
+    # worker processes join one jax.distributed group and device_mesh()
+    # spans all hosts; aggregation is pure XLA collectives (ICI + DCN) and
+    # the PS tier is bypassed. Default off = hybrid PS topology.
+    jax_distributed: bool = False
+    # Coordination-service address for global-mesh rendezvous, hosted by
+    # WORKER 0 (reference analog: the ps-lite scheduler's address). The
+    # defaults reuse DMLC_PS_ROOT_URI/PORT — correct when worker 0 lives at
+    # that address (the common colocated layout; PS servers bind
+    # port+1+i so there is no clash). Deployments whose DMLC_PS_ROOT_URI
+    # points at a dedicated scheduler machine must set
+    # BYTEPS_JAX_COORD_URI to worker 0's host instead — our scheduler role
+    # is a no-op that binds nothing.
+    jax_coord_uri: str = "127.0.0.1"
+    jax_coord_port: int = 9000
 
     # --- BYTEPS_* runtime tuning -------------------------------------------
     local_rank: int = 0
@@ -109,6 +124,14 @@ class Config:
             ps_root_port=_env_int("DMLC_PS_ROOT_PORT", 9000),
             worker_id=_env_int("DMLC_WORKER_ID", 0),
             interface=_env_str("DMLC_INTERFACE", ""),
+            jax_distributed=_env_bool("BYTEPS_JAX_DISTRIBUTED"),
+            jax_coord_uri=_env_str(
+                "BYTEPS_JAX_COORD_URI",
+                _env_str("DMLC_PS_ROOT_URI", "127.0.0.1"),
+            ),
+            jax_coord_port=_env_int(
+                "BYTEPS_JAX_COORD_PORT", _env_int("DMLC_PS_ROOT_PORT", 9000)
+            ),
             local_rank=_env_int("BYTEPS_LOCAL_RANK", 0),
             local_size=_env_int("BYTEPS_LOCAL_SIZE", 1),
             partition_bytes=_env_int("BYTEPS_PARTITION_BYTES", DEFAULT_PARTITION_BYTES),
@@ -133,12 +156,17 @@ class Config:
 
     @property
     def is_distributed(self) -> bool:
-        """Multi-host (DCN tier involved) vs single-host ICI-only.
+        """Multi-host via the DCN PS tier vs collectives-only.
 
         Mirrors the reference's distinction between the NCCL-only single
         machine fast path and the hybrid-PS distributed path
-        (``byteps/common/operations.cc`` queue-list construction).
+        (``byteps/common/operations.cc`` queue-list construction). In
+        global-mesh mode (``BYTEPS_JAX_DISTRIBUTED``) multi-worker jobs are
+        collectives-only: one mesh spans the hosts and psum crosses DCN,
+        so the PS tier stays out of the picture.
         """
+        if self.jax_distributed:
+            return self.force_distributed
         return self.num_worker > 1 or self.force_distributed
 
 

@@ -43,10 +43,17 @@ class TraceRecorder:
         self.start_step = start_step
         self.end_step = end_step
         self.rank = rank
+        self.metadata: Dict[str, Any] = {}
         self._events: List[Dict[str, Any]] = []
         self._lock = threading.Lock()
         self._step = 0
-        self._origin = time.perf_counter_ns()
+        # Timestamps are ABSOLUTE epoch microseconds, advanced by the
+        # monotonic clock (immune to wall-clock steps mid-run): the server
+        # trace records CLOCK_REALTIME, so worker and server events land on
+        # one timeline without post-hoc shifting (same host; cross-host uses
+        # the recorded ping clock offset — see merge_traces).
+        self._epoch0_ns = time.time_ns()
+        self._perf0_ns = time.perf_counter_ns()
         self._dumped = False
 
     # -- step lifecycle -----------------------------------------------------
@@ -56,6 +63,36 @@ class TraceRecorder:
         if self.enabled and self._step > self.end_step:
             self.dump()
 
+    def advance_to(self, step_no: int) -> None:
+        """Idempotent step advance: the production paths drive this
+        automatically (eager: a tensor's round/version number; fused: the
+        optimizer's count via jax.debug.callback), so ``BYTEPS_TRACE_ON=1``
+        alone records — no manual ``step()`` calls in user code."""
+        dump = False
+        with self._lock:
+            if step_no <= self._step:
+                return
+            self._step = step_no
+            dump = self.enabled and self._step > self.end_step
+        if dump:
+            self.dump()
+
+    def fused_step(self, count: int, args: Optional[Dict[str, Any]] = None) -> None:
+        """Per-execution marker fired from inside a jitted train step
+        (``jax.debug.callback`` in ``DistributedOptimizer.update``); `count`
+        is the optimizer's pre-increment step counter. Idempotent across
+        the per-shard duplicate callbacks shard_map can produce."""
+        step_no = int(count) + 1
+        emit = False
+        with self._lock:
+            if step_no > self._step:
+                self._step = step_no
+                emit = True
+        if emit:
+            self.instant(f"step{step_no}", "FUSED_PUSHPULL", args)
+            if self.enabled and self._step > self.end_step:
+                self.dump()
+
     @property
     def active(self) -> bool:
         return (
@@ -64,7 +101,9 @@ class TraceRecorder:
         )
 
     def _now_us(self) -> float:
-        return (time.perf_counter_ns() - self._origin) / 1e3
+        return (
+            self._epoch0_ns + (time.perf_counter_ns() - self._perf0_ns)
+        ) / 1e3
 
     # -- event emission -----------------------------------------------------
     def complete_event(
@@ -122,7 +161,12 @@ class TraceRecorder:
             doc = {
                 "traceEvents": self._events,
                 "displayTimeUnit": "ms",
-                "metadata": {"rank": self.rank, "framework": "byteps_tpu"},
+                "metadata": {
+                    "rank": self.rank,
+                    "framework": "byteps_tpu",
+                    "clock": "epoch_us",
+                    **self.metadata,
+                },
             }
         with open(path, "w") as f:
             json.dump(doc, f)
@@ -169,3 +213,68 @@ def get_tracer() -> TraceRecorder:
 def reset_tracer() -> None:
     global _tracer
     _tracer = None
+
+
+def merge_traces(out_path: str, in_paths: List[str]) -> int:
+    """Merge per-role chrome traces onto ONE aligned timeline.
+
+    Worker traces carry absolute epoch-us timestamps; server traces carry
+    CLOCK_REALTIME us (the same clock on the same host). For a server on a
+    DIFFERENT host, the worker that pinged it recorded
+    ``server_clock_offset_ns`` (= server_clock − worker_clock, kPing RTT/2
+    method — SURVEY §5.1, the dPRO cross-worker alignment capability) in
+    its own metadata; server events are shifted by −offset onto the
+    workers' clock here. Returns the merged event count.
+    """
+    docs = [json.load(open(p)) for p in in_paths]
+    # per-server offsets (server_clock − worker_clock, ns) from the first
+    # worker that probed them; every server's rows get their OWN shift
+    offsets_ns: Dict[str, float] = {}
+    for d in docs:
+        md = d.get("metadata", {})
+        if md.get("role") != "server" and md.get("server_clock_offsets"):
+            offsets_ns = {
+                str(k): float(v)
+                for k, v in md["server_clock_offsets"].items()
+            }
+            break
+    events: List[Dict[str, Any]] = []
+    for d in docs:
+        md = d.get("metadata", {})
+        is_server = md.get("role") == "server"
+        offset_us = (
+            offsets_ns.get(str(md.get("server_id", 0)), 0.0) / 1e3
+            if is_server else 0.0
+        )
+        for ev in d.get("traceEvents", []):
+            if is_server and offset_us:
+                ev = {**ev, "ts": ev["ts"] - offset_us}
+            events.append(ev)
+    events.sort(key=lambda e: e.get("ts", 0))
+    with open(out_path, "w") as f:
+        json.dump(
+            {
+                "traceEvents": events,
+                "displayTimeUnit": "ms",
+                "metadata": {"merged_from": [os.path.basename(p) for p in in_paths]},
+            },
+            f,
+        )
+    return len(events)
+
+
+def _merge_main(argv: List[str]) -> int:
+    """CLI: python -m byteps_tpu.common.tracing merged.json trace1.json ..."""
+    if len(argv) < 3:
+        print("usage: python -m byteps_tpu.common.tracing OUT.json IN.json "
+              "[IN.json ...]")
+        return 2
+    n = merge_traces(argv[1], argv[2:])
+    print(f"merged {n} events from {len(argv) - 2} traces into {argv[1]}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(_merge_main(sys.argv))

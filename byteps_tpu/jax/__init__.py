@@ -98,13 +98,17 @@ def init(
 ) -> None:
     """Initialize the adapter (reference: ``byteps_init`` / ``BytePSGlobal::Init``).
 
-    On multi-host TPU pods call ``jax.distributed.initialize()`` first (the
-    launcher does this); ``mesh`` then spans all hosts' devices.
+    On multi-host TPU pods with ``BYTEPS_JAX_DISTRIBUTED=1`` this joins the
+    global ``jax.distributed`` group (the launcher's ``_jd_boot`` already
+    did, making this a no-op); ``mesh`` then spans all hosts' devices.
     """
     if _state.initialized:
         return
     cfg = get_config()
     _state.cfg = cfg
+    from byteps_tpu.comm.distributed import maybe_init_distributed
+
+    maybe_init_distributed(cfg)
     _state.mesh = mesh if mesh is not None else device_mesh()
     _state.registry = TensorRegistry()
     _state.spec = from_params(compression_params)
@@ -123,6 +127,17 @@ def init(
         from byteps_tpu.server import PSWorker
 
         _state.psworker = PSWorker()
+        if cfg.trace_on:
+            # measure server_clock − local_clock per server (kPing RTT/2)
+            # so merge_traces can align EVERY server's rows, not just
+            # server 0's — cross-host clocks can differ by seconds each
+            try:
+                tracer.metadata["server_clock_offsets"] = {
+                    str(sidx): _state.psworker.clock_offset_ns(sidx)
+                    for sidx in range(max(1, cfg.num_server))
+                }
+            except Exception as e:  # noqa: BLE001 - tracing is best-effort
+                log.warning("clock-offset probe failed: %s", e)
         _state.scheduler = PipelineScheduler(
             stages=[
                 Stage("REDUCE", _reduce_stage, pool_size=1),
@@ -192,6 +207,11 @@ def shutdown() -> None:
     if _state.psworker is not None:
         _state.psworker.shutdown()
         _state.psworker = None
+    tracer = get_tracer()
+    if tracer.enabled:
+        # after the pipeline stops so late stage events are included; runs
+        # shorter than BYTEPS_TRACE_END_STEP still get their trace
+        tracer.dump()
     _state.initialized = False
     _state.versions.clear()
     _state.ef_state.clear()
@@ -220,8 +240,12 @@ def pod_size() -> int:
 def size() -> int:
     """Global data-parallel participant count (each TPU device is the
     analog of one reference GPU worker): pod devices × DMLC_NUM_WORKER
-    pods. Matches the reference's size() = machines × local GPUs."""
+    pods. Matches the reference's size() = machines × local GPUs. In
+    global-mesh mode the mesh already spans every host's devices, so
+    pod_size() IS the global count."""
     _require_init()
+    if _state.cfg.jax_distributed:
+        return pod_size()
     return pod_size() * max(1, _state.cfg.num_worker)
 
 
@@ -241,6 +265,18 @@ def mesh():
 
 
 # --- eager push_pull path ---------------------------------------------------
+def _global_rows(local_rows: np.ndarray, n: int) -> jax.Array:
+    """Assemble per-process local-device rows into one (n, L) global array
+    sharded over the dp axis (global-mesh mode: each controller holds only
+    its own devices' rows)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(_state.mesh, P(_state.cfg.dp_axis))
+    return jax.make_array_from_process_local_data(
+        sh, np.asarray(local_rows), (n,) + local_rows.shape[1:]
+    )
+
+
 def _tensor_rng(name: str, version: int, seed: int = 0):
     # zlib.crc32 is stable across processes/runs, unlike salted hash() —
     # multi-host controllers must derive identical keys for the same tensor
@@ -426,11 +462,28 @@ def push_pull_async(
     ``size()``). Returns a Handle; ``handle.wait()`` / :func:`synchronize`.
 
     Reference: ``byteps_push_pull`` / ``byteps_torch_push_pull_async``.
+
+    In global-mesh mode (``BYTEPS_JAX_DISTRIBUTED``) across several
+    controller processes, pass either the full global ``(size(), ...)``
+    array or just THIS process's local-device rows
+    ``(jax.local_device_count(), ...)`` — local rows are assembled into one
+    dp-sharded global array before the collective.
     """
     _require_init()
+    from byteps_tpu.comm.distributed import is_multiprocess
+
     n = pod_size()
-    bps_check(x.ndim >= 1 and x.shape[0] == n,
-              f"expected leading axis {n} (= pod_size()), got {x.shape}")
+    multiproc = is_multiprocess()
+    if multiproc:
+        n_local = jax.local_device_count()
+        bps_check(
+            x.ndim >= 1 and x.shape[0] in (n, n_local),
+            f"expected leading axis {n} (global) or {n_local} (local "
+            f"devices), got {x.shape}",
+        )
+    else:
+        bps_check(x.ndim >= 1 and x.shape[0] == n,
+                  f"expected leading axis {n} (= pod_size()), got {x.shape}")
     anonymous = name is None
     with _state.lock:
         if anonymous:
@@ -442,6 +495,9 @@ def push_pull_async(
     with _state.lock:
         version = _state.versions.get(name, 0)
         _state.versions[name] = version + 1
+    # auto step detection: the highest round number any tensor has reached
+    # IS the training step — BYTEPS_TRACE_ON=1 alone records, no user code
+    get_tracer().advance_to(version + 1)
     spec = (
         from_params(compression_params)
         if compression_params is not None
@@ -495,7 +551,10 @@ def push_pull_async(
     # Skip compression for tiny tensors (reference: BYTEPS_MIN_COMPRESS_BYTES)
     elif spec.enabled and L * np.dtype(x.dtype).itemsize < _state.cfg.min_compress_bytes:
         spec = from_params(None)
-    x2d = x.reshape(n, L)
+    if multiproc and x.shape[0] != n:
+        x2d = _global_rows(np.asarray(x).reshape(x.shape[0], L), n)
+    else:
+        x2d = x.reshape(n, L)
     handle = Handle(name, len(ctx.partitions))
     handle.inner_shape = inner_shape  # type: ignore[attr-defined]
     handle.dtype = x.dtype            # type: ignore[attr-defined]
@@ -517,6 +576,25 @@ def push_pull_async(
         tasks.append(
             PartitionTask(partition=p, name=name, handle=handle, context=shared)
         )
+    if multiproc:
+        # SPMD determinism: every controller must issue IDENTICAL
+        # collectives in IDENTICAL order or the job deadlocks. The credit
+        # scheduler's pop order is timing-dependent (credits free on
+        # device-side completion), so in global-mesh mode chunks dispatch
+        # inline in partition order — JAX's async dispatch still overlaps
+        # their execution; only the issue order is pinned.
+        handle.localize = True  # type: ignore[attr-defined]
+        tracer = get_tracer()
+        for t in tasks:
+            with tracer.span(
+                f"{name}.p{t.partition.part_idx}", "PUSHPULL",
+                args={"key": t.partition.key,
+                      "priority": t.partition.priority,
+                      "length": t.partition.length},
+            ):
+                result = _dispatch_stage(t)
+            handle._partition_done(t.partition.part_idx, result)
+        return handle
     _state.scheduler.enqueue(tasks)
     return handle
 
@@ -528,6 +606,15 @@ def synchronize(handle: Handle, timeout: Optional[float] = 120.0) -> jnp.ndarray
     """
     results = handle.wait(timeout)
     parts = [results[i] for i in sorted(results)]
+    if getattr(handle, "localize", False):
+        # global-mesh mode: chunk results are mesh-wide replicated arrays;
+        # hand the caller an ordinary process-local value (the Horovod-style
+        # eager contract — usable in plain per-device computation, exactly
+        # like the reference's in-place updated GPU tensor)
+        flat_np = (np.asarray(parts[0]) if len(parts) == 1
+                   else np.concatenate([np.asarray(p) for p in parts]))
+        out = jnp.asarray(flat_np.reshape(handle.inner_shape))  # type: ignore[attr-defined]
+        return out.astype(handle.dtype)     # type: ignore[attr-defined]
     flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
     out = flat.reshape(handle.inner_shape)  # type: ignore[attr-defined]
     return out.astype(handle.dtype)         # type: ignore[attr-defined]
@@ -596,15 +683,25 @@ def broadcast_parameters(params, root_rank: int = 0):
         outs = [synchronize(h) for h in handles]
         return jax.tree.unflatten(treedef, outs)
 
+    from byteps_tpu.comm.distributed import is_multiprocess
+
+    multiproc = is_multiprocess()
+
     def bcast(leaf):
-        bps_check(leaf.shape[0] == n, f"leading axis must be {n}")
         L = int(np.prod(leaf.shape[1:])) if leaf.ndim > 1 else 1
+        if multiproc and leaf.shape[0] != n:
+            flat2d = _global_rows(
+                np.asarray(leaf).reshape(leaf.shape[0], L), n)
+        else:
+            bps_check(leaf.shape[0] == n, f"leading axis must be {n}")
+            flat2d = leaf.reshape(n, L)
         # native dtype throughout: zero-plus-psum is exact for ints too,
         # and a float32 round-trip would corrupt int leaves > 2^24
         flat = broadcast_flat(
-            leaf.reshape(n, L), _state.mesh, root=root_rank,
-            axis=_state.cfg.dp_axis,
+            flat2d, _state.mesh, root=root_rank, axis=_state.cfg.dp_axis,
         )
+        if multiproc:  # hand back a process-local value (see synchronize)
+            flat = jnp.asarray(np.asarray(flat))
         return flat.reshape(leaf.shape[1:])
 
     return jax.tree.map(bcast, params)

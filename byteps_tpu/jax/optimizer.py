@@ -316,12 +316,52 @@ def DistributedOptimizer(
             )
             new_ef = state.ef
 
+        if cfg.trace_on and _host_callbacks_supported():
+            # Per-execution dispatch-site marker (SURVEY §5.1): the fused
+            # path lives inside XLA where the host tracer cannot see, so a
+            # debug callback surfaces one event per executed step and
+            # advances the trace step window. count makes it idempotent
+            # across shard_map's per-shard duplicates; zero overhead when
+            # BYTEPS_TRACE_ON is off (branch is trace-time static).
+            pb = partition_bytes or cfg.partition_bytes
+            nchunks = -(-total * 4 // pb)
+            jax.debug.callback(
+                _fused_trace_callback, state.count,
+                total_elems=total, chunks=nchunks,
+            )
+
         updates, new_inner = tx.update(agg, state.inner, params)
         return updates, DistributedOptState(
             inner=new_inner, count=state.count + 1, ef=new_ef, momentum=mom
         )
 
     return optax.GradientTransformation(init_fn, update_fn)
+
+
+def _host_callbacks_supported() -> bool:
+    """Some PJRT plugins (the axon TPU tunnel) reject host send/recv
+    callbacks outright; tracing must degrade to eager-path events there
+    instead of crashing every traced step."""
+    backend = jax.default_backend()
+    if backend not in ("cpu", "gpu", "tpu"):
+        if not getattr(_host_callbacks_supported, "_warned", False):
+            from byteps_tpu.common.logging import get_logger
+
+            get_logger("jax.optimizer").warning(
+                "fused-path trace markers disabled: backend %r does not "
+                "support host callbacks", backend,
+            )
+            _host_callbacks_supported._warned = True  # type: ignore[attr-defined]
+        return False
+    return True
+
+
+def _fused_trace_callback(count, total_elems: int, chunks: int) -> None:
+    from byteps_tpu.common.tracing import get_tracer
+
+    get_tracer().fused_step(
+        int(count), {"total_elems": int(total_elems), "chunks": int(chunks)}
+    )
 
 
 def dp_state_specs(axis: Optional[str] = None) -> DistributedOptState:

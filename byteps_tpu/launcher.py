@@ -49,6 +49,29 @@ def _run_scheduler() -> int:
     return 0
 
 
+def _wrap_jax_distributed(cmd: List[str]) -> List[str]:
+    """Interpose the jax.distributed bootstrap around a python command so
+    the global mesh forms BEFORE user code touches any JAX backend
+    (reference: ps-lite rendezvous precedes all CUDA work in byteps_init).
+    Interpreter flags (``python -u train.py``) are kept ahead of the
+    ``-m`` interposition. Commands that cannot be wrapped (non-python
+    binaries, ``python -m pkg``, ``python -c ...``) run unwrapped with a
+    warning — their own bps.init() still joins the group, just later."""
+    exe = os.path.basename(cmd[0])
+    if exe.startswith("python"):
+        for i, arg in enumerate(cmd[1:], start=1):
+            if arg in ("-m", "-c"):
+                break  # module/inline form: runpy.run_path can't replay it
+            if not arg.startswith("-"):
+                return (cmd[:i] + ["-m", "byteps_tpu._jd_boot"] + cmd[i:])
+    log.warning(
+        "cannot interpose jax.distributed bootstrap around %r; the global "
+        "mesh forms at bps.init() — make sure user code touches no JAX "
+        "backend before that", " ".join(cmd),
+    )
+    return cmd
+
+
 def _spawn_workers(cmd: List[str]) -> int:
     cfg = get_config()
     local_size = cfg.local_size
@@ -56,6 +79,8 @@ def _spawn_workers(cmd: List[str]) -> int:
     single_host_sim = (
         local_size > 1 and cfg.num_worker == local_size and cfg.worker_id == 0
     )
+    if cfg.jax_distributed:
+        cmd = _wrap_jax_distributed(cmd)
     for i in range(local_size):
         env = dict(os.environ)
         env["BYTEPS_LOCAL_RANK"] = str(i)

@@ -15,6 +15,12 @@ sys.path.insert(0, os.path.abspath(
     os.path.join(os.path.dirname(__file__), "..", "..")))
 
 import jax
+
+# honor an explicit JAX_PLATFORMS choice even when a preloaded PJRT plugin
+# (e.g. a harness sitecustomize) already picked a different default
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P

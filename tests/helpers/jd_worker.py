@@ -1,0 +1,72 @@
+"""Worker body for the global-mesh (jax.distributed) integration test.
+
+Two controller processes, 2 virtual CPU devices each, form ONE 4-device
+global mesh (reference analog: ps-lite scheduler rendezvous assembling the
+worker group, SURVEY §3.1; the TPU-native multislice topology of §5.8).
+Asserts the mesh spans both processes, runs an eager push_pull, one
+aggregated train step, and a broadcast — printing a digest the parent test
+compares across ranks.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.environ["BPS_REPO"])
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+import byteps_tpu.jax as bps
+
+
+def main():
+    bps.init()
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 4, jax.device_count()
+    assert jax.local_device_count() == 2, jax.local_device_count()
+    assert bps.size() == 4, bps.size()
+    rank = bps.rank()
+    nl = jax.local_device_count()
+
+    # 1. eager push_pull from per-process local rows: global row r carries
+    # value r+1, so the cross-process sum is 1+2+3+4 = 10
+    rows = np.arange(nl, dtype=np.float32) + 1 + rank * nl
+    x = np.ascontiguousarray(
+        np.broadcast_to(rows[:, None], (nl, 100)), dtype=np.float32)
+    out = bps.push_pull(x, average=False, name="g0")
+    np.testing.assert_allclose(np.asarray(out), 10.0, rtol=1e-6)
+
+    # 2. one aggregated train step: each process computes grads on its OWN
+    # batch; push_pull averages them across all 4 global devices, so both
+    # processes must land on identical updated params
+    w = jnp.ones((8,), jnp.float32)
+
+    def loss(w, b):
+        return jnp.mean((b @ w - 1.0) ** 2)
+
+    rng = np.random.default_rng(100 + rank)
+    batch = rng.standard_normal((nl, 4, 8)).astype(np.float32)
+    g_local = np.stack(
+        [np.asarray(jax.grad(loss)(w, batch[d])) for d in range(nl)])
+    g = bps.push_pull(g_local, average=True, name="grads")
+    w2 = w - 0.1 * g
+    digest = float(jnp.sum(w2 * jnp.arange(8)))
+    print(f"JD_OK rank={rank} digest={digest:.6f}", flush=True)
+
+    # 3. broadcast from global row 0 (process 0's first device row)
+    p = {"w": np.full((nl, 3), float(rank + 1), np.float32)}
+    pb = bps.broadcast_parameters(p, root_rank=0)
+    np.testing.assert_allclose(np.asarray(pb["w"]), 1.0)
+
+    bps.shutdown()
+    print(f"JD_DONE rank={rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

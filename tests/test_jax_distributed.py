@@ -1,0 +1,95 @@
+"""Global-mesh mode: DMLC-env-driven ``jax.distributed`` rendezvous.
+
+Reference analog: ps-lite scheduler rendezvous bringing up the worker
+group before training (SURVEY §3.1); here two controller processes form
+one JAX process group and a mesh spanning both (SURVEY §5.8 control-plane
+row). Tested the reference way — real multi-process on localhost.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HELPER = os.path.join(REPO, "tests", "helpers", "jd_worker.py")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _env(port: int, wid=None):
+    env = dict(os.environ)
+    env.update({
+        "BPS_REPO": REPO,
+        "DMLC_ROLE": "worker",
+        "DMLC_NUM_WORKER": "2",
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "BYTEPS_JAX_DISTRIBUTED": "1",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "JAX_PLATFORMS": "cpu",
+    })
+    env.pop("DMLC_WORKER_ID", None)
+    if wid is not None:
+        env["DMLC_WORKER_ID"] = str(wid)
+    return env
+
+
+def _check_outputs(outs):
+    for i, out in enumerate(outs):
+        assert f"JD_DONE rank={i}" in out, f"worker {i} output:\n{out}"
+    digests = []
+    for out in outs:
+        line = next(ln for ln in out.splitlines() if ln.startswith("JD_OK"))
+        digests.append(line.split("digest=")[1])
+    # the aggregated step must land both processes on identical params
+    assert digests[0] == digests[1], digests
+
+
+def test_two_process_global_mesh():
+    """bps.init() joins the group; both controllers see one 4-device mesh
+    (jax.device_count() == 2 processes x 2 local devices) and an
+    aggregated step produces identical params on both."""
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, HELPER], env=_env(port, i),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, p in enumerate(procs):
+        assert p.returncode == 0, f"worker {i} failed:\n{outs[i]}"
+    _check_outputs(outs)
+
+
+def test_launcher_brings_up_global_mesh():
+    """The launcher alone (no user-code changes, no explicit worker ids)
+    spawns both workers, interposes the jax.distributed bootstrap, and the
+    global mesh forms — the reference bpslaunch UX."""
+    port = _free_port()
+    env = _env(port)
+    env["BYTEPS_LOCAL_SIZE"] = "2"
+    p = subprocess.run(
+        [sys.executable, "-m", "byteps_tpu.launcher", sys.executable, HELPER],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, timeout=300,
+    )
+    assert p.returncode == 0, p.stdout
+    for i in range(2):
+        assert f"JD_DONE rank={i}" in p.stdout, p.stdout
