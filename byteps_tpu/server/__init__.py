@@ -263,7 +263,9 @@ class PSWorker:
 
     def pull(self, key: int, nelems: int, version: int) -> np.ndarray:
         buf = self.pull_bytes(key, nelems * 4, version, WIRE_RAW)
-        return buf.view(np.float32).copy()
+        # view, not copy: pull_bytes allocated the buffer for this call, so
+        # the caller owns it — the copy was a full extra pass per partition
+        return buf.view(np.float32)
 
     def push_pull(self, key: int, data: np.ndarray) -> np.ndarray:
         v = self.push(key, data)

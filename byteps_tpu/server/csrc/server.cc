@@ -32,6 +32,26 @@ int64_t steady_ms() {
       .count();
 }
 
+// vector<char> whose resize() default-initializes instead of zeroing:
+// payload buffers are filled by recv_all immediately after sizing, and the
+// avoided memset is a full extra memory pass per 4 MB push.
+template <class T>
+struct uninit_alloc : std::allocator<T> {
+  template <class U>
+  struct rebind {
+    using other = uninit_alloc<U>;
+  };
+  template <class U>
+  void construct(U* p) noexcept {
+    ::new (static_cast<void*>(p)) U;
+  }
+  template <class U, class... Args>
+  void construct(U* p, Args&&... args) {
+    ::new (static_cast<void*>(p)) U(std::forward<Args>(args)...);
+  }
+};
+using RawBuf = std::vector<char, uninit_alloc<char>>;
+
 // Ordered executor over the shared engine pool, one per (key, worker).
 // A worker's pushes for one key are applied in RECEIVE order: two
 // pipelined pushes (rounds v and v+1) submitted to an unordered pool could
@@ -70,7 +90,7 @@ struct PendingPull {
 struct DeferredPush {
   uint16_t worker;
   uint8_t codec;
-  std::shared_ptr<std::vector<char>> buf;
+  std::shared_ptr<RawBuf> buf;
 };
 
 // Per-key state (reference: BytePSArray store + the "all workers arrived →
@@ -82,6 +102,11 @@ struct DeferredPush {
 struct KeyStore {
   std::mutex mu;
   std::condition_variable cv;  // local (in-process) pulls wait here
+  // Dense element count, immutable after creation. Validation MUST read
+  // this, not accum.size(): a closing round MOVES accum out and
+  // reallocates it under mu, so an unlocked accum.size() can observe 0
+  // and spuriously reject a concurrent pipelined push.
+  size_t n_elems = 0;
   std::vector<float> accum;
   std::shared_ptr<const std::vector<float>> result;
   uint64_t version = 0;
@@ -255,7 +280,7 @@ class Server {
     if (!running_) return -10;
     if (nbytes == 0 || nbytes > kMaxFrameLen || nbytes % 4 != 0) return -1;
     KeyStore* ks = GetOrCreate(key, nbytes / 4);
-    return ks->accum.size() * 4 == nbytes ? 0 : -2;
+    return ks->n_elems * 4 == nbytes ? 0 : -2;
   }
 
   int LocalPush(uint16_t worker, uint64_t key, uint8_t codec,
@@ -264,9 +289,9 @@ class Server {
     KeyStore* ks = Get(key);
     if (ks == nullptr) return -1;
     if (!async_ && worker >= num_workers_) return -2;
-    const int64_t n = static_cast<int64_t>(ks->accum.size());
+    const int64_t n = static_cast<int64_t>(ks->n_elems);
     if (!validate_payload(codec, buf, len, n)) return -3;
-    auto owned = std::make_shared<std::vector<char>>(buf, buf + len);
+    auto owned = std::make_shared<RawBuf>(buf, buf + len);
     ApplyPush(ks, key, worker, codec, std::move(owned));
     return 0;
   }
@@ -414,6 +439,7 @@ class Server {
     auto& slot = store_[key];
     if (!slot) {
       slot = std::make_unique<KeyStore>();
+      slot->n_elems = nfloats;
       slot->accum.assign(nfloats, 0.f);
       slot->result =
           std::make_shared<const std::vector<float>>(nfloats, 0.f);
@@ -446,9 +472,9 @@ class Server {
   // Pulls satisfied by a closing round are appended to `ready` with that
   // round's snapshot.
   void ApplyPushLocked(KeyStore* ks, uint16_t worker, uint8_t codec,
-                       std::shared_ptr<std::vector<char>> buf,
+                       std::shared_ptr<RawBuf> buf,
                        std::vector<ReadyResp>* ready) {
-    const int64_t n = static_cast<int64_t>(ks->accum.size());
+    const int64_t n = static_cast<int64_t>(ks->n_elems);
     if (!async_ && ks->pushed[worker]) {
       ks->deferred.push_back({worker, codec, std::move(buf)});
       return;
@@ -496,7 +522,7 @@ class Server {
   }
 
   void ApplyPush(KeyStore* ks, uint64_t key, uint16_t worker, uint8_t codec,
-                 std::shared_ptr<std::vector<char>> buf) {
+                 std::shared_ptr<RawBuf> buf) {
     const int64_t t0 = realtime_ns();
     const uint32_t len = static_cast<uint32_t>(buf->size());
     std::vector<ReadyResp> ready;
@@ -655,7 +681,7 @@ class Server {
     while (running_ && recv_all(c->fd, &h, sizeof(h))) {
       if (h.magic != kMagic || h.len > kMaxFrameLen) break;
       const int64_t t_recv = realtime_ns();
-      auto payload = std::make_shared<std::vector<char>>();
+      auto payload = std::make_shared<RawBuf>();
       if (h.len > 0) {
         payload->resize(h.len);
         if (!recv_all(c->fd, payload->data(), h.len)) break;
@@ -669,7 +695,7 @@ class Server {
             break;
           }
           KeyStore* ks = GetOrCreate(h.key, h.version / sizeof(float));
-          if (ks->accum.size() * sizeof(float) != h.version) {
+          if (ks->n_elems * sizeof(float) != h.version) {
             // mismatched partition config across pods — fail loudly
             // instead of letting a later push corrupt the store
             SendErr(c, h.key, "init size mismatch");
@@ -689,7 +715,7 @@ class Server {
             break;
           }
           if (!validate_payload(h.flags, payload->data(), h.len,
-                                static_cast<int64_t>(ks->accum.size()))) {
+                                static_cast<int64_t>(ks->n_elems))) {
             SendErr(c, h.key, "payload does not match store size");
             break;
           }
