@@ -59,7 +59,16 @@ class AutoTuner:
         min_gain: float = 0.02,
         partition_bytes: int = 4 << 20,
         credit: int = 4,
+        knobs: Tuple[str, ...] = ("partition", "credit"),
     ) -> None:
+        """``knobs`` restricts the search space: the fused jit path has no
+        credit scheduler (XLA owns overlap), so it tunes ``("partition",)``
+        only — every move there costs a retrace, and burning evaluations on
+        a knob with no effect would double convergence time."""
+        _KNOBS = ("partition", "credit")
+        bad = [k for k in knobs if k not in _KNOBS]
+        if bad or not knobs:
+            raise ValueError(f"knobs must be a non-empty subset of {_KNOBS}")
         pi = min(range(len(PARTITION_GRID)),
                  key=lambda i: abs(PARTITION_GRID[i] - partition_bytes))
         ci = min(range(len(CREDIT_GRID)),
@@ -79,7 +88,8 @@ class AutoTuner:
         self._best_time: Optional[float] = None
         self._samples: List[float] = []
         self._steps = 0
-        self._knob = 0          # 0: partition, 1: credit
+        self._knobs = tuple(knobs)
+        self._knob_i = 0
         self._direction = +1
         self._exhausted = 0     # directions tried without improvement
         self.converged = False
@@ -118,8 +128,8 @@ class AutoTuner:
                 self._direction = -1
             else:
                 self._direction = +1
-                self._knob = 1 - self._knob
-        if self._exhausted >= 4:
+                self._knob_i = (self._knob_i + 1) % len(self._knobs)
+        if self._exhausted >= 2 * len(self._knobs):
             self.converged = True
             self._apply(self._best.partition_bytes, self._best.credit)
             log.info("tuner converged: partition=%dKB credit=%d",
@@ -134,7 +144,7 @@ class AutoTuner:
 
     def _neighbor(self) -> Optional[_Candidate]:
         c = self._current
-        if self._knob == 0:
+        if self._knobs[self._knob_i] == "partition":
             i = c.part_idx + self._direction
             if 0 <= i < len(PARTITION_GRID):
                 return _Candidate(i, c.credit_idx)

@@ -63,6 +63,7 @@ from byteps_tpu.jax.optimizer import (  # noqa: F401,E402
     dp_state_specs,
     push_pull_inside,
 )
+from byteps_tpu.jax.tuned_step import AutoTunedStep  # noqa: F401,E402
 
 log = get_logger("jax")
 
@@ -717,6 +718,18 @@ def tuner():
     once per training step to drive online (partition, credit) tuning."""
     _require_init()
     return _state.tuner
+
+
+def auto_tune_enabled() -> bool:
+    """True when BYTEPS_AUTO_TUNE=1 — build your fused step through
+    :class:`AutoTunedStep` (the train-step factories in
+    ``byteps_tpu.models.train`` do this automatically)."""
+    return get_config().auto_tune
+
+
+def default_partition_bytes() -> int:
+    """The configured BYTEPS_PARTITION_BYTES (tuner starting point)."""
+    return get_config().partition_bytes
 
 
 def declare_tensor(name: str, shape, dtype) -> None:

@@ -56,16 +56,23 @@ def _check_compression_mesh(use_vma, tp, sp):
         )
 
 
-def _setup_optimizer(mesh, base_tx, params, pspecs, compression_params,
-                     partition_bytes, dp):
-    """Wrap base_tx with dp aggregation; shard params + opt state."""
-    if dp is not None:
-        tx = DistributedOptimizer(
-            base_tx, compression_params=compression_params, axis=dp,
-            num_devices=mesh.shape[dp], partition_bytes=partition_bytes,
-        )
-    else:
-        tx = base_tx
+def _make_tx(mesh, base_tx, compression_params, partition_bytes, dp):
+    """Wrap base_tx with dp aggregation (or pass through on a dp-less mesh).
+
+    Separated from the params/state sharding so the auto-tuner can rebuild
+    the transformation at a new partition size without re-initializing
+    optimizer state (partition size affects chunking only, never state
+    shapes)."""
+    if dp is None:
+        return base_tx
+    return DistributedOptimizer(
+        base_tx, compression_params=compression_params, axis=dp,
+        num_devices=mesh.shape[dp], partition_bytes=partition_bytes,
+    )
+
+
+def _shard_params_state(mesh, tx, params, pspecs, dp):
+    """device_put params, init + shard the optimizer state."""
     params = jax.device_put(
         params, jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs)
     )
@@ -80,7 +87,23 @@ def _setup_optimizer(mesh, base_tx, params, pspecs, compression_params,
     opt_state = jax.device_put(
         opt_state, jax.tree.map(lambda s: NamedSharding(mesh, s), ospecs)
     )
-    return tx, params, opt_state, ospecs
+    return params, opt_state, ospecs
+
+
+def _finalize_step(build_jit, partition_bytes, dp):
+    """Return the jitted step, auto-tuned when BYTEPS_AUTO_TUNE=1.
+
+    The tuned wrapper re-invokes ``build_jit`` with new partition sizes as
+    the search moves (ByteScheduler's online partition tuning, SURVEY §2.6,
+    transposed to the fused path where a move costs one cached retrace)."""
+    from byteps_tpu.common.config import get_config
+
+    cfg = get_config()
+    if cfg.auto_tune and dp is not None:
+        from byteps_tpu.jax.tuned_step import AutoTunedStep
+
+        return AutoTunedStep(build_jit, partition_bytes or cfg.partition_bytes)
+    return build_jit(partition_bytes)
 
 
 def _make_resymmetrize(pspecs, dp):
@@ -133,9 +156,9 @@ def make_gpt_train_step(
     _check_compression_mesh(use_vma, tp, sp)
     pspecs = gpt_param_specs(cfg, tp)
     params = gpt_init(jax.random.PRNGKey(0), cfg)
-    tx, params, opt_state, ospecs = _setup_optimizer(
-        mesh, base_tx, params, pspecs, compression_params, partition_bytes,
-        dp,
+    params, opt_state, ospecs = _shard_params_state(
+        mesh, _make_tx(mesh, base_tx, compression_params, partition_bytes, dp),
+        params, pspecs, dp,
     )
     batch_spec = P(dp, sp)
     resym = _make_resymmetrize(pspecs, dp)
@@ -148,28 +171,35 @@ def make_gpt_train_step(
         gpt_loss, cfg=cfg, dp_axis=None, tp_axis=tp, sp_axis=sp
     )
 
-    def per_device_step(params, opt_state, tokens, targets):
-        grad_params = _pcast_dp(params, dp, mesh, use_vma)
-        loss, grads = jax.value_and_grad(loss_fn)(grad_params, tokens, targets)
-        if use_vma:
-            grads = resym(grads)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        if dp is not None:
-            loss = jax.lax.pmean(loss, dp)  # report the global mean loss
-        return loss, params, opt_state
+    def build_jit(pb):
+        tx = _make_tx(mesh, base_tx, compression_params, pb, dp)
 
-    sharded = jax.shard_map(
-        per_device_step,
-        mesh=mesh,
-        in_specs=(pspecs, ospecs, batch_spec, batch_spec),
-        out_specs=(P(), pspecs, ospecs),
-        check_vma=use_vma,
-    )
-    # donate params/opt_state: the step is an in-place update at the XLA
-    # level (halves HBM traffic for the weight/optimizer buffers)
+        def per_device_step(params, opt_state, tokens, targets):
+            grad_params = _pcast_dp(params, dp, mesh, use_vma)
+            loss, grads = jax.value_and_grad(loss_fn)(
+                grad_params, tokens, targets
+            )
+            if use_vma:
+                grads = resym(grads)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            if dp is not None:
+                loss = jax.lax.pmean(loss, dp)  # report the global mean loss
+            return loss, params, opt_state
+
+        sharded = jax.shard_map(
+            per_device_step,
+            mesh=mesh,
+            in_specs=(pspecs, ospecs, batch_spec, batch_spec),
+            out_specs=(P(), pspecs, ospecs),
+            check_vma=use_vma,
+        )
+        # donate params/opt_state: the step is an in-place update at the XLA
+        # level (halves HBM traffic for the weight/optimizer buffers)
+        return jax.jit(sharded, donate_argnums=(0, 1))
+
     return (
-        jax.jit(sharded, donate_argnums=(0, 1)),
+        _finalize_step(build_jit, partition_bytes, dp),
         params, opt_state, NamedSharding(mesh, batch_spec),
     )
 
@@ -188,9 +218,9 @@ def make_bert_train_step(
     _check_compression_mesh(use_vma, tp, sp)
     pspecs = bert_param_specs(cfg, tp)
     params = bert_init(jax.random.PRNGKey(0), cfg)
-    tx, params, opt_state, ospecs = _setup_optimizer(
-        mesh, base_tx, params, pspecs, compression_params, partition_bytes,
-        dp,
+    params, opt_state, ospecs = _shard_params_state(
+        mesh, _make_tx(mesh, base_tx, compression_params, partition_bytes, dp),
+        params, pspecs, dp,
     )
     batch_spec = P(dp, sp)
     resym = _make_resymmetrize(pspecs, dp)
@@ -198,28 +228,33 @@ def make_bert_train_step(
         bert_mlm_loss, cfg=cfg, dp_axis=None, tp_axis=tp, sp_axis=sp
     )
 
-    def per_device_step(params, opt_state, tokens, targets, mask):
-        grad_params = _pcast_dp(params, dp, mesh, use_vma)
-        loss, grads = jax.value_and_grad(loss_fn)(
-            grad_params, tokens, targets, mask
-        )
-        if use_vma:
-            grads = resym(grads)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        if dp is not None:
-            loss = jax.lax.pmean(loss, dp)
-        return loss, params, opt_state
+    def build_jit(pb):
+        tx = _make_tx(mesh, base_tx, compression_params, pb, dp)
 
-    sharded = jax.shard_map(
-        per_device_step,
-        mesh=mesh,
-        in_specs=(pspecs, ospecs, batch_spec, batch_spec, batch_spec),
-        out_specs=(P(), pspecs, ospecs),
-        check_vma=use_vma,
-    )
+        def per_device_step(params, opt_state, tokens, targets, mask):
+            grad_params = _pcast_dp(params, dp, mesh, use_vma)
+            loss, grads = jax.value_and_grad(loss_fn)(
+                grad_params, tokens, targets, mask
+            )
+            if use_vma:
+                grads = resym(grads)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            if dp is not None:
+                loss = jax.lax.pmean(loss, dp)
+            return loss, params, opt_state
+
+        sharded = jax.shard_map(
+            per_device_step,
+            mesh=mesh,
+            in_specs=(pspecs, ospecs, batch_spec, batch_spec, batch_spec),
+            out_specs=(P(), pspecs, ospecs),
+            check_vma=use_vma,
+        )
+        return jax.jit(sharded, donate_argnums=(0, 1))
+
     return (
-        jax.jit(sharded, donate_argnums=(0, 1)),
+        _finalize_step(build_jit, partition_bytes, dp),
         params, opt_state, NamedSharding(mesh, batch_spec),
     )
 
@@ -240,9 +275,9 @@ def make_resnet_train_step(
     use_vma = compression_params is None
     params, bn_state = resnet_init(jax.random.PRNGKey(0), cfg)
     pspecs = resnet_param_specs(cfg, params)
-    tx, params, opt_state, ospecs = _setup_optimizer(
-        mesh, base_tx, params, pspecs, compression_params, partition_bytes,
-        dp,
+    params, opt_state, ospecs = _shard_params_state(
+        mesh, _make_tx(mesh, base_tx, compression_params, partition_bytes, dp),
+        params, pspecs, dp,
     )
     sspecs = jax.tree.map(lambda _: P(), bn_state)
     bn_state = jax.device_put(
@@ -255,38 +290,44 @@ def make_resnet_train_step(
         return resnet_loss(params, bn_state, images, labels, cfg,
                            dp_axis=dp, train=True)
 
-    def per_device_step(params, opt_state, bn_state, images, labels):
-        grad_params = _pcast_dp(params, dp, mesh, use_vma)
-        (loss, new_bn), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            grad_params, bn_state, images, labels
-        )
-        if use_vma:
-            grads = resym(grads)
-            # SyncBN pmean makes stats unvarying, but conservative VMA can
-            # widen the state type the same way it widens grads
-            new_bn = jax.tree.map(
-                lambda s: jax.lax.pmean(
-                    s, tuple(sorted(
-                        a for a in (getattr(jax.typeof(s), "vma", ()) or ())
-                    ))
-                ) if (getattr(jax.typeof(s), "vma", ()) or ()) else s,
-                new_bn,
-            )
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        if dp is not None:
-            loss = jax.lax.pmean(loss, dp)
-        return loss, params, opt_state, new_bn
+    def build_jit(pb):
+        tx = _make_tx(mesh, base_tx, compression_params, pb, dp)
 
-    sharded = jax.shard_map(
-        per_device_step,
-        mesh=mesh,
-        in_specs=(pspecs, ospecs, sspecs, batch_spec, batch_spec),
-        out_specs=(P(), pspecs, ospecs, sspecs),
-        check_vma=use_vma,
-    )
+        def per_device_step(params, opt_state, bn_state, images, labels):
+            grad_params = _pcast_dp(params, dp, mesh, use_vma)
+            (loss, new_bn), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                grad_params, bn_state, images, labels
+            )
+            if use_vma:
+                grads = resym(grads)
+                # SyncBN pmean makes stats unvarying, but conservative VMA
+                # can widen the state type the same way it widens grads
+                new_bn = jax.tree.map(
+                    lambda s: jax.lax.pmean(
+                        s, tuple(sorted(
+                            a for a in
+                            (getattr(jax.typeof(s), "vma", ()) or ())
+                        ))
+                    ) if (getattr(jax.typeof(s), "vma", ()) or ()) else s,
+                    new_bn,
+                )
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            if dp is not None:
+                loss = jax.lax.pmean(loss, dp)
+            return loss, params, opt_state, new_bn
+
+        sharded = jax.shard_map(
+            per_device_step,
+            mesh=mesh,
+            in_specs=(pspecs, ospecs, sspecs, batch_spec, batch_spec),
+            out_specs=(P(), pspecs, ospecs, sspecs),
+            check_vma=use_vma,
+        )
+        return jax.jit(sharded, donate_argnums=(0, 1, 2))
+
     return (
-        jax.jit(sharded, donate_argnums=(0, 1, 2)),
+        _finalize_step(build_jit, partition_bytes, dp),
         params, opt_state, bn_state, NamedSharding(mesh, batch_spec),
     )
 

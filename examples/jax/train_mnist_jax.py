@@ -68,11 +68,14 @@ def main():
     bps.init(mesh=mesh)
     comp = {"compressor": args.compressor, "ef": "vanilla"} \
         if args.compressor else None
-    tx = bps.DistributedOptimizer(
-        optax.sgd(args.lr, momentum=0.9), compression_params=comp,
-        num_devices=n_dev,
-    )
 
+    def make_tx(pb=None):
+        return bps.DistributedOptimizer(
+            optax.sgd(args.lr, momentum=0.9), compression_params=comp,
+            num_devices=n_dev, partition_bytes=pb,
+        )
+
+    tx = make_tx()
     params = mlp_init(jax.random.PRNGKey(0))
     opt_state = tx.init(params)
     pspecs = jax.tree.map(lambda _: P(), params)
@@ -82,18 +85,28 @@ def main():
     if opt_state.momentum is not None:
         ospecs = ospecs._replace(momentum=P("dp"))
 
-    def per_device(params, opt_state, x, y):
-        loss, grads = jax.value_and_grad(mlp_loss)(params, x, y)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return jax.lax.pmean(loss, "dp"), params, opt_state
+    def build_step(pb):
+        tx = make_tx(pb)
 
-    step = jax.jit(jax.shard_map(
-        per_device, mesh=mesh,
-        in_specs=(pspecs, ospecs, P("dp"), P("dp")),
-        out_specs=(P(), pspecs, ospecs),
-        check_vma=False,
-    ), donate_argnums=(0, 1))
+        def per_device(params, opt_state, x, y):
+            loss, grads = jax.value_and_grad(mlp_loss)(params, x, y)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return jax.lax.pmean(loss, "dp"), params, opt_state
+
+        return jax.jit(jax.shard_map(
+            per_device, mesh=mesh,
+            in_specs=(pspecs, ospecs, P("dp"), P("dp")),
+            out_specs=(P(), pspecs, ospecs),
+            check_vma=False,
+        ), donate_argnums=(0, 1))
+
+    # BYTEPS_AUTO_TUNE=1: online partition-size search, retracing the step
+    # as the tuner moves (ByteScheduler's tuner on the fused path)
+    if bps.auto_tune_enabled():
+        step = bps.AutoTunedStep(build_step, bps.default_partition_bytes())
+    else:
+        step = build_step(None)
 
     bsh = NamedSharding(mesh, P("dp"))
     for i in range(args.steps):
@@ -102,6 +115,12 @@ def main():
         loss, params, opt_state = step(params, opt_state, x, y)
         if i % 10 == 0 or i == args.steps - 1:
             print(f"step {i}: loss={float(loss):.4f}", flush=True)
+    if bps.auto_tune_enabled():
+        print(
+            f"tuner: converged={step.tuner.converged} "
+            f"partition={step.partition_bytes >> 10}KB "
+            f"retraces={step.retraces}", flush=True,
+        )
     x, y = synthetic_mnist(jax.random.PRNGKey(999), 2048)
     h = jax.nn.relu(x @ params["w1"] + params["b1"])
     h = jax.nn.relu(h @ params["w2"] + params["b2"])
