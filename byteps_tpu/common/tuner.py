@@ -124,23 +124,32 @@ class AutoTuner:
             # revert and rotate direction/knob
             self._current = self._best
             self._exhausted += 1
-            if self._direction > 0:
-                self._direction = -1
-            else:
-                self._direction = +1
-                self._knob_i = (self._knob_i + 1) % len(self._knobs)
-        if self._exhausted >= 2 * len(self._knobs):
-            self.converged = True
-            self._apply(self._best.partition_bytes, self._best.credit)
-            log.info("tuner converged: partition=%dKB credit=%d",
-                     self._best.partition_bytes >> 10, self._best.credit)
-            return
-        nxt = self._neighbor()
-        if nxt is None:
+            self._rotate()
+        # Find the next candidate, skipping grid-edge dead ends WITHOUT
+        # spending a measurement on them: starting at the top of the grid,
+        # the +1 direction is exhausted for free and the -1 neighbor still
+        # gets its fair evaluation before convergence can fire.
+        while True:
+            if self._exhausted >= 2 * len(self._knobs):
+                self.converged = True
+                self._apply(self._best.partition_bytes, self._best.credit)
+                log.info("tuner converged: partition=%dKB credit=%d",
+                         self._best.partition_bytes >> 10, self._best.credit)
+                return
+            nxt = self._neighbor()
+            if nxt is not None:
+                break
             self._exhausted += 1
-            nxt = self._current
+            self._rotate()
         self._current = nxt
         self._apply(self._current.partition_bytes, self._current.credit)
+
+    def _rotate(self) -> None:
+        if self._direction > 0:
+            self._direction = -1
+        else:
+            self._direction = +1
+            self._knob_i = (self._knob_i + 1) % len(self._knobs)
 
     def _neighbor(self) -> Optional[_Candidate]:
         c = self._current

@@ -123,8 +123,26 @@ def _spawn_workers(cmd: List[str]) -> int:
     return rc
 
 
+_USAGE = """\
+bpslaunch — BytePS-TPU job launcher (reference: launcher/launch.py)
+
+Usage:
+  DMLC_ROLE=server  DMLC_NUM_WORKER=N ... bpslaunch
+  DMLC_ROLE=worker  DMLC_WORKER_ID=i ... bpslaunch python train.py [args...]
+
+Role comes from DMLC_ROLE (worker | server | scheduler | joint). The worker
+role spawns BYTEPS_LOCAL_SIZE copies of the given command with per-child
+rank env and tears the job down if any child fails; with
+BYTEPS_JAX_DISTRIBUTED=1 it also interposes the jax.distributed bootstrap
+so one global mesh spans all workers. See docs/env.md for every variable.
+"""
+
+
 def main(argv: List[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in ("-h", "--help"):
+        print(_USAGE)
+        return 0
     cfg = get_config()
     role = cfg.role.lower()
     if role == "server":

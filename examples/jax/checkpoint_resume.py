@@ -9,11 +9,6 @@ across pods.
 """
 
 import argparse
-import os
-import sys
-
-sys.path.insert(0, os.path.abspath(
-    os.path.join(os.path.dirname(__file__), "..", "..")))
 
 import jax
 import jax.numpy as jnp

@@ -9,10 +9,6 @@ Runs on a TPU slice or on virtual CPU devices:
 
 import argparse
 import os
-import sys
-
-sys.path.insert(0, os.path.abspath(
-    os.path.join(os.path.dirname(__file__), "..", "..")))
 
 import jax
 

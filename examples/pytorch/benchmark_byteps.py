@@ -6,12 +6,7 @@ ResNet-50-sized gradient set, and raw push_pull GB/s.
 """
 
 import argparse
-import os
-import sys
 import time
-
-sys.path.insert(0, os.path.abspath(
-    os.path.join(os.path.dirname(__file__), "..", "..")))
 
 import numpy as np
 import torch

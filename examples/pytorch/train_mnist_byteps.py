@@ -14,11 +14,6 @@ Run (per worker, plus a server process):
 """
 
 import argparse
-import os
-import sys
-
-sys.path.insert(0, os.path.abspath(
-    os.path.join(os.path.dirname(__file__), "..", "..")))
 
 import torch
 import torch.nn as nn

@@ -4,13 +4,9 @@ with DistributedGradientTape)."""
 
 import argparse
 import os
-import sys
 import time
 
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
-sys.path.insert(0, os.path.abspath(
-    os.path.join(os.path.dirname(__file__), "..", "..")))
-
 import numpy as np
 import tensorflow as tf
 

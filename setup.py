@@ -1,0 +1,38 @@
+"""Build hook: compile the native DCN summation library into the package.
+
+Reference analog: the reference's setup.py builds its C++ core as a CPython
+extension. Here the native boundary is a plain shared library driven via
+ctypes (no pybind11 in the supported toolchain), so the build step is the
+same ``make`` the first-import path uses — wheels ship the .so, editable
+installs and source checkouts build lazily on first use
+(byteps_tpu/server/native.py).
+"""
+
+import os
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class build_py_with_native(build_py):
+    def run(self):
+        csrc = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "byteps_tpu", "server", "csrc",
+        )
+        if os.path.exists(os.path.join(csrc, "Makefile")):
+            # Best-effort: the .so only serves the DCN server tier, and
+            # native.py rebuilds it lazily on first use — a missing
+            # toolchain must not block installing the JAX/ICI-only paths.
+            try:
+                subprocess.run(["make", "-C", csrc, "-j4"], check=True)
+            except (OSError, subprocess.CalledProcessError) as e:
+                print(
+                    f"WARNING: native DCN server build skipped ({e}); "
+                    "it will be built on first use (requires make + g++)"
+                )
+        super().run()
+
+
+setup(cmdclass={"build_py": build_py_with_native})

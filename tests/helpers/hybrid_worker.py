@@ -6,11 +6,9 @@ push_pull must return the global sum across pods × pod devices
 """
 
 import os
-import sys
 
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 os.environ["JAX_PLATFORMS"] = "cpu"
-sys.path.insert(0, os.environ["BPS_REPO"])
 
 import jax
 

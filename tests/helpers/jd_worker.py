@@ -9,11 +9,9 @@ compares across ranks.
 """
 
 import os
-import sys
 
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 os.environ["JAX_PLATFORMS"] = "cpu"
-sys.path.insert(0, os.environ["BPS_REPO"])
 
 import jax
 

@@ -2,10 +2,8 @@
 (mirrors tests/helpers/torch_worker.py)."""
 
 import os
-import sys
 
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
-sys.path.insert(0, os.environ["BPS_REPO"])
 
 import numpy as np
 import tensorflow as tf

@@ -7,10 +7,6 @@ Asserts (reference test strategy, SURVEY §4):
     the single-process gold run on the combined batch.
 """
 
-import os
-import sys
-
-sys.path.insert(0, os.environ["BPS_REPO"])
 
 import numpy as np
 import torch

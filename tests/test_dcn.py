@@ -113,16 +113,19 @@ def test_key_sharding_across_servers():
     import sys
 
     start_server(port=p1, num_workers=1, engine_threads=1, async_mode=False)
-    proc = subprocess.Popen([
-        sys.executable, "-c",
-        "import sys; sys.path.insert(0, %r);"
-        "from byteps_tpu.server import start_server, serve_forever;"
-        "from byteps_tpu.server.native import load_lib;"
-        "start_server(port=%d, num_workers=1, engine_threads=1,"
-        "async_mode=False); load_lib().bps_server_wait()"
-        % (__import__("os").path.dirname(__import__("os").path.dirname(
-            __import__("os").path.abspath(__file__))), p2),
-    ])
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-c",
+            "from byteps_tpu.server import start_server;"
+            "from byteps_tpu.server.native import load_lib;"
+            "start_server(port=%d, num_workers=1, engine_threads=1,"
+            "async_mode=False); load_lib().bps_server_wait()" % p2,
+        ],
+        env={**os.environ, "PYTHONPATH": repo},
+    )
     try:
         w = PSWorker(servers=[("127.0.0.1", p1), ("127.0.0.1", p2)])
         rng = np.random.default_rng(2)

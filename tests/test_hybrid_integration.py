@@ -14,7 +14,6 @@ PORT = 19800
 def test_two_pods_hybrid_push_pull():
     env_base = {
         **os.environ,
-        "BPS_REPO": REPO,
         "PYTHONPATH": REPO,
         "DMLC_NUM_WORKER": "2",
         "DMLC_NUM_SERVER": "1",
@@ -58,7 +57,6 @@ def test_two_pods_hybrid_compressed_wire():
     (reference: server decompress→fp32-sum→recompress, SURVEY §2.2/§3.3)."""
     env_base = {
         **os.environ,
-        "BPS_REPO": REPO,
         "PYTHONPATH": REPO,
         "DMLC_NUM_WORKER": "2",
         "DMLC_NUM_SERVER": "1",

@@ -26,7 +26,7 @@ def _free_port() -> int:
 def _env(port: int, wid=None):
     env = dict(os.environ)
     env.update({
-        "BPS_REPO": REPO,
+        "PYTHONPATH": REPO,
         "DMLC_ROLE": "worker",
         "DMLC_NUM_WORKER": "2",
         "DMLC_PS_ROOT_URI": "127.0.0.1",

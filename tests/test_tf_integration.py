@@ -17,7 +17,6 @@ PORT = 19900
 def test_two_tf_workers_one_server():
     env_base = {
         **os.environ,
-        "BPS_REPO": REPO,
         "PYTHONPATH": REPO,
         "DMLC_NUM_WORKER": "2",
         "DMLC_NUM_SERVER": "1",

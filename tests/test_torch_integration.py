@@ -18,7 +18,6 @@ def _env(role: str, port: int, worker_id: int = 0, num_workers: int = 2,
          local_size: int = 1):
     env = dict(os.environ)
     env.update({
-        "BPS_REPO": REPO,
         "PYTHONPATH": REPO,
         "DMLC_ROLE": role,
         "DMLC_NUM_WORKER": str(num_workers),

@@ -18,7 +18,6 @@ def test_hybrid_traces_and_merge(tmp_path):
     trace_dir = str(tmp_path)
     env_base = {
         **os.environ,
-        "BPS_REPO": REPO,
         "PYTHONPATH": REPO,
         "DMLC_NUM_WORKER": "2",
         "DMLC_NUM_SERVER": "1",
@@ -91,6 +90,7 @@ def test_mnist_example_fused_trace(tmp_path):
     writes a non-empty trace with per-step dispatch markers."""
     env = {
         **os.environ,
+        "PYTHONPATH": REPO,
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
         "BYTEPS_TRACE_ON": "1",
