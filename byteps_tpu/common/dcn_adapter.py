@@ -10,6 +10,7 @@ Framework adapters only convert tensors to/from flat numpy fp32.
 from __future__ import annotations
 
 import threading
+import zlib
 from typing import Optional
 
 import numpy as np
@@ -24,9 +25,22 @@ from byteps_tpu.common.scheduler import (
     Stage,
 )
 from byteps_tpu.common.tracing import get_tracer
+from byteps_tpu.compression.wire import Fp16Wire, WireCodec, WirePlan
 from byteps_tpu.server import PSWorker
 
 log = get_logger("dcn_adapter")
+
+
+def wire_codec_for(compression: Optional[str]) -> Optional[WireCodec]:
+    """Map a host adapter's ``Compression`` choice onto a DCN wire codec
+    (reference: byteps/torch/compression.py — fp16 halves actual wire
+    bytes, it is not a round-trip simulation)."""
+    if compression in (None, "none", ""):
+        return None
+    if compression == "fp16":
+        return Fp16Wire()
+    raise ValueError(f"unknown compression {compression!r}; "
+                     "host adapters support 'none' or 'fp16'")
 
 
 class DcnCore:
@@ -47,13 +61,26 @@ class DcnCore:
         )
         self._inited_keys = set()
         self._key_lock = threading.Lock()
+        self._versions = {}
         self.worker.barrier()
+
+    @staticmethod
+    def _wire_seed(name: str, version: int, part_idx: int) -> int:
+        """Deterministic per (tensor, round, partition) codec seed, agreed
+        across workers (same derivation as the jax hybrid pipeline)."""
+        base = zlib.crc32(name.encode()) & 0xFFFFFFFF
+        return (base * 1000003 + version * 8191 + part_idx) % (2 ** 63)
 
     # -- stages -------------------------------------------------------------
     def _push_stage(self, task: PartitionTask):
         p = task.partition
         flat: np.ndarray = task.context["flat"]
         chunk = np.ascontiguousarray(flat[p.offset:p.offset + p.length])
+        plan: Optional[WirePlan] = task.context["plans"][p.part_idx]
+        store_bytes = (
+            plan.codec.store_elems(p.length) * 4 if plan is not None
+            else p.length * 4
+        )
         with self._key_lock:
             needs_init = p.key not in self._inited_keys
             if needs_init:
@@ -62,21 +89,52 @@ class DcnCore:
             # no cross-worker barrier needed: server-side init is idempotent
             # and never resets an existing store, so only THIS worker's init
             # must precede its own push (serial on this connection)
-            self.worker.init_key(p.key, p.length * 4)
-        return self.worker.push(p.key, chunk)
+            self.worker.init_key(p.key, store_bytes)
+        if plan is None:
+            return self.worker.push(p.key, chunk)
+        payload = plan.codec.encode(
+            chunk,
+            self._wire_seed(task.name, task.context["version"], p.part_idx),
+        )
+        return self.worker.push_bytes(p.key, payload, plan.codec.codec_id)
 
     def _pull_stage(self, task: PartitionTask):
         p = task.partition
-        return self.worker.pull(p.key, p.length, task.payload)
+        plan: Optional[WirePlan] = task.context["plans"][p.part_idx]
+        if plan is None:
+            return self.worker.pull(p.key, p.length, task.payload)
+        buf = self.worker.pull_bytes(
+            p.key, plan.pull_capacity(p.length), task.payload,
+            plan.pull_codec_id,
+        )
+        return plan.decode_pull(
+            np.ascontiguousarray(buf), p.length,
+            self._wire_seed(task.name, task.context["version"], p.part_idx),
+        )
 
     # -- public -------------------------------------------------------------
     def push_pull_async(self, flat: np.ndarray, name: str,
-                        priority: Optional[int] = None) -> Handle:
+                        priority: Optional[int] = None,
+                        codec: Optional[WireCodec] = None,
+                        two_way: bool = True) -> Handle:
         """Enqueue a flat fp32 vector; returns a Handle whose results are
-        per-partition summed numpy chunks."""
+        per-partition summed numpy chunks. ``codec`` compresses the DCN wire
+        per partition (the server decodes, fp32-sums, re-encodes);
+        partitions below BYTEPS_MIN_COMPRESS_BYTES ride raw fp32, matching
+        the jax hybrid pipeline and the reference's
+        BYTEPS_MIN_COMPRESS_BYTES semantics."""
         ctx = self.registry.declare(name, (flat.size,), np.float32)
+        with self._key_lock:
+            version = self._versions.get(name, 0)
+            self._versions[name] = version + 1
+        plans = [
+            None
+            if codec is None or p.length * 4 < self.cfg.min_compress_bytes
+            else WirePlan(codec, two_way)
+            for p in ctx.partitions
+        ]
         handle = Handle(name, len(ctx.partitions))
-        shared = {"flat": flat}
+        shared = {"flat": flat, "plans": plans, "version": version}
         tasks = []
         for p in ctx.partitions:
             if priority is not None:

@@ -1,12 +1,13 @@
-"""byteps_tpu.mxnet — MXNet adapter surface (gated).
+"""byteps_tpu.mxnet — MXNet adapter (gated on mxnet being installed).
 
 Reference analog: ``byteps/mxnet/`` (DistributedTrainer over gluon,
 byteps_declare_tensor + push_pull in ``_allreduce_grads``). MXNet reached
 end-of-life upstream (retired from Apache in 2023) and is not part of this
-image's supported stack; the adapter surface is declared for reference
-parity and raises with guidance at import-use time. The torch and
-tensorflow adapters cover the host-framework capability; ``byteps_tpu.jax``
-is the native path.
+image's supported stack, so the real surface (``byteps_tpu/mxnet/adapter.py``,
+built on the same ``DcnCore`` transport the torch/TF adapters share) loads
+only where a user vendors mxnet themselves; without it, any attribute access
+raises ImportError with guidance instead of failing deep inside a train
+script.
 """
 
 from __future__ import annotations
@@ -14,18 +15,34 @@ from __future__ import annotations
 _MSG = (
     "MXNet is end-of-life and not installed in this environment. Use "
     "byteps_tpu.torch, byteps_tpu.tensorflow, or byteps_tpu.jax instead. "
-    "(If you vendor MXNet yourself, the DcnCore in "
-    "byteps_tpu/common/dcn_adapter.py is the integration point — see the "
-    "torch adapter for the ~200-line pattern.)"
+    "(With a vendored mxnet on sys.path this package exposes the full "
+    "byteps/mxnet surface: init, push_pull, broadcast_parameters, "
+    "DistributedTrainer — see byteps_tpu/mxnet/adapter.py.)"
 )
 
-try:  # pragma: no cover - exercised only where mxnet exists
+try:
     import mxnet  # noqa: F401
 
     _HAVE_MXNET = True
 except ImportError:
     _HAVE_MXNET = False
 
-
-def __getattr__(name: str):
-    raise ImportError(_MSG)
+if _HAVE_MXNET:  # pragma: no cover - exercised only where mxnet exists
+    from byteps_tpu.mxnet.adapter import (  # noqa: F401
+        Compression,
+        DistributedTrainer,
+        broadcast_parameters,
+        byteps_declare_tensor,
+        init,
+        local_rank,
+        local_size,
+        push_pull,
+        push_pull_async,
+        rank,
+        shutdown,
+        size,
+        synchronize,
+    )
+else:
+    def __getattr__(name: str):
+        raise ImportError(_MSG)

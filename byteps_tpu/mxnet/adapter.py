@@ -1,0 +1,211 @@
+"""byteps_tpu.mxnet real surface — imported only when mxnet is installed.
+
+Reference analog: ``byteps/mxnet/__init__.py`` — ``DistributedTrainer``
+subclasses ``mx.gluon.Trainer`` and overrides ``_allreduce_grads`` to
+push_pull each parameter's gradient (name ``byteps_push_pull.<i>``,
+priority −i), with grad scaling folded into the trainer's rescale;
+``broadcast_parameters`` replicates root's weights. The transport is the
+same credit-scheduled partition pipeline over the native DCN summation
+servers that the torch/TF adapters use (``DcnCore``), so every wire
+behavior (partitioning, priorities, validation, timeouts) is shared and
+integration-tested there.
+
+MXNet is EOL upstream (retired to the Apache attic in 2023) and absent
+from this image, so this module is exercised only where a user vendors
+mxnet; the gate lives in ``byteps_tpu/mxnet/__init__.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Tuple
+
+import mxnet as mx
+import numpy as np
+
+from byteps_tpu.common.config import get_config
+from byteps_tpu.common.dcn_adapter import DcnCore, wire_codec_for
+from byteps_tpu.common.logging import bps_check, get_logger
+from byteps_tpu.common.scheduler import Handle
+
+log = get_logger("mxnet")
+
+
+class Compression:
+    """Compression choices for the DCN wire (parity with byteps/mxnet);
+    ``fp16`` uses the real binary16 wire codec — halved wire bytes."""
+
+    none = "none"
+    fp16 = "fp16"
+
+
+class _MxState:
+    def __init__(self) -> None:
+        self.initialized = False
+        self.cfg = None
+        self.core: Optional[DcnCore] = None
+
+
+_state = _MxState()
+
+
+def init() -> None:
+    """Reference: ``byteps.mxnet.init`` (env-driven rendezvous)."""
+    if _state.initialized:
+        return
+    _state.cfg = get_config()
+    _state.core = DcnCore()
+    _state.initialized = True
+    log.info("byteps_tpu.mxnet initialized: worker %d/%d",
+             _state.cfg.worker_id, _state.cfg.num_worker)
+
+
+def shutdown() -> None:
+    if not _state.initialized:
+        return
+    _state.core.shutdown()
+    _state.initialized = False
+
+
+def _require_init() -> None:
+    bps_check(_state.initialized, "call byteps_tpu.mxnet.init() first")
+
+
+def rank() -> int:
+    _require_init()
+    return _state.cfg.worker_id
+
+
+def size() -> int:
+    _require_init()
+    return _state.cfg.num_worker
+
+
+def local_rank() -> int:
+    _require_init()
+    return _state.cfg.local_rank
+
+
+def local_size() -> int:
+    _require_init()
+    return _state.cfg.local_size
+
+
+def byteps_declare_tensor(name: str, shape: Tuple[int, ...]) -> None:
+    """Fix a tensor's declaration (and thus priority) order explicitly
+    (reference: ``byteps_declare_tensor``)."""
+    _require_init()
+    n = int(np.prod(shape)) if shape else 1
+    _state.core.registry.declare(f"byteps_push_pull.{name}", (n,), np.float32)
+
+
+# --- push_pull ---------------------------------------------------------------
+def push_pull_async(
+    tensor: "mx.nd.NDArray",
+    average: bool = True,
+    name: Optional[str] = None,
+    priority: Optional[int] = None,
+    compression: str = Compression.none,
+) -> Handle:
+    """In-place async sum (mean) of an NDArray across workers
+    (reference: ``byteps_push_pull`` on ``param.list_grad()[0]``)."""
+    _require_init()
+    bps_check(name is not None,
+              "byteps_tpu.mxnet.push_pull requires a tensor name (keys must "
+              "agree across workers)")
+    flat = tensor.asnumpy().astype(np.float32).ravel()
+    handle = _state.core.push_pull_async(
+        flat, name, priority, codec=wire_codec_for(compression)
+    )
+    handle.nd = tensor            # type: ignore[attr-defined]
+    handle.average = average      # type: ignore[attr-defined]
+    return handle
+
+
+def synchronize(handle: Handle, timeout: Optional[float] = 120.0):
+    """Wait and write the aggregated value back into the NDArray."""
+    flat = DcnCore.assemble(handle, timeout)
+    if handle.average:  # type: ignore[attr-defined]
+        flat = flat / size()
+    nd = handle.nd      # type: ignore[attr-defined]
+    nd[:] = mx.nd.array(flat.reshape(nd.shape), dtype=nd.dtype)
+    return nd
+
+
+def push_pull(
+    tensor: "mx.nd.NDArray",
+    average: bool = True,
+    name: Optional[str] = None,
+    priority: Optional[int] = None,
+    compression: str = Compression.none,
+):
+    return synchronize(
+        push_pull_async(tensor, average, name, priority, compression)
+    )
+
+
+# --- broadcast ---------------------------------------------------------------
+def broadcast_parameters(
+    params: Iterable[Tuple[str, "mx.nd.NDArray"]] | Dict[str, "mx.nd.NDArray"],
+    root_rank: int = 0,
+) -> None:
+    """Replicate root's values to all workers, in place (zero-on-non-root +
+    summed push_pull — the reference's own construction). Accepts a dict of
+    arrays or a gluon ``ParameterDict``-style iterable."""
+    _require_init()
+    if hasattr(params, "items"):
+        items = list(params.items())
+    else:
+        items = list(params)
+    handles = []
+    for pname, p in items:
+        if p is None:
+            continue
+        # gluon Parameter → its first-context data array
+        if hasattr(p, "list_data"):
+            p = p.list_data()[0]
+        if rank() != root_rank:
+            p[:] = 0
+        handles.append(push_pull_async(
+            p, average=False, name=f"byteps_broadcast.{pname}"
+        ))
+    for h in handles:
+        synchronize(h)
+
+
+# --- DistributedTrainer ------------------------------------------------------
+class DistributedTrainer(mx.gluon.Trainer):
+    """Gluon trainer whose ``_allreduce_grads`` push_pulls every gradient
+    through the summation servers (reference: byteps/mxnet
+    DistributedTrainer; kvstore is forced off, the DCN tier replaces it).
+
+    Gradient averaging follows the reference: the wire carries sums and the
+    trainer's rescale divides by ``size()``.
+    """
+
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 root_rank: int = 0,
+                 compression: str = Compression.none):
+        _require_init()
+        super().__init__(params, optimizer, optimizer_params, kvstore=None)
+        self._bps_compression = compression
+        self.root_rank = root_rank
+        # reference: fold 1/size into the optimizer's grad rescale so the
+        # summed wire value lands as a mean
+        self._scale /= size()
+        # declaration order = parameter order → identical priorities on
+        # every worker before any backward pass runs
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                byteps_declare_tensor(str(i), param.shape)
+
+    def _allreduce_grads(self):
+        handles = []
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                handles.append(push_pull_async(
+                    param.list_grad()[0], average=False,
+                    name=f"byteps_push_pull.{i}", priority=-i,
+                    compression=self._bps_compression,
+                ))
+        for h in handles:
+            synchronize(h)

@@ -49,7 +49,15 @@ def load_lib() -> ctypes.CDLL:
             return _lib
         if not os.path.exists(_SO):
             _build()
-        lib = ctypes.CDLL(_SO)
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            # wheel built on another platform shipped a foreign .so —
+            # rebuild from the packaged sources for THIS machine
+            log.warning("packaged native library unloadable; rebuilding")
+            os.remove(_SO)
+            _build()
+            lib = ctypes.CDLL(_SO)
         lib.bps_server_start.argtypes = [
             ctypes.c_uint16, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_int,

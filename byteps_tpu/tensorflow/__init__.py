@@ -18,11 +18,21 @@ import numpy as np
 import tensorflow as tf
 
 from byteps_tpu.common.config import get_config
-from byteps_tpu.common.dcn_adapter import DcnCore
+from byteps_tpu.common.dcn_adapter import DcnCore, wire_codec_for
 from byteps_tpu.common.logging import bps_check, get_logger
 from byteps_tpu.common.scheduler import Handle
 
 log = get_logger("tensorflow")
+
+
+class Compression:
+    """Compression choices for the DCN wire (reference:
+    byteps/tensorflow/compression.py). ``fp16`` rides the real binary16
+    wire codec — halved push/pull bytes; partitions under
+    BYTEPS_MIN_COMPRESS_BYTES stay raw fp32."""
+
+    none = "none"
+    fp16 = "fp16"
 
 
 class _TfState:
@@ -79,7 +89,8 @@ def local_size() -> int:
 
 def push_pull_async(tensor: tf.Tensor, average: bool = True,
                     name: Optional[str] = None,
-                    priority: Optional[int] = None) -> Handle:
+                    priority: Optional[int] = None,
+                    compression: str = Compression.none) -> Handle:
     """Async sum/mean across workers; returns a Handle for
     :func:`synchronize` (reference: the BytePSPushPull AsyncOpKernel)."""
     _require_init()
@@ -87,7 +98,9 @@ def push_pull_async(tensor: tf.Tensor, average: bool = True,
                                 "a tensor name (keys must agree across "
                                 "workers)")
     flat = np.asarray(tf.reshape(tf.cast(tensor, tf.float32), [-1]))
-    handle = _state.core.push_pull_async(flat, name, priority)
+    handle = _state.core.push_pull_async(
+        flat, name, priority, codec=wire_codec_for(compression)
+    )
     handle.shape = tensor.shape        # type: ignore[attr-defined]
     handle.dtype = tensor.dtype        # type: ignore[attr-defined]
     handle.average = average           # type: ignore[attr-defined]
@@ -104,8 +117,11 @@ def synchronize(handle: Handle, timeout: Optional[float] = 120.0) -> tf.Tensor:
 
 def push_pull(tensor: tf.Tensor, average: bool = True,
               name: Optional[str] = None,
-              priority: Optional[int] = None) -> tf.Tensor:
-    return synchronize(push_pull_async(tensor, average, name, priority))
+              priority: Optional[int] = None,
+              compression: str = Compression.none) -> tf.Tensor:
+    return synchronize(
+        push_pull_async(tensor, average, name, priority, compression)
+    )
 
 
 class DistributedGradientTape:
@@ -113,8 +129,10 @@ class DistributedGradientTape:
     (averaged) gradients (reference: DistributedGradientTape for eager
     mode)."""
 
-    def __init__(self, tape: tf.GradientTape, compression=None):
+    def __init__(self, tape: tf.GradientTape,
+                 compression: str = Compression.none):
         self._tape = tape
+        self._compression = compression
 
     def __getattr__(self, item):
         return getattr(self._tape, item)
@@ -131,6 +149,7 @@ class DistributedGradientTape:
                 g = tf.convert_to_tensor(g)
             handles.append(push_pull_async(
                 g, average=True, name=f"byteps_push_pull.grad_{i}",
+                compression=self._compression,
             ))
         return [None if h is None else synchronize(h) for h in handles]
 
@@ -140,9 +159,10 @@ class DistributedOptimizer(tf.keras.optimizers.Optimizer):
     first (reference: DistributedOptimizer wrapping compute_gradients)."""
 
     def __init__(self, optimizer, name: str = "BytePSDistributedOptimizer",
-                 **kwargs):
+                 compression: str = Compression.none, **kwargs):
         super().__init__(name=name, learning_rate=1.0)
         self._opt = optimizer
+        self._compression = compression
 
     def apply_gradients(self, grads_and_vars, **kwargs):
         gv = list(grads_and_vars)
@@ -158,6 +178,7 @@ class DistributedOptimizer(tf.keras.optimizers.Optimizer):
             vname = getattr(v, "path", v.name).replace(":", "_")
             handles.append(push_pull_async(
                 g, average=True, name=f"byteps_push_pull.{vname}",
+                compression=self._compression,
             ))
         new_gv = [
             (g if h is None else synchronize(h), v)

@@ -20,7 +20,7 @@ import numpy as np
 import torch
 
 from byteps_tpu.common.config import get_config
-from byteps_tpu.common.dcn_adapter import DcnCore
+from byteps_tpu.common.dcn_adapter import DcnCore, wire_codec_for
 from byteps_tpu.common.logging import bps_check, get_logger
 from byteps_tpu.common.scheduler import Handle
 
@@ -28,10 +28,11 @@ log = get_logger("torch")
 
 
 class Compression:
-    """Python-level compression shim for API parity (reference:
-    byteps/torch/compression.py). ``fp16`` rounds gradients through float16
-    before the fp32 wire push (wire stays fp32 on this tier; the real
-    compressed wire formats live in the ICI-tier Pallas/XLA path)."""
+    """Compression choices for the DCN wire (reference:
+    byteps/torch/compression.py). ``fp16`` rides the real binary16 wire
+    codec — every push and pull moves half the bytes; the server decodes,
+    fp32-sums, and re-encodes (partitions under BYTEPS_MIN_COMPRESS_BYTES
+    stay raw fp32)."""
 
     none = "none"
     fp16 = "fp16"
@@ -111,9 +112,9 @@ def push_pull_async(
               "agree across workers)")
     t = tensor.detach()
     flat = t.to(torch.float32).contiguous().view(-1).numpy()
-    if compression == Compression.fp16:
-        flat = flat.astype(np.float16).astype(np.float32)
-    handle = _state.core.push_pull_async(flat, name, priority)
+    handle = _state.core.push_pull_async(
+        flat, name, priority, codec=wire_codec_for(compression)
+    )
     handle.tensor = tensor          # type: ignore[attr-defined]
     handle.average = average        # type: ignore[attr-defined]
     return handle

@@ -67,6 +67,21 @@ def main():
         np.testing.assert_allclose(np.asarray(v), np.asarray(g),
                                    rtol=1e-4, atol=1e-5)
 
+    # 4. Compression.fp16 rides the real binary16 wire (2 bytes/element
+    # each way) when the test env enables it on small partitions
+    core = bps._state.core
+    nelems = 4096
+    before_push = core.worker.bytes_pushed
+    before_pull = core.worker.bytes_pulled
+    out = bps.push_pull(tf.fill((nelems,), float(r + 1)), average=False,
+                        name="t_fp16", compression=bps.Compression.fp16)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-3)
+    if core.cfg.min_compress_bytes == 0:
+        pushed = core.worker.bytes_pushed - before_push
+        pulled = core.worker.bytes_pulled - before_pull
+        assert pushed == nelems * 2, (pushed, nelems * 2)
+        assert pulled == nelems * 2, (pulled, nelems * 2)
+
     bps.shutdown()
     print(f"TF_WORKER_{r}_OK")
 

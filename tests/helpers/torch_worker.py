@@ -77,6 +77,25 @@ def main():
             p.detach().numpy(), q.detach().numpy(), rtol=1e-4, atol=1e-5,
         )
 
+    # 4. Compression.fp16 moves REAL binary16 wire bytes: exactly 2 bytes
+    # per element in each direction (not a round-trip simulation). The
+    # min-compress gate is per PARTITION, so the byte accounting only
+    # holds when the spawning test disables the threshold
+    # (BYTEPS_MIN_COMPRESS_BYTES=0 — test_torch_integration.py does).
+    core = bps._state.core
+    nelems = 32768
+    before_push = core.worker.bytes_pushed
+    before_pull = core.worker.bytes_pulled
+    xb = torch.full((nelems,), float(r + 1))
+    out = bps.push_pull(xb, average=False, name="t_fp16",
+                        compression=bps.Compression.fp16)
+    assert torch.allclose(out, torch.full((nelems,), want)), out[:4]
+    if core.cfg.min_compress_bytes == 0:
+        pushed = core.worker.bytes_pushed - before_push
+        pulled = core.worker.bytes_pulled - before_pull
+        assert pushed == nelems * 2, (pushed, nelems * 2)
+        assert pulled == nelems * 2, (pulled, nelems * 2)
+
     bps.shutdown()
     print(f"WORKER_{r}_OK")
 

@@ -1,0 +1,66 @@
+"""MXNet adapter gate + (where mxnet exists) functional round trip.
+
+MXNet is EOL and absent from this image, so the functional test skips
+here; the gate test asserts the honest failure mode the adapter promises:
+importing the package is safe, touching the surface without mxnet raises
+ImportError with guidance (never a silent stub).
+"""
+
+import importlib
+
+import pytest
+
+try:
+    import mxnet  # noqa: F401
+
+    HAVE_MXNET = True
+except ImportError:
+    HAVE_MXNET = False
+
+
+def test_gate_matches_mxnet_availability():
+    import byteps_tpu.mxnet as bpsmx
+
+    assert bpsmx._HAVE_MXNET == HAVE_MXNET
+
+
+@pytest.mark.skipif(HAVE_MXNET, reason="mxnet installed: surface is live")
+def test_missing_mxnet_raises_with_guidance():
+    import byteps_tpu.mxnet as bpsmx
+
+    for attr in ("DistributedTrainer", "push_pull", "init",
+                 "broadcast_parameters"):
+        with pytest.raises(ImportError, match="end-of-life"):
+            getattr(bpsmx, attr)
+
+
+@pytest.mark.skipif(not HAVE_MXNET, reason="mxnet not installed (EOL)")
+def test_push_pull_roundtrip_single_worker():
+    """1-worker push_pull through a local summation server must be the
+    identity (sum of one)."""
+    import numpy as np
+
+    from byteps_tpu.server import start_server, stop_server
+
+    port = 23700
+    start_server(port=port, num_workers=1, engine_threads=1,
+                 async_mode=False)
+    try:
+        import os
+
+        os.environ["DMLC_NUM_WORKER"] = "1"
+        os.environ["DMLC_NUM_SERVER"] = "1"
+        os.environ["DMLC_PS_ROOT_URI"] = "127.0.0.1"
+        os.environ["DMLC_PS_ROOT_PORT"] = str(port)
+        from byteps_tpu.common.config import reset_config
+
+        reset_config()
+        bpsmx = importlib.import_module("byteps_tpu.mxnet")
+        bpsmx.init()
+        x = mxnet.nd.array(np.arange(8, dtype=np.float32))
+        out = bpsmx.push_pull(x, average=True, name="t0")
+        np.testing.assert_allclose(out.asnumpy(),
+                                   np.arange(8, dtype=np.float32))
+        bpsmx.shutdown()
+    finally:
+        stop_server()

@@ -23,6 +23,7 @@ def test_two_tf_workers_one_server():
         "DMLC_PS_ROOT_URI": "127.0.0.1",
         "DMLC_PS_ROOT_PORT": str(PORT),
         "BYTEPS_PARTITION_BYTES": "256",
+        "BYTEPS_MIN_COMPRESS_BYTES": "0",
         "JAX_PLATFORMS": "cpu",
     }
     server = subprocess.Popen(

@@ -28,6 +28,9 @@ def _env(role: str, port: int, worker_id: int = 0, num_workers: int = 2,
         "BYTEPS_LOCAL_SIZE": str(local_size),
         # keep partitions small so multi-partition scheduling is exercised
         "BYTEPS_PARTITION_BYTES": "256",
+        # and let the fp16 wire kick in on those tiny partitions (the
+        # helper asserts exact 2-bytes-per-element wire accounting)
+        "BYTEPS_MIN_COMPRESS_BYTES": "0",
         "JAX_PLATFORMS": "cpu",
     })
     return env
