@@ -45,10 +45,10 @@ def _env_str(name: str, default: str) -> str:
 DEFAULT_PARTITION_BYTES = 4096000
 # Reference BYTEPS_SCHEDULING_CREDIT default (byteps/common/scheduled_queue.cc).
 DEFAULT_SCHEDULING_CREDIT = 4
-# Reference BYTEPS_NCCL_GROUP_SIZE default: number of ready partitions batched
-# into one NCCL group call. Our analog: partitions batched per collective
-# dispatch group.
-DEFAULT_GROUP_SIZE = 4
+# (The reference's BYTEPS_NCCL_GROUP_SIZE has no TPU analog: XLA's async
+# dispatch overlaps chunk collectives on the device stream and the credit
+# scheduler bounds in-flight partitions, which together subsume NCCL group
+# batching — the knob is intentionally not exposed.)
 DEFAULT_SERVER_ENGINE_THREADS = 4
 
 
@@ -85,7 +85,6 @@ class Config:
     local_size: int = 1
     partition_bytes: int = DEFAULT_PARTITION_BYTES
     scheduling_credit: int = DEFAULT_SCHEDULING_CREDIT
-    group_size: int = DEFAULT_GROUP_SIZE
     force_distributed: bool = False
     enable_async: bool = False
     enable_ipc: bool = False
@@ -136,7 +135,6 @@ class Config:
             local_size=_env_int("BYTEPS_LOCAL_SIZE", 1),
             partition_bytes=_env_int("BYTEPS_PARTITION_BYTES", DEFAULT_PARTITION_BYTES),
             scheduling_credit=_env_int("BYTEPS_SCHEDULING_CREDIT", DEFAULT_SCHEDULING_CREDIT),
-            group_size=_env_int("BYTEPS_GROUP_SIZE", _env_int("BYTEPS_NCCL_GROUP_SIZE", DEFAULT_GROUP_SIZE)),
             force_distributed=_env_bool("BYTEPS_FORCE_DISTRIBUTED"),
             enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),
             enable_ipc=_env_bool("BYTEPS_ENABLE_IPC"),

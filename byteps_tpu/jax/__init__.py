@@ -82,7 +82,6 @@ class _BytePSJaxState:
         self.mom_state: Dict[Any, jnp.ndarray] = {}
         self.base_rng = None
         self.anon_counter = 0
-        self.bcast_counter = 0
         self.lock = threading.Lock()
         self.tuner = None
         self.psworker = None        # DCN tier client (distributed mode)
@@ -218,7 +217,6 @@ def shutdown() -> None:
     _state.ef_state.clear()
     _state.mom_state.clear()
     _state.inited_keys.clear()
-    _state.bcast_counter = 0
 
 
 def _require_init() -> None:
@@ -661,14 +659,20 @@ def broadcast_parameters(params, root_rank: int = 0):
     root_pod, root_row = divmod(root_rank, n)
 
     if _state.cfg.is_distributed:
+        import zlib
+
         leaves, treedef = jax.tree.flatten(params)
-        # per-call unique name prefix: successive broadcasts (params, then
-        # optimizer state) have different leaf shapes, and registry names
-        # are declare-once. Workers issue broadcasts in the same order, so
-        # the counter stays aligned across pods.
-        with _state.lock:
-            call_id = _state.bcast_counter
-            _state.bcast_counter += 1
+        # Fixed key family per pytree signature: repeated broadcasts (the
+        # periodic-broadcast workload) reuse the same tensor names — and so
+        # the same server KeyStores and registry entries — instead of
+        # minting a fresh c{N} family per call that grows server memory
+        # without bound. Distinct structures (params vs optimizer state)
+        # hash to distinct families; workers derive the signature from the
+        # same pytree, so names agree across pods with no counter to align.
+        sig_src = repr(treedef) + repr(
+            [(tuple(leaf.shape), str(leaf.dtype)) for leaf in leaves]
+        )
+        sig = zlib.crc32(sig_src.encode()) & 0xFFFFFFFF
         handles = []
         for i, leaf in enumerate(leaves):
             bps_check(leaf.shape[0] == n, f"leading axis must be {n}")
@@ -678,9 +682,13 @@ def broadcast_parameters(params, root_rank: int = 0):
                 z = jnp.where(mask, leaf, jnp.zeros_like(leaf))
             else:
                 z = jnp.zeros_like(leaf)
-            # fp32 wire: int leaves survive exactly below 2^24
+            # fp32 wire: int leaves survive exactly below 2^24; broadcasts
+            # never ride a lossy codec (params must replicate bit-faithfully
+            # even when gradient compression is configured globally)
             handles.append(push_pull_async(
-                z, average=False, name=f"byteps_broadcast.c{call_id}.{i}"))
+                z, average=False,
+                name=f"byteps_broadcast.s{sig:08x}.{i}",
+                compression_params={}))
         outs = [synchronize(h) for h in handles]
         return jax.tree.unflatten(treedef, outs)
 

@@ -92,10 +92,11 @@ def local_size() -> int:
 
 def byteps_declare_tensor(name: str, shape: Tuple[int, ...]) -> None:
     """Fix a tensor's declaration (and thus priority) order explicitly
-    (reference: ``byteps_declare_tensor``)."""
+    (reference: ``byteps_declare_tensor``). ``name`` must be the same name
+    later passed to :func:`push_pull` — it is registered verbatim."""
     _require_init()
     n = int(np.prod(shape)) if shape else 1
-    _state.core.registry.declare(f"byteps_push_pull.{name}", (n,), np.float32)
+    _state.core.registry.declare(name, (n,), np.float32)
 
 
 # --- push_pull ---------------------------------------------------------------
@@ -193,10 +194,15 @@ class DistributedTrainer(mx.gluon.Trainer):
         # summed wire value lands as a mean
         self._scale /= size()
         # declaration order = parameter order → identical priorities on
-        # every worker before any backward pass runs
+        # every worker before any backward pass runs. Deferred-shape gluon
+        # parameters (unknown dims are 0 before the first forward) cannot
+        # be sized yet — they attach at first push instead, identically on
+        # every worker, so priorities still agree.
         for i, param in enumerate(self._params):
-            if param.grad_req != "null":
-                byteps_declare_tensor(str(i), param.shape)
+            if param.grad_req != "null" and all(
+                d > 0 for d in (param.shape or ())
+            ):
+                byteps_declare_tensor(f"byteps_push_pull.{i}", param.shape)
 
     def _allreduce_grads(self):
         handles = []

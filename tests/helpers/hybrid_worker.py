@@ -101,10 +101,22 @@ def main():
     np.testing.assert_allclose(np.asarray(got["w"]), 5.0, rtol=1e-6)
 
     # second broadcast with DIFFERENT leaf shapes (params → optimizer state
-    # workflow; regression: per-call unique names, no re-declare crash)
+    # workflow; distinct signature family, no re-declare crash)
     opt_like = {"mu": x[:, :7] + wid, "count": jnp.zeros((4, 1)) + wid}
     got2 = bps.broadcast_parameters(opt_like, root_rank=0)
     np.testing.assert_allclose(np.asarray(got2["count"]), 0.0, atol=1e-6)
+
+    # periodic-broadcast workload: repeated broadcasts must REUSE the fixed
+    # signature-keyed families — registry entries and server keys bounded,
+    # no per-call growth (round-1/2 leak: fresh c{N} names every call)
+    n_names = len(bps._state.registry._by_name)
+    n_keys = len(bps._state.inited_keys)
+    for _ in range(25):
+        got = bps.broadcast_parameters(params, root_rank=5)
+        bps.broadcast_parameters(opt_like, root_rank=0)
+    np.testing.assert_allclose(np.asarray(got["w"]), 5.0, rtol=1e-6)
+    assert len(bps._state.registry._by_name) == n_names, "registry grew"
+    assert len(bps._state.inited_keys) == n_keys, "server keys grew"
 
     # multi-partition tensor (exercises partitioned DCN pipeline): with
     # BYTEPS_PARTITION_BYTES small, this splits into many chunks
