@@ -104,16 +104,54 @@ def _pack_pallas(x2d: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
     return jax.lax.bitcast_convert_type(out[0], jnp.uint32)
 
 
+# Above this K the unpack-sum switches from a fully unrolled body to a
+# grid axis over K: unrolling is fastest for mesh-axis-sized K (one VMEM
+# pass, no revisits) but its program size — and Mosaic compile time —
+# grows linearly with K, which is unbounded at pod scale (K = worker
+# count on the server decompress-sum path).
+_UNROLL_K_MAX = 32
+
+
+def _rows_unpack_acc(words_ref, scales_ref, rows: int, bl: int):
+    """Σ_r signs(words[r]) · scale[r] over ``rows`` block rows — the one
+    copy of the bit-unpack arithmetic both unpack-sum kernels share."""
+    shifts = jax.lax.broadcasted_iota(jnp.int32, (_BITS, bl), 0)
+    acc = jnp.zeros((_BITS, bl), jnp.float32)
+    for r in range(rows):
+        w = jnp.broadcast_to(words_ref[r:r + 1, :], (_BITS, bl))
+        bits = (w >> shifts) & jnp.int32(1)
+        signs = bits.astype(jnp.float32) * 2.0 - 1.0
+        acc = acc + signs * scales_ref[r, 0]
+    return acc
+
+
 def _make_unpack_sum_kernel(K: int, bl: int):
     def kernel(words_ref, scales_ref, out_ref):
-        shifts = jax.lax.broadcasted_iota(jnp.int32, (_BITS, bl), 0)
-        acc = jnp.zeros((_BITS, bl), jnp.float32)
-        for k in range(K):  # K = mesh-axis size: small, static → unrolled
-            w = jnp.broadcast_to(words_ref[k:k + 1, :], (_BITS, bl))
-            bits = (w >> shifts) & jnp.int32(1)
-            signs = bits.astype(jnp.float32) * 2.0 - 1.0
-            acc = acc + signs * scales_ref[k, 0]
-        out_ref[...] = acc
+        out_ref[...] = _rows_unpack_acc(words_ref, scales_ref, K, bl)
+
+    return kernel
+
+
+_GRID_K_BLOCK = 8  # sublane-dim blocks must be divisible by 8 on TPU
+
+
+def _make_unpack_sum_grid_kernel(bl: int):
+    """K as the innermost grid axis in blocks of 8 rows: constant program
+    size for any K; the output block is revisited across consecutive k
+    steps (legal revisit order on TPU), accumulating in place. Padded rows
+    carry scale 0 and contribute nothing."""
+
+    def kernel(words_ref, scales_ref, out_ref):
+        kb = pl.program_id(1)
+        acc = _rows_unpack_acc(words_ref, scales_ref, _GRID_K_BLOCK, bl)
+
+        @pl.when(kb == 0)
+        def _init():
+            out_ref[...] = acc
+
+        @pl.when(kb > 0)
+        def _accumulate():
+            out_ref[...] = out_ref[...] + acc
 
     return kernel
 
@@ -123,20 +161,41 @@ def _unpack_sum_pallas(words: jnp.ndarray, scales: jnp.ndarray,
                        interpret: bool = False) -> jnp.ndarray:
     K, L = words.shape
     bl = _block(L)
-    out = pl.pallas_call(
-        _make_unpack_sum_kernel(K, bl),
-        grid=(L // bl,),
+    words_i32 = jax.lax.bitcast_convert_type(words, jnp.int32)
+    if K <= _UNROLL_K_MAX:
+        return pl.pallas_call(
+            _make_unpack_sum_kernel(K, bl),
+            grid=(L // bl,),
+            in_specs=[
+                pl.BlockSpec((K, bl), lambda i: (0, i)),
+                pl.BlockSpec((K, 1), lambda i: (0, 0),
+                             memory_space=pltpu.MemorySpace.SMEM),
+            ],
+            out_specs=pl.BlockSpec((_BITS, bl), lambda i: (0, i)),
+            out_shape=jax.ShapeDtypeStruct((_BITS, L), jnp.float32),
+            interpret=interpret,
+        )(words_i32, scales.reshape(K, 1))
+    kp = -(-K // _GRID_K_BLOCK) * _GRID_K_BLOCK
+    if kp != K:
+        # pod worker counts are usually 8-multiples, so this copy of the
+        # (already 32x-compressed) payload is the uncommon case; padded
+        # rows are zero-scaled in the kernel
+        words_i32 = jnp.pad(words_i32, ((0, kp - K), (0, 0)))
+        scales_p = jnp.pad(scales.reshape(K, 1), ((0, kp - K), (0, 0)))
+    else:
+        scales_p = scales.reshape(K, 1)
+    return pl.pallas_call(
+        _make_unpack_sum_grid_kernel(bl),
+        grid=(L // bl, kp // _GRID_K_BLOCK),
         in_specs=[
-            pl.BlockSpec((K, bl), lambda i: (0, i)),
-            pl.BlockSpec((K, 1), lambda i: (0, 0),
+            pl.BlockSpec((_GRID_K_BLOCK, bl), lambda j, k: (k, j)),
+            pl.BlockSpec((_GRID_K_BLOCK, 1), lambda j, k: (k, 0),
                          memory_space=pltpu.MemorySpace.SMEM),
         ],
-        out_specs=pl.BlockSpec((_BITS, bl), lambda i: (0, i)),
+        out_specs=pl.BlockSpec((_BITS, bl), lambda j, k: (0, j)),
         out_shape=jax.ShapeDtypeStruct((_BITS, L), jnp.float32),
         interpret=interpret,
-    )(jax.lax.bitcast_convert_type(words, jnp.int32),
-      scales.reshape(K, 1))
-    return out
+    )(words_i32, scales_p)
 
 
 # --- public API --------------------------------------------------------------

@@ -51,6 +51,35 @@ def test_unpack_sum_backends_agree(xs):
     np.testing.assert_allclose(np.asarray(a), want, rtol=1e-6)
 
 
+def test_unpack_sum_grid_at_pod_scale_K():
+    """K=256 (pod-scale worker count) takes the grid-over-K kernel: the
+    program size is constant in K — tracing/compiling stays bounded where
+    the unrolled body would emit 256 copies — and the numerics match the
+    jnp fallback to fp32 accumulation-order tolerance."""
+    import time
+
+    from byteps_tpu.ops.onebit_kernels import _UNROLL_K_MAX
+
+    K, n = 256, 2000
+    assert K > _UNROLL_K_MAX
+    rng = np.random.RandomState(11)
+    xs256 = jnp.asarray(rng.randn(K, n).astype(np.float32))
+    words = jnp.stack([onebit_pack(x, backend="jnp") for x in xs256])
+    scales = jnp.asarray(rng.rand(K).astype(np.float32) + 0.1)
+    t0 = time.perf_counter()
+    a = onebit_unpack_sum(words, scales, n, backend="pallas")
+    a.block_until_ready()
+    elapsed = time.perf_counter() - t0
+    b = onebit_unpack_sum(words, scales, n, backend="jnp")
+    # sequential (grid) vs tree (jnp .sum) fp32 accumulation order differs
+    # across 256 terms — bitwise equality is not expected
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-4, atol=1e-4)
+    # trace+compile+run must stay bounded (unrolled K=256 would not);
+    # generous bound absorbs CI noise while catching O(K) program blowup
+    assert elapsed < 120, f"grid kernel took {elapsed:.1f}s at K={K}"
+
+
 def test_pack_pallas_under_vmap(xs):
     a = jax.vmap(lambda v: onebit_pack(v, backend="pallas"))(xs)
     b = jnp.stack([onebit_pack(x, backend="jnp") for x in xs])
