@@ -89,6 +89,10 @@ class Config:
     enable_async: bool = False
     enable_ipc: bool = False
     server_engine_threads: int = DEFAULT_SERVER_ENGINE_THREADS
+    # Priority-ordered server engine (reference BYTEPS_SERVER_ENABLE_SCHEDULE
+    # [C-LOW]): a contended engine sums/answers lower keys (earlier-declared,
+    # higher-priority tensors) first, matching the worker scheduler's order.
+    server_enable_schedule: bool = False
     # Server expires pulls waiting longer than this with an error so a dead
     # worker fails the job fast instead of hanging its peers (reference
     # analog: ps-lite heartbeat/resender timeouts). 0 disables.
@@ -139,6 +143,7 @@ class Config:
             enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),
             enable_ipc=_env_bool("BYTEPS_ENABLE_IPC"),
             server_engine_threads=_env_int("BYTEPS_SERVER_ENGINE_THREAD", DEFAULT_SERVER_ENGINE_THREADS),
+            server_enable_schedule=_env_bool("BYTEPS_SERVER_ENABLE_SCHEDULE"),
             pull_timeout_ms=_env_int("BYTEPS_SERVER_PULL_TIMEOUT_MS", 60000),
             log_level=_env_str("BYTEPS_LOG_LEVEL", "INFO").upper(),
             min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES", 65536),

@@ -61,6 +61,7 @@ def start_server(
     async_mode: Optional[bool] = None,
     server_id: int = 0,
     pull_timeout_ms: Optional[int] = None,
+    enable_schedule: Optional[bool] = None,
 ) -> int:
     """Start the native summation service in this process (non-blocking)."""
     global _INPROC_SERVER_ID
@@ -77,6 +78,8 @@ def start_server(
         pull_timeout_ms if pull_timeout_ms is not None
         else cfg.pull_timeout_ms,
         server_id,
+        1 if (enable_schedule if enable_schedule is not None
+              else cfg.server_enable_schedule) else 0,
     )
     if rc != 0:
         raise RuntimeError(f"bps_server_start failed (rc={rc}, port={port})")

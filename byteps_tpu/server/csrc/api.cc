@@ -11,9 +11,10 @@
 extern "C" {
 
 int bps_server_start(uint16_t port, int num_workers, int engine_threads,
-                     int async_mode, int pull_timeout_ms, int server_id) {
+                     int async_mode, int pull_timeout_ms, int server_id,
+                     int enable_schedule) {
   return bps::StartServer(port, num_workers, engine_threads, async_mode != 0,
-                          pull_timeout_ms, server_id);
+                          pull_timeout_ms, server_id, enable_schedule != 0);
 }
 
 void bps_server_wait() { bps::WaitServer(); }

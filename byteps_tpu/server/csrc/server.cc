@@ -154,11 +154,12 @@ constexpr size_t kMaxTraceEvents = 1u << 21;
 class Server {
  public:
   int Start(uint16_t port, int num_workers, int engine_threads, bool async,
-            int pull_timeout_ms, int server_id) {
+            int pull_timeout_ms, int server_id, bool schedule) {
     num_workers_ = num_workers;
     async_ = async;
     pull_timeout_ms_ = pull_timeout_ms;
     server_id_ = server_id;
+    schedule_ = schedule;
     engine_ = std::make_unique<ThreadPool>(engine_threads);
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     if (listen_fd_ < 0) return -1;
@@ -384,9 +385,22 @@ class Server {
     }
   }
 
+  // Engine submission honoring BYTEPS_SERVER_ENABLE_SCHEDULE: with
+  // scheduling on, tasks carry the key as priority (lower key =
+  // earlier-declared tensor = higher priority — the worker scheduler's own
+  // (priority, key) order) so a contended engine sums and answers
+  // high-priority partitions first.
+  void SubmitEngine(uint64_t key, std::function<void()> fn) {
+    if (schedule_) {
+      engine_->SubmitPriority(key, std::move(fn));
+    } else {
+      engine_->Submit(std::move(fn));
+    }
+  }
+
   // Enqueue `fn` on the key's per-worker strand: tasks run on the engine
   // pool but strictly in post order for that (key, worker).
-  void PostOrdered(KeyStore* ks, uint16_t worker,
+  void PostOrdered(KeyStore* ks, uint64_t key, uint16_t worker,
                    std::function<void()> fn) {
     std::shared_ptr<Strand> st;
     {
@@ -405,21 +419,52 @@ class Server {
       }
     }
     if (start) {
-      engine_->Submit([st] {
-        for (;;) {
-          std::function<void()> task;
-          {
-            std::lock_guard<std::mutex> lk(st->mu);
-            if (st->q.empty()) {
-              st->running = false;
-              return;
+      if (schedule_) {
+        SubmitEngine(key, [this, st, key] { RunStrandOne(st, key); });
+      } else {
+        engine_->Submit([st] {
+          for (;;) {
+            std::function<void()> task;
+            {
+              std::lock_guard<std::mutex> lk(st->mu);
+              if (st->q.empty()) {
+                st->running = false;
+                return;
+              }
+              task = std::move(st->q.front());
+              st->q.pop_front();
             }
-            task = std::move(st->q.front());
-            st->q.pop_front();
+            task();
           }
-          task();
-        }
-      });
+        });
+      }
+    }
+  }
+
+  // Scheduled strand pump: ONE task per engine submission, continuation
+  // re-enqueued through the priority lane — a low-priority key receiving a
+  // steady push stream must yield to higher-priority work between tasks
+  // instead of monopolizing an engine thread with a drain loop.
+  void RunStrandOne(const std::shared_ptr<Strand>& st, uint64_t key) {
+    std::function<void()> task;
+    {
+      std::lock_guard<std::mutex> lk(st->mu);
+      if (st->q.empty()) {
+        st->running = false;
+        return;
+      }
+      task = std::move(st->q.front());
+      st->q.pop_front();
+    }
+    task();
+    bool more;
+    {
+      std::lock_guard<std::mutex> lk(st->mu);
+      more = !st->q.empty();
+      if (!more) st->running = false;
+    }
+    if (more) {
+      SubmitEngine(key, [this, st, key] { RunStrandOne(st, key); });
     }
   }
 
@@ -543,7 +588,7 @@ class Server {
     Trace(kTrSum, key, len, codec, t0);
     for (auto& p : ready) {
       // parallel fan-out: each response encodes+sends on its own engine slot
-      engine_->Submit([this, ks, key, p = std::move(p)] {
+      SubmitEngine(key, [this, ks, key, p = std::move(p)] {
         RespondPull(p.conn, key, ks, p.codec, p.version, p.snap, p.hint);
       });
     }
@@ -625,8 +670,8 @@ class Server {
       }
     }
     if (ready) {
-      engine_->Submit([this, c, key, ks, codec, v, hint,
-                       snap = std::move(snap)] {
+      SubmitEngine(key, [this, c, key, ks, codec, v, hint,
+                         snap = std::move(snap)] {
         RespondPull(c, key, ks, codec, v, snap, hint);
       });
     }
@@ -729,7 +774,7 @@ class Server {
           Trace(kTrPushRecv, h.key, h.len, h.flags, t_recv);
           const uint16_t worker = h.reserved;
           const uint8_t codec = h.flags;
-          PostOrdered(ks, worker,
+          PostOrdered(ks, h.key, worker,
                       [this, ks, key = h.key, worker, codec,
                        buf = std::move(payload)]() mutable {
                         ApplyPush(ks, key, worker, codec, std::move(buf));
@@ -774,6 +819,7 @@ class Server {
   int listen_fd_ = -1;
   int num_workers_ = 1;
   bool async_ = false;
+  bool schedule_ = false;
   int pull_timeout_ms_ = 0;
   int server_id_ = 0;
   std::atomic<bool> running_{false};
@@ -816,7 +862,8 @@ Server* GetServer() {
 }  // namespace
 
 int StartServer(uint16_t port, int num_workers, int engine_threads,
-                bool async, int pull_timeout_ms, int server_id) {
+                bool async, int pull_timeout_ms, int server_id,
+                bool schedule) {
   std::lock_guard<std::mutex> lk(g_server_mu);
   if (g_server != nullptr) {
     if (g_server->IsRunning()) return -10;  // already running
@@ -828,7 +875,7 @@ int StartServer(uint16_t port, int num_workers, int engine_threads,
   }
   auto* s = new Server();
   int rc = s->Start(port, num_workers, engine_threads, async,
-                    pull_timeout_ms, server_id);
+                    pull_timeout_ms, server_id, schedule);
   if (rc != 0) {
     delete s;  // never published: no other thread can hold it
     return rc;

@@ -18,9 +18,11 @@ namespace bps {
 // threads: decode/sum pool size; async: no per-round barrier.
 // `pull_timeout_ms` > 0 expires pulls waiting past the deadline with kErr
 // (dead-worker fail-fast; reference analog: ps-lite heartbeat/resender,
-// SURVEY §5.3). `server_id` labels trace output.
+// SURVEY §5.3). `server_id` labels trace output. `schedule` enables
+// priority-ordered engine work by key (BYTEPS_SERVER_ENABLE_SCHEDULE).
 int StartServer(uint16_t port, int num_workers, int engine_threads,
-                bool async, int pull_timeout_ms, int server_id);
+                bool async, int pull_timeout_ms, int server_id,
+                bool schedule);
 // Blocks until the server stops (all workers sent kShutdown, or StopServer).
 void WaitServer();
 void StopServer();

@@ -60,7 +60,7 @@ def load_lib() -> ctypes.CDLL:
             lib = ctypes.CDLL(_SO)
         lib.bps_server_start.argtypes = [
             ctypes.c_uint16, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ]
         lib.bps_server_start.restype = ctypes.c_int
         lib.bps_server_wait.argtypes = []

@@ -365,3 +365,55 @@ def test_ipc_local_fast_path():
     # the data plane never opened a TCP connection
     assert not w._all_conns
     stop_server()
+
+
+def test_server_schedule_priority_order(tmp_path):
+    """BYTEPS_SERVER_ENABLE_SCHEDULE: on a contended single-thread engine,
+    queued work drains in KEY order (the worker scheduler's own priority
+    order: lower key = earlier-declared tensor) rather than arrival order.
+    A large push occupies the engine while three small pushes arrive in
+    descending key order; the server trace must show their sums in
+    ascending key order."""
+    import json
+    import os
+
+    from byteps_tpu.server import dump_server_trace
+
+    port = BASE_PORT + 13
+    start_server(port=port, num_workers=1, engine_threads=1,
+                 async_mode=False, enable_schedule=True)
+    load_lib().bps_server_trace_enable(1)
+    w = PSWorker(servers=[("127.0.0.1", port)])
+    big_n = 32 * 1024 * 1024  # 128 MB raw sum keeps the engine busy
+    big = np.ones(big_n, np.float32)
+    w.init_key(1000, big_n * 4)
+    for k in (5, 3, 1):
+        w.init_key(k, 32 * 4)
+    # The contention window is OS-scheduling dependent (1-core CI hosts can
+    # stall the small pushes past the big sum), so run several rounds: any
+    # round whose three smalls were queued inside the window must drain
+    # ascending. Without scheduling, arrival order (5, 3, 1) would surface
+    # instead, so a single ascending triple is decisive — and correctness
+    # is asserted every round.
+    rounds = 6
+    for v in range(1, rounds + 1):
+        w.push(1000, big)  # ack-on-receipt returns fast
+        for k in (5, 3, 1):  # queue while the big sum holds the engine
+            w.push(k, np.full(32, float(k) * v, np.float32))
+        # one worker per round: every round's sum is one push of ones
+        np.testing.assert_allclose(w.pull(1000, big_n, v)[:4], 1.0)
+        for k in (5, 3, 1):
+            np.testing.assert_allclose(w.pull(k, 32, v), float(k) * v)
+    path = os.path.join(str(tmp_path), "sched_trace.json")
+    assert dump_server_trace(path) > 0
+    w.shutdown()
+    doc = json.load(open(path))
+    sums = sorted(
+        (e for e in doc["traceEvents"] if e["tid"] == "SUM"),
+        key=lambda e: e["ts"],
+    )
+    small_order = [e["args"]["key"] for e in sums if e["args"]["key"] < 100]
+    assert len(small_order) == 3 * rounds, small_order
+    triples = [tuple(small_order[i:i + 3])
+               for i in range(0, len(small_order), 3)]
+    assert (1, 3, 5) in triples, triples
