@@ -108,7 +108,9 @@ def _aggregate_flat(
             s = jax.lax.psum(g, axis)
             out = s / n if average else s
             if new_e_chunks is not None:
-                new_e_chunks.append(jnp.zeros_like(g))
+                # residual contract is fp32 regardless of the aggregation
+                # dtype (g may be bf16 under BYTEPS_REDUCE_DTYPE)
+                new_e_chunks.append(jnp.zeros(g.shape, jnp.float32))
         out_chunks.append(out)
     agg = out_chunks[0] if len(out_chunks) == 1 else jnp.concatenate(out_chunks)
     new_e = None
@@ -173,7 +175,15 @@ def push_pull_inside(
             return grads, jnp.zeros_like(ef_residual)
         return grads
     partition_bytes = partition_bytes or cfg.partition_bytes
-    chunk_elems = max(1, partition_bytes // 4)  # aggregation runs in fp32
+    # BYTEPS_REDUCE_DTYPE: the aggregation dtype for uncompressed psums —
+    # bfloat16 halves the bytes every chunk moves over ICI at reduced
+    # summation precision (the reference PS always sums fp32; this is a
+    # TPU-only lever). Compression requires fp32 (kernel contract), and
+    # the EF residual stays fp32 either way.
+    acc_dtype = jnp.dtype(
+        "float32" if spec.enabled else cfg.reduce_dtype
+    )
+    chunk_elems = max(1, partition_bytes // acc_dtype.itemsize)
 
     leaves, treedef = jax.tree.flatten(grads)
     out_leaves = [None] * len(leaves)
@@ -182,7 +192,7 @@ def push_pull_inside(
     chunk_id = 0
     new_e_parts = [] if ef_residual is not None else None
     for idxs in groups:
-        flats = [jnp.ravel(leaves[i]).astype(jnp.float32) for i in idxs]
+        flats = [jnp.ravel(leaves[i]).astype(acc_dtype) for i in idxs]
         sizes = [f.shape[0] for f in flats]
         flat = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
         gtotal = flat.shape[0]
@@ -324,7 +334,10 @@ def DistributedOptimizer(
             # across shard_map's per-shard duplicates; zero overhead when
             # BYTEPS_TRACE_ON is off (branch is trace-time static).
             pb = partition_bytes or cfg.partition_bytes
-            nchunks = -(-total * 4 // pb)
+            itemsize = (
+                4 if spec.enabled else jnp.dtype(cfg.reduce_dtype).itemsize
+            )
+            nchunks = -(-total * itemsize // pb)
             jax.debug.callback(
                 _fused_trace_callback, state.count,
                 total_elems=total, chunks=nchunks,

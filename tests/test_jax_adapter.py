@@ -173,6 +173,41 @@ def test_distributed_optimizer_trains(mesh8, compression_params):
     assert final < init_loss * 0.05, (final, init_loss)
 
 
+def test_reduce_dtype_bf16_changes_wire_numerics(mesh8, monkeypatch):
+    """BYTEPS_REDUCE_DTYPE=bfloat16: the fused uncompressed psum runs in
+    bf16 (half the ICI bytes) — the aggregated mean shows bf16 rounding
+    relative to the fp32 default, and training still converges."""
+    monkeypatch.setenv("BYTEPS_REDUCE_DTYPE", "bfloat16")
+    from byteps_tpu.common.config import reset_config
+
+    reset_config()
+
+    from byteps_tpu.jax.optimizer import push_pull_inside
+
+    rows = jnp.asarray(
+        np.random.RandomState(0).randn(N, 1000).astype(np.float32)
+    )
+    agg16 = jax.jit(jax.shard_map(
+        lambda b: push_pull_inside({"g": b[0]}, axis="dp", n=N)["g"],
+        mesh=mesh8, in_specs=P("dp"), out_specs=P(),
+    ))(rows)
+    want = np.asarray(rows, np.float32).mean(axis=0)
+    got = np.asarray(agg16)
+    # bf16-rounded, hence close to — but (for a random vector) not exactly
+    # equal to — the fp32 mean (atol covers near-zero means whose relative
+    # bf16 error is unbounded)
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=4e-3)
+    assert np.abs(got - want).max() > 0, "bf16 path produced exact fp32"
+
+    monkeypatch.setenv("BYTEPS_REDUCE_DTYPE", "float32")
+    reset_config()
+    agg32 = jax.jit(jax.shard_map(
+        lambda b: push_pull_inside({"g": b[0]}, axis="dp", n=N)["g"],
+        mesh=mesh8, in_specs=P("dp"), out_specs=P(),
+    ))(rows)
+    np.testing.assert_allclose(np.asarray(agg32), want, rtol=1e-6)
+
+
 def test_distributed_optimizer_matches_single_worker_sgd(mesh8):
     """Uncompressed DP aggregation == training on the pooled batch."""
     X, y, _ = _linreg_data(seed=3)
