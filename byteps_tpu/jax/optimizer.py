@@ -176,10 +176,11 @@ def push_pull_inside(
         return grads
     partition_bytes = partition_bytes or cfg.partition_bytes
     # BYTEPS_REDUCE_DTYPE: the aggregation dtype for uncompressed psums —
-    # bfloat16 halves the bytes every chunk moves over ICI at reduced
-    # summation precision (the reference PS always sums fp32; this is a
-    # TPU-only lever). Compression requires fp32 (kernel contract), and
-    # the EF residual stays fp32 either way.
+    # bfloat16 halves TOTAL ICI bytes (chunks still carry partition_bytes
+    # each, so half as many chunks) at reduced summation precision (the
+    # reference PS always sums fp32; this is a TPU-only lever).
+    # Compression requires fp32 (kernel contract), and the EF residual
+    # stays fp32 either way.
     acc_dtype = jnp.dtype(
         "float32" if spec.enabled else cfg.reduce_dtype
     )

@@ -7,7 +7,8 @@ benchmark/bench.py workloads (BASELINE configs: ResNet-50, BERT, GPT-2) and
 the flagship for the driver's compile checks.
 """
 
-from byteps_tpu.models.gpt import GPTConfig, gpt_init, gpt_forward, gpt_loss
+from byteps_tpu.models.gpt import (GPTConfig, gpt_init, gpt_forward,
+                                   gpt_loss, gpt_pp_loss)
 from byteps_tpu.models.gpt import gpt_param_specs
 from byteps_tpu.models.bert import (
     BertConfig, bert_init, bert_forward, bert_mlm_loss, bert_param_specs,
@@ -18,7 +19,8 @@ from byteps_tpu.models.resnet import (
 )
 
 __all__ = [
-    "GPTConfig", "gpt_init", "gpt_forward", "gpt_loss", "gpt_param_specs",
+    "GPTConfig", "gpt_init", "gpt_forward", "gpt_loss", "gpt_pp_loss",
+    "gpt_param_specs",
     "BertConfig", "bert_init", "bert_forward", "bert_mlm_loss",
     "bert_param_specs",
     "ResNetConfig", "resnet_init", "resnet_forward", "resnet_loss",

@@ -164,6 +164,22 @@ def block_specs(tp_axis):
     }
 
 
+def _readout(params, h: jnp.ndarray) -> jnp.ndarray:
+    """Final LN → weight-tied fp32 readout, shared by the dense and
+    pipelined paths so their numerics cannot diverge."""
+    h = _layernorm(h, params["lnf_g"], params["lnf_b"])
+    return h.astype(jnp.float32) @ params["wte"].T.astype(jnp.float32)
+
+
+def _nll(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+
+
+def _readout_nll(params, h: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    return _nll(_readout(params, h), targets)
+
+
 def gpt_forward(params, tokens: jnp.ndarray, cfg: GPTConfig,
                 tp_axis: Optional[str] = None,
                 sp_axis: Optional[str] = None) -> jnp.ndarray:
@@ -184,9 +200,50 @@ def gpt_forward(params, tokens: jnp.ndarray, cfg: GPTConfig,
     for p in params["blocks"]:
         x = transformer_block(x, p, cfg.head_dim, tp_axis, sp_axis,
                               causal=True)
-    x = _layernorm(x, params["lnf_g"], params["lnf_b"])
     # weight-tied readout, f32 logits for a stable softmax/loss
-    return x.astype(jnp.float32) @ params["wte"].T.astype(jnp.float32)
+    return _readout(params, x)
+
+
+def gpt_pp_loss(params, tokens, targets, cfg: GPTConfig,
+                pp_axis: str, n_micro: int,
+                tp_axis: Optional[str] = None) -> jnp.ndarray:
+    """Pipeline-parallel next-token loss (inside shard_map over pp).
+
+    ``params["blocks"]`` is THIS stage's stacked layer slab
+    ((n_layers/pp, ...) — build with ``stack_blocks`` + ``stacked_specs``);
+    embeddings / final LN are pp-replicated. The batch is split into
+    ``n_micro`` microbatches and pipelined through the stages
+    (:func:`byteps_tpu.parallel.pipeline.pipeline_apply`); the last stage
+    computes the readout + loss; the returned value is the MASKED per-stage
+    loss (nonzero only on the last stage). Differentiate THIS value —
+    grading an already-psum'd replica double-counts through the psum
+    transpose under ``check_vma=False`` — and replicate it afterwards for
+    reporting (``last_stage_value``). Per-device ``jax.grad`` then yields
+    stage-local slab grads plus stage-partial grads for the replicated
+    leaves (psum those over pp).
+    """
+    from byteps_tpu.parallel.pipeline import pipeline_apply
+
+    B, S = tokens.shape
+    if B % n_micro != 0:
+        raise ValueError(f"local batch {B} not divisible by {n_micro} "
+                         "microbatches")
+    pos = jnp.arange(S)
+    x = (params["wte"][tokens] + params["wpe"][pos]).astype(cfg.dtype)
+    x_mb = x.reshape(n_micro, B // n_micro, S, x.shape[-1])
+
+    def blk(h, p):
+        return transformer_block(h, p, cfg.head_dim, tp_axis, None,
+                                 causal=True)
+
+    y_mb = pipeline_apply(x_mb, params["blocks"], blk, pp_axis)
+    y = y_mb.reshape(B, S, -1)
+    nll = _readout_nll(params, y, targets)
+    # only the last stage's outputs are real; other stages' readout math
+    # above is masked dead weight (grads through it are zeroed here)
+    stage = jax.lax.axis_index(pp_axis)
+    nstages = jax.lax.axis_size(pp_axis)
+    return jnp.where(stage == nstages - 1, nll.mean(), 0.0)
 
 
 def gpt_loss(params, tokens, targets, cfg: GPTConfig,
@@ -201,9 +258,7 @@ def gpt_loss(params, tokens, targets, cfg: GPTConfig,
     aggregation `DistributedOptimizer` / `sync_grads` provide.
     """
     logits = gpt_forward(params, tokens, cfg, tp_axis, sp_axis)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    loss = nll.mean()
+    loss = _nll(logits, targets).mean()
     axes = tuple(a for a in (dp_axis, sp_axis) if a is not None)
     if axes:
         loss = jax.lax.pmean(loss, axes)

@@ -33,7 +33,14 @@ from byteps_tpu.models.bert import (
     bert_mlm_loss,
     bert_param_specs,
 )
-from byteps_tpu.models.gpt import GPTConfig, gpt_init, gpt_loss, gpt_param_specs
+from byteps_tpu.models.gpt import (
+    GPTConfig,
+    block_specs,
+    gpt_init,
+    gpt_loss,
+    gpt_param_specs,
+    gpt_pp_loss,
+)
 from byteps_tpu.models.resnet import (
     ResNetConfig,
     resnet_init,
@@ -196,6 +203,99 @@ def make_gpt_train_step(
         )
         # donate params/opt_state: the step is an in-place update at the XLA
         # level (halves HBM traffic for the weight/optimizer buffers)
+        return jax.jit(sharded, donate_argnums=(0, 1))
+
+    return (
+        _finalize_step(build_jit, partition_bytes, dp),
+        params, opt_state, NamedSharding(mesh, batch_spec),
+    )
+
+
+def make_gpt_pp_train_step(
+    cfg: GPTConfig,
+    mesh: Mesh,
+    base_tx: optax.GradientTransformation,
+    n_micro: int = 4,
+    partition_bytes: Optional[int] = None,
+):
+    """Pipeline-parallel GPT train step over a (pp, dp) mesh.
+
+    Transformer blocks are stacked on a leading layer axis and sharded
+    ``P('pp')`` — each stage owns n_layers/pp contiguous layers and its
+    optimizer moments for them; microbatches flow stage-to-stage via
+    ppermute (GPipe schedule, backward derived by AD). dp aggregation is
+    DistributedOptimizer as everywhere else; grads of pp-replicated leaves
+    (embeddings, final LN) are psum'd over pp first. Compression is not
+    yet supported on the pp path (EF state is sized per-device and block
+    grads are pp-sharded).
+
+    Returns ``(step, params, opt_state, batch_sharding)`` like
+    :func:`make_gpt_train_step`; ``params["blocks"]`` is the stacked slab.
+    """
+    from byteps_tpu.parallel.pipeline import stack_blocks, stacked_specs
+
+    dp, pp = _axis(mesh, "dp"), _axis(mesh, "pp")
+    if pp is None:
+        raise ValueError("mesh has no pp axis — use make_gpt_train_step")
+    for ax in ("tp", "sp"):
+        if _axis(mesh, ax) is not None:
+            raise NotImplementedError(
+                f"pp currently composes with dp only (mesh has {ax})"
+            )
+    nstages = mesh.shape[pp]
+    if cfg.n_layers % nstages != 0:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by pp={nstages}"
+        )
+    raw = gpt_init(jax.random.PRNGKey(0), cfg)
+    params = {
+        "wte": raw["wte"], "wpe": raw["wpe"],
+        "lnf_g": raw["lnf_g"], "lnf_b": raw["lnf_b"],
+        "blocks": stack_blocks(raw["blocks"]),
+    }
+    pspecs = {
+        "wte": P(), "wpe": P(), "lnf_g": P(), "lnf_b": P(),
+        "blocks": stacked_specs(block_specs(None), pp),
+    }
+    params, opt_state, ospecs = _shard_params_state(
+        mesh, _make_tx(mesh, base_tx, None, partition_bytes, dp),
+        params, pspecs, dp,
+    )
+    batch_spec = P(dp)
+    loss_fn = functools.partial(
+        gpt_pp_loss, cfg=cfg, pp_axis=pp, n_micro=n_micro
+    )
+
+    def build_jit(pb):
+        tx = _make_tx(mesh, base_tx, None, pb, dp)
+
+        def per_device_step(params, opt_state, tokens, targets):
+            # loss_fn returns the last-stage-masked loss: grading through
+            # an already-replicated psum double-counts (psum transpose)
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, tokens, targets
+            )
+            loss = jax.lax.psum(loss, pp)  # replicate for reporting
+            # stage-partial grads of the pp-replicated leaves sum to the
+            # true grad; slab grads are already stage-local and final
+            grads = {
+                **{k: jax.lax.psum(grads[k], pp)
+                   for k in ("wte", "wpe", "lnf_g", "lnf_b")},
+                "blocks": grads["blocks"],
+            }
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            if dp is not None:
+                loss = jax.lax.pmean(loss, dp)
+            return loss, params, opt_state
+
+        sharded = jax.shard_map(
+            per_device_step,
+            mesh=mesh,
+            in_specs=(pspecs, ospecs, batch_spec, batch_spec),
+            out_specs=(P(), pspecs, ospecs),
+            check_vma=False,
+        )
         return jax.jit(sharded, donate_argnums=(0, 1))
 
     return (

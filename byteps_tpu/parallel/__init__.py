@@ -12,6 +12,12 @@ called inside ``jax.shard_map`` over a mesh built by :func:`make_mesh`.
 """
 
 from byteps_tpu.parallel.mesh import MeshAxes, make_mesh, factor_devices
+from byteps_tpu.parallel.pipeline import (
+    last_stage_value,
+    pipeline_apply,
+    stack_blocks,
+    stacked_specs,
+)
 from byteps_tpu.parallel.ring_attention import ring_attention, plain_attention
 from byteps_tpu.parallel.tp import (
     col_parallel_matmul,
@@ -23,6 +29,10 @@ __all__ = [
     "MeshAxes",
     "make_mesh",
     "factor_devices",
+    "pipeline_apply",
+    "stack_blocks",
+    "stacked_specs",
+    "last_stage_value",
     "ring_attention",
     "plain_attention",
     "col_parallel_matmul",

@@ -1,0 +1,112 @@
+"""Pipeline parallelism (pp axis): GPipe-style microbatch pipelining.
+
+The reference is data-parallel only (SURVEY §2.7); pipeline parallelism is
+one of the "beyond reference" axes the TPU rebuild adds for large-model
+training. The TPU-idiomatic formulation is a *collective pipeline* inside
+``shard_map`` (the scaling-book recipe): each pp stage owns a contiguous
+stack of layers (a stacked pytree sharded ``P('pp', ...)`` on its leading
+axis), activations shift stage-to-stage with ``jax.lax.ppermute`` over ICI,
+and a ``lax.scan`` over schedule ticks runs every stage in lockstep —
+stage s computes microbatch t−s at tick t, so all stages are busy once the
+pipeline fills. The whole schedule is one traced XLA program, and because
+``ppermute``/``scan``/``where`` are differentiable, ``jax.grad`` through
+:func:`pipeline_apply` yields the reverse (backward) pipeline automatically
+— no hand-written 1F1B schedule.
+
+Cost model: with M microbatches and S stages, bubble fraction is
+(S−1)/(M+S−1); pick M ≥ 4·S to keep it under ~20%.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List
+
+import jax
+import jax.numpy as jnp
+
+
+def stack_blocks(blocks: List[Any]):
+    """Stack a list of identically-shaped block pytrees into one pytree
+    with a leading layer axis — shard it ``P('pp', ...)`` so each stage
+    holds its own contiguous layer slab."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+
+
+def stacked_specs(block_spec, pp_axis: str):
+    """PartitionSpec tree for :func:`stack_blocks` output: the leading
+    layer axis shards over pp, per-layer dims keep ``block_spec``."""
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree.map(
+        lambda s: P(pp_axis, *s),
+        block_spec,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def pipeline_apply(
+    x_mb: jnp.ndarray,
+    stacked: Any,
+    block_fn: Callable[[jnp.ndarray, Any], jnp.ndarray],
+    pp_axis: str,
+) -> jnp.ndarray:
+    """Run microbatches through the pp-staged layer pipeline.
+
+    Call inside ``shard_map``. ``x_mb`` is the microbatched stage-0 input
+    ``(M, mb, ...)`` (identical on every stage — only stage 0 injects it);
+    ``stacked`` is THIS stage's ``(layers_per_stage, ...)`` parameter slab;
+    ``block_fn(x, layer_params) -> x`` applies one layer and must preserve
+    shape. Returns ``(M, mb, ...)`` pipeline outputs, valid on the LAST pp
+    stage (zeros elsewhere — mask with ``lax.axis_index(pp_axis)``).
+
+    Schedule: M + S − 1 ticks; at each tick every stage applies its slab
+    (a ``lax.scan`` over its layers) and ships the result to the next
+    stage via ring ``ppermute`` (the wraparound edge feeds stage 0, which
+    ignores it in favor of the next injected microbatch).
+    """
+    nstages = jax.lax.axis_size(pp_axis)
+    stage = jax.lax.axis_index(pp_axis)
+    M = x_mb.shape[0]
+    mb_shape = x_mb.shape[1:]
+    perm = [(i, (i + 1) % nstages) for i in range(nstages)]
+
+    def local_slab(x):
+        def body(h, layer):
+            return block_fn(h, layer), None
+
+        h, _ = jax.lax.scan(body, x, stacked)
+        return h
+
+    def tick(carry, t):
+        recv, outs = carry
+        inject = x_mb[jnp.clip(t, 0, M - 1)]
+        xin = jnp.where(stage == 0, inject, recv)
+        y = local_slab(xin)
+        out_t = t - (nstages - 1)
+        valid = (out_t >= 0) & (out_t < M) & (stage == nstages - 1)
+        start = (jnp.clip(out_t, 0, M - 1),) + (0,) * len(mb_shape)
+        updated = jax.lax.dynamic_update_slice(
+            outs, y[None].astype(outs.dtype), start
+        )
+        outs = jnp.where(valid, updated, outs)
+        recv = jax.lax.ppermute(y, pp_axis, perm)
+        return (recv, outs), None
+
+    init = (
+        jnp.zeros(mb_shape, x_mb.dtype),
+        jnp.zeros((M,) + mb_shape, x_mb.dtype),
+    )
+    (_, outs), _ = jax.lax.scan(
+        tick, init, jnp.arange(M + nstages - 1)
+    )
+    return outs
+
+
+def last_stage_value(value: jnp.ndarray, pp_axis: str) -> jnp.ndarray:
+    """Replicate a last-stage scalar/array to every pp stage (psum of the
+    masked value — other stages contribute zero)."""
+    nstages = jax.lax.axis_size(pp_axis)
+    stage = jax.lax.axis_index(pp_axis)
+    masked = jnp.where(stage == nstages - 1, value,
+                       jnp.zeros_like(value))
+    return jax.lax.psum(masked, pp_axis)
