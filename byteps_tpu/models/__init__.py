@@ -13,6 +13,9 @@ from byteps_tpu.models.gpt import gpt_param_specs
 from byteps_tpu.models.bert import (
     BertConfig, bert_init, bert_forward, bert_mlm_loss, bert_param_specs,
 )
+from byteps_tpu.models.moe_gpt import (
+    MoEGPTConfig, moe_gpt_init, moe_gpt_loss, moe_gpt_param_specs,
+)
 from byteps_tpu.models.resnet import (
     ResNetConfig, resnet_init, resnet_forward, resnet_loss,
     resnet_param_specs,
@@ -23,6 +26,7 @@ __all__ = [
     "gpt_param_specs",
     "BertConfig", "bert_init", "bert_forward", "bert_mlm_loss",
     "bert_param_specs",
+    "MoEGPTConfig", "moe_gpt_init", "moe_gpt_loss", "moe_gpt_param_specs",
     "ResNetConfig", "resnet_init", "resnet_forward", "resnet_loss",
     "resnet_param_specs",
 ]

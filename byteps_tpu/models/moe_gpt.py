@@ -1,0 +1,110 @@
+"""MoE GPT: dense attention + Switch-style MoE FFN, expert-parallel over ep.
+
+The sparse-FFN sibling of the flagship dense GPT (models/gpt.py — shared
+attention/layernorm/readout code, so the families cannot diverge). Each
+block's MLP is replaced by :func:`byteps_tpu.parallel.moe.moe_ffn`: top-1
+capacity routing, expert weights stacked on a leading expert axis and
+sharded ``P('ep')``, token slots shipped to their expert's owner and back
+with ``all_to_all`` over ICI. The Switch load-balancing auxiliary loss is
+averaged over layers and added with ``aux_coef``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from byteps_tpu.models.gpt import (
+    GPTConfig,
+    _attention,
+    _layernorm,
+    _readout_nll,
+    block_init,
+)
+from byteps_tpu.parallel.moe import moe_ffn, moe_init, moe_specs
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEGPTConfig(GPTConfig):
+    n_experts: int = 8
+    capacity_factor: float = 1.25
+    aux_coef: float = 0.01
+
+    @classmethod
+    def tiny(cls) -> "MoEGPTConfig":
+        return cls(vocab_size=256, max_seq=64, d_model=64, n_heads=4,
+                   n_layers=2, d_ff=128, n_experts=4,
+                   capacity_factor=4.0)
+
+
+def moe_block_init(rng, cfg: MoEGPTConfig):
+    """Attention half of a dense block + expert-stacked MoE FFN."""
+    b = block_init(rng, cfg.d_model, cfg.d_ff,
+                   cfg.n_heads * cfg.head_dim, cfg.n_layers)
+    for k in ("w1", "b1", "w2", "b2"):
+        del b[k]
+    b["moe"] = moe_init(jax.random.fold_in(rng, 99), cfg.d_model,
+                        cfg.d_ff, cfg.n_experts)
+    return b
+
+
+def moe_gpt_init(rng, cfg: MoEGPTConfig) -> Dict[str, Any]:
+    d = cfg.d_model
+    keys = jax.random.split(rng, 2 + cfg.n_layers)
+    return {
+        "wte": jax.random.normal(keys[0], (cfg.vocab_size, d),
+                                 jnp.float32) * 0.02,
+        "wpe": jax.random.normal(keys[1], (cfg.max_seq, d),
+                                 jnp.float32) * 0.02,
+        "lnf_g": jnp.ones((d,), jnp.float32),
+        "lnf_b": jnp.zeros((d,), jnp.float32),
+        "blocks": [moe_block_init(keys[2 + li], cfg)
+                   for li in range(cfg.n_layers)],
+    }
+
+
+def moe_block_specs(ep_axis: Optional[str]):
+    return {
+        "ln1_g": P(), "ln1_b": P(),
+        "wq": P(), "bq": P(), "wk": P(), "bk": P(),
+        "wv": P(), "bv": P(), "wo": P(), "bo": P(),
+        "ln2_g": P(), "ln2_b": P(),
+        "moe": moe_specs(ep_axis),
+    }
+
+
+def moe_gpt_param_specs(cfg: MoEGPTConfig,
+                        ep_axis: Optional[str]) -> Dict[str, Any]:
+    return {
+        "wte": P(), "wpe": P(), "lnf_g": P(), "lnf_b": P(),
+        "blocks": [moe_block_specs(ep_axis) for _ in range(cfg.n_layers)],
+    }
+
+
+def moe_transformer_block(x, p, cfg: MoEGPTConfig,
+                          ep_axis: Optional[str]):
+    """Pre-LN attention + MoE FFN; returns (x, aux_loss)."""
+    x = x + _attention(_layernorm(x, p["ln1_g"], p["ln1_b"]), p,
+                       cfg.head_dim, None, None, causal=True)
+    m, aux = moe_ffn(_layernorm(x, p["ln2_g"], p["ln2_b"]), p["moe"],
+                     cfg.capacity_factor, ep_axis)
+    return x + m, aux
+
+
+def moe_gpt_loss(params, tokens, targets, cfg: MoEGPTConfig,
+                 ep_axis: Optional[str] = None) -> jnp.ndarray:
+    """Per-device next-token loss + Switch aux loss (local mean — dp/ep
+    averaging is the train step's job)."""
+    B, S = tokens.shape
+    pos = jnp.arange(S)
+    x = (params["wte"][tokens] + params["wpe"][pos]).astype(cfg.dtype)
+    aux_total = jnp.zeros((), jnp.float32)
+    for p in params["blocks"]:
+        x, aux = moe_transformer_block(x, p, cfg, ep_axis)
+        aux_total = aux_total + aux
+    nll = _readout_nll(params, x, targets)
+    return nll.mean() + cfg.aux_coef * aux_total / cfg.n_layers

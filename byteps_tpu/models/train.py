@@ -113,17 +113,23 @@ def _finalize_step(build_jit, partition_bytes, dp):
     return build_jit(partition_bytes)
 
 
+def _spec_axes(spec) -> set:
+    """Flatten a PartitionSpec's entries to the set of mesh axis names."""
+    axes = set()
+    for part in spec:
+        if part is None:
+            continue
+        axes.update((part,) if isinstance(part, str) else part)
+    return axes
+
+
 def _make_resymmetrize(pspecs, dp):
     """Collapse conservative VMA variance on grad leaves (numerical identity
     — AD's auto-psums already made replicated grads bit-identical across
     sp/tp; only the inferred *type* is too wide on some paths)."""
 
     def resym(g, spec):
-        allowed = set()
-        for part in spec:
-            if part is None:
-                continue
-            allowed.update((part,) if isinstance(part, str) else part)
+        allowed = _spec_axes(spec)
         vma = set(getattr(jax.typeof(g), "vma", ()) or ())
         excess = tuple(sorted(a for a in vma if a not in allowed and a != dp))
         return jax.lax.pmean(g, excess) if excess else g
@@ -287,6 +293,88 @@ def make_gpt_pp_train_step(
             params = optax.apply_updates(params, updates)
             if dp is not None:
                 loss = jax.lax.pmean(loss, dp)
+            return loss, params, opt_state
+
+        sharded = jax.shard_map(
+            per_device_step,
+            mesh=mesh,
+            in_specs=(pspecs, ospecs, batch_spec, batch_spec),
+            out_specs=(P(), pspecs, ospecs),
+            check_vma=False,
+        )
+        return jax.jit(sharded, donate_argnums=(0, 1))
+
+    return (
+        _finalize_step(build_jit, partition_bytes, dp),
+        params, opt_state, NamedSharding(mesh, batch_spec),
+    )
+
+
+def make_gpt_moe_train_step(
+    cfg,
+    mesh: Mesh,
+    base_tx: optax.GradientTransformation,
+    partition_bytes: Optional[int] = None,
+):
+    """Expert-parallel MoE GPT train step over a (dp, ep) mesh.
+
+    The batch shards over dp AND ep (every device routes its own tokens to
+    all experts via all_to_all); expert-stacked FFN weights shard P('ep').
+    Gradient assembly treats the global loss as the mean of per-device
+    local means: expert-slab grads already SUM their ep peers' token
+    contributions through the all_to_all transpose, so they divide by
+    ep; everything else pmeans over ep; dp averaging stays in
+    DistributedOptimizer as everywhere else.
+
+    Returns ``(step, params, opt_state, batch_sharding)``.
+    """
+    from byteps_tpu.models.moe_gpt import (
+        moe_gpt_init,
+        moe_gpt_loss,
+        moe_gpt_param_specs,
+    )
+
+    dp, ep = _axis(mesh, "dp"), _axis(mesh, "ep")
+    for ax in ("tp", "sp", "pp"):
+        if _axis(mesh, ax) is not None:
+            raise NotImplementedError(
+                f"MoE currently composes dp x ep only (mesh has {ax})"
+            )
+    ep_size = mesh.shape[ep] if ep is not None else 1
+    if ep is not None and cfg.n_experts % ep_size != 0:
+        raise ValueError(
+            f"n_experts={cfg.n_experts} not divisible by ep={ep_size}"
+        )
+    pspecs = moe_gpt_param_specs(cfg, ep)
+    params = moe_gpt_init(jax.random.PRNGKey(0), cfg)
+    params, opt_state, ospecs = _shard_params_state(
+        mesh, _make_tx(mesh, base_tx, None, partition_bytes, dp),
+        params, pspecs, dp,
+    )
+    batch_spec = P((dp, ep) if dp and ep else (dp or ep))
+    loss_fn = functools.partial(moe_gpt_loss, cfg=cfg, ep_axis=ep)
+
+    def _fix_ep(g, spec):
+        if ep is None:
+            return g
+        if ep in _spec_axes(spec):  # expert slab: peers' sums included
+            return g / ep_size
+        return jax.lax.pmean(g, ep)
+
+    def build_jit(pb):
+        tx = _make_tx(mesh, base_tx, None, pb, dp)
+
+        def per_device_step(params, opt_state, tokens, targets):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, tokens, targets
+            )
+            grads = jax.tree.map(_fix_ep, grads, pspecs,
+                                 is_leaf=lambda x: x is None)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            axes = tuple(a for a in (dp, ep) if a is not None)
+            if axes:
+                loss = jax.lax.pmean(loss, axes)
             return loss, params, opt_state
 
         sharded = jax.shard_map(

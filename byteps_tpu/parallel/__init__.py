@@ -12,6 +12,7 @@ called inside ``jax.shard_map`` over a mesh built by :func:`make_mesh`.
 """
 
 from byteps_tpu.parallel.mesh import MeshAxes, make_mesh, factor_devices
+from byteps_tpu.parallel.moe import moe_ffn, moe_init, moe_specs, top1_dispatch
 from byteps_tpu.parallel.pipeline import (
     last_stage_value,
     pipeline_apply,
@@ -29,6 +30,10 @@ __all__ = [
     "MeshAxes",
     "make_mesh",
     "factor_devices",
+    "moe_ffn",
+    "moe_init",
+    "moe_specs",
+    "top1_dispatch",
     "pipeline_apply",
     "stack_blocks",
     "stacked_specs",

@@ -1,0 +1,140 @@
+"""Expert parallelism (ep axis): Mixture-of-Experts FFN with all_to_all
+dispatch.
+
+The reference is data-parallel only; ep is the last of the "beyond
+reference" mesh axes (pp/tp/sp being the others). TPU-first design
+(Switch-Transformer style): top-1 gating with a static per-expert
+capacity (XLA needs static shapes — tokens beyond capacity are dropped,
+their residual path passes through untouched), dispatch/combine as
+einsums against a one-hot (token, expert, slot) tensor so the MXU does
+the routing, and expert placement over the ``ep`` mesh axis with a pair
+of ``lax.all_to_all`` collectives shipping token slots to their expert's
+owner and back over ICI.
+
+Inside ``shard_map`` each device owns ``E / ep_size`` experts
+(expert-stacked weights sharded ``P('ep')`` on their leading axis) and
+every device routes its OWN tokens to all E experts — dp and ep compose:
+dp replicas each contribute their local batch's slots.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def top1_dispatch(gate_logits: jnp.ndarray, capacity: int):
+    """Top-1 routing tensors from ``(T, E)`` gate logits.
+
+    Returns ``(dispatch, combine, aux_loss)``: ``dispatch`` is a one-hot
+    ``(T, E, C)`` float tensor mapping each kept token to its (expert,
+    slot); ``combine`` is ``dispatch`` scaled by the token's gate
+    probability; ``aux_loss`` is the Switch load-balancing loss
+    (mean_e frac_tokens_e · mean_prob_e · E).
+    """
+    T, E = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                    # (T,)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # (T, E)
+    # slot index = this token's rank among earlier tokens of its expert
+    slot = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot     # (T, E)
+    kept = (slot < capacity) & (onehot > 0)
+    slot_oh = jax.nn.one_hot(
+        jnp.sum(slot, axis=-1).astype(jnp.int32), capacity,
+        dtype=jnp.float32,
+    )                                                      # (T, C)
+    dispatch = (
+        kept.astype(jnp.float32)[:, :, None] * slot_oh[:, None, :]
+    )                                                      # (T, E, C)
+    combine = dispatch * gate[:, None, None]
+    frac = onehot.mean(axis=0)                             # tokens per expert
+    aux = E * jnp.sum(frac * probs.mean(axis=0))
+    return dispatch, combine, aux
+
+
+def moe_ffn(
+    x: jnp.ndarray,
+    params,
+    capacity_factor: float = 1.25,
+    ep_axis: Optional[str] = None,
+    activation=jax.nn.gelu,
+):
+    """MoE feed-forward over the trailing feature dim of ``x (..., d)``.
+
+    ``params``: ``wg (d, E)`` gate; expert-stacked ``w1 (E_loc, d, ff)``,
+    ``b1 (E_loc, ff)``, ``w2 (E_loc, ff, d)``, ``b2 (E_loc, d)`` — with
+    ``ep_axis`` set these are THIS device's expert slab (global tensors
+    sharded ``P('ep')``); without it they hold all experts.
+
+    Returns ``(y, aux_loss)`` with ``y`` shaped like ``x``. Dropped
+    (over-capacity) tokens produce zero — add the residual outside, as the
+    transformer block does.
+    """
+    ep = jax.lax.axis_size(ep_axis) if ep_axis is not None else 1
+    e_loc = params["w1"].shape[0]
+    E = e_loc * ep
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    T = 1
+    for s in lead:
+        T *= s
+    xt = x.reshape(T, d)
+    # gating/dispatch in f32 (standard Switch practice); the expert
+    # matmuls and the all_to_all payload run in x.dtype like the dense
+    # family's _mlp — bf16 configs keep full MXU rate and half ICI bytes
+    gate_logits = xt.astype(jnp.float32) @ params["wg"].astype(jnp.float32)
+    cap = max(1, int(capacity_factor * T / E))
+    dispatch, combine, aux = top1_dispatch(gate_logits, cap)
+    slots = jnp.einsum(
+        "tec,td->ecd", dispatch.astype(x.dtype), xt
+    )                                                      # (E, cap, d)
+    if ep_axis is not None:
+        # ship each expert's slots to its owner: (E, cap, d) →
+        # (ep, E_loc, cap, d) → all_to_all → every device holds, for its
+        # OWN experts, the slots from every peer: (ep, E_loc, cap, d)
+        slots = slots.reshape(ep, e_loc, cap, d)
+        slots = jax.lax.all_to_all(
+            slots, ep_axis, split_axis=0, concat_axis=0, tiled=False
+        )
+        # (ep, E_loc, cap, d): axis 0 now indexes the SOURCE device; bring
+        # the local-expert axis out front for the expert matmuls
+        slots = slots.transpose(1, 0, 2, 3).reshape(e_loc, ep * cap, d)
+    h = jnp.einsum("ecd,edf->ecf", slots, params["w1"].astype(x.dtype))
+    h = activation(h + params["b1"][:, None, :].astype(x.dtype))
+    y = jnp.einsum("ecf,efd->ecd", h, params["w2"].astype(x.dtype))
+    y = y + params["b2"][:, None, :].astype(x.dtype)
+    if ep_axis is not None:
+        y = y.reshape(e_loc, ep, cap, d).transpose(1, 0, 2, 3)
+        y = jax.lax.all_to_all(
+            y, ep_axis, split_axis=0, concat_axis=0, tiled=False
+        )
+        # axis 0 = expert-group owner: global expert e = owner*E_loc + local
+        y = y.reshape(E, cap, d)
+    out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), y)
+    return out.reshape(*lead, d).astype(x.dtype), aux.astype(jnp.float32)
+
+
+def moe_init(rng, d: int, ff: int, n_experts: int, std: float = 0.02):
+    """Expert-stacked MoE FFN params (shard w1/b1/w2/b2 ``P('ep')``)."""
+    k = jax.random.split(rng, 3)
+    return {
+        "wg": jax.random.normal(k[0], (d, n_experts), jnp.float32) * std,
+        "w1": jax.random.normal(k[1], (n_experts, d, ff), jnp.float32) * std,
+        "b1": jnp.zeros((n_experts, ff), jnp.float32),
+        "w2": jax.random.normal(k[2], (n_experts, ff, d), jnp.float32) * std,
+        "b2": jnp.zeros((n_experts, d), jnp.float32),
+    }
+
+
+def moe_specs(ep_axis: Optional[str]):
+    """PartitionSpec dict for :func:`moe_init` output."""
+    from jax.sharding import PartitionSpec as P
+
+    e = ep_axis
+    return {
+        "wg": P(),
+        "w1": P(e), "b1": P(e), "w2": P(e), "b2": P(e),
+    }
