@@ -24,6 +24,7 @@ from byteps_tpu.models.gpt import (
     _layernorm,
     _readout_nll,
     block_init,
+    block_specs,
 )
 from byteps_tpu.parallel.moe import moe_ffn, moe_init, moe_specs
 
@@ -68,13 +69,13 @@ def moe_gpt_init(rng, cfg: MoEGPTConfig) -> Dict[str, Any]:
 
 
 def moe_block_specs(ep_axis: Optional[str]):
-    return {
-        "ln1_g": P(), "ln1_b": P(),
-        "wq": P(), "bq": P(), "wk": P(), "bk": P(),
-        "wv": P(), "bv": P(), "wo": P(), "bo": P(),
-        "ln2_g": P(), "ln2_b": P(),
-        "moe": moe_specs(ep_axis),
-    }
+    # derive from the dense family's specs exactly like moe_block_init
+    # derives from block_init, so new attention params cannot diverge
+    s = block_specs(None)
+    for k in ("w1", "b1", "w2", "b2"):
+        del s[k]
+    s["moe"] = moe_specs(ep_axis)
+    return s
 
 
 def moe_gpt_param_specs(cfg: MoEGPTConfig,

@@ -1,0 +1,79 @@
+"""Train a GPT across every parallelism composition the framework ships.
+
+    --mode dense  : dp x sp x tp (ring attention + Megatron tp + BytePS dp)
+    --mode pp     : pp x dp GPipe pipeline (microbatched, ppermute shifts)
+    --mode moe    : dp x ep Switch MoE (all_to_all expert dispatch)
+
+Runs on a TPU slice or virtual CPU devices:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/jax/train_gpt_parallel.py --mode pp
+"""
+
+import argparse
+import os
+
+import jax
+
+# honor an explicit JAX_PLATFORMS choice even when a preloaded PJRT plugin
+# (e.g. a harness sitecustomize) already picked a different default — the
+# env var alone does not win once the plugin registered itself
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import optax
+
+from byteps_tpu.models import GPTConfig, MoEGPTConfig
+from byteps_tpu.models.train import (
+    make_gpt_moe_train_step,
+    make_gpt_pp_train_step,
+    make_gpt_train_step,
+    synthetic_batch,
+)
+from byteps_tpu.parallel import MeshAxes, factor_devices, make_mesh
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=["dense", "pp", "moe"],
+                    default="dense")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=32)
+    ap.add_argument("--n-micro", type=int, default=2)
+    args = ap.parse_args()
+
+    n = len(jax.devices())
+    tx = optax.adamw(1e-3)
+    if args.mode == "dense":
+        cfg = GPTConfig.tiny()
+        mesh = make_mesh(factor_devices(n))
+        step, params, opt_state, bsh = make_gpt_train_step(cfg, mesh, tx)
+    elif args.mode == "pp":
+        cfg = GPTConfig.tiny()
+        pp = 2
+        mesh = make_mesh(MeshAxes(pp=pp, dp=n // pp))
+        step, params, opt_state, bsh = make_gpt_pp_train_step(
+            cfg, mesh, tx, n_micro=args.n_micro
+        )
+    else:
+        cfg = MoEGPTConfig.tiny()
+        ep = 2
+        mesh = make_mesh(MeshAxes(dp=n // ep, ep=ep))
+        step, params, opt_state, bsh = make_gpt_moe_train_step(
+            cfg, mesh, tx
+        )
+    print(f"mode={args.mode} mesh={dict(mesh.shape)}", flush=True)
+
+    for i in range(args.steps):
+        tokens, targets = synthetic_batch(
+            jax.random.PRNGKey(i), cfg, args.batch_size, args.seq
+        )
+        tokens = jax.device_put(tokens, bsh)
+        targets = jax.device_put(targets, bsh)
+        loss, params, opt_state = step(params, opt_state, tokens, targets)
+        if i % 5 == 0 or i == args.steps - 1:
+            print(f"step {i}: loss={float(loss):.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
