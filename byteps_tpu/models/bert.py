@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from byteps_tpu.parallel.remat import maybe_remat
 from byteps_tpu.models.gpt import (
     _layernorm,
     block_init,
@@ -92,7 +93,8 @@ def bert_param_specs(cfg: BertConfig, tp_axis: Optional[str]) -> Dict[str, Any]:
 def bert_forward(params, tokens: jnp.ndarray, cfg: BertConfig,
                  type_ids: Optional[jnp.ndarray] = None,
                  tp_axis: Optional[str] = None,
-                 sp_axis: Optional[str] = None) -> jnp.ndarray:
+                 sp_axis: Optional[str] = None,
+                 remat: bool = False) -> jnp.ndarray:
     """(B, S_local) tokens → f32 MLM logits (B, S_local, V)."""
     B, S_loc = tokens.shape
     off = jax.lax.axis_index(sp_axis) * S_loc if sp_axis is not None else 0
@@ -102,9 +104,13 @@ def bert_forward(params, tokens: jnp.ndarray, cfg: BertConfig,
         x = x + params["wtype"][type_ids]
     x = _layernorm(x.astype(cfg.dtype), params["emb_ln_g"],
                    params["emb_ln_b"])
+    def apply_block(x, p):
+        return transformer_block(x, p, cfg.head_dim, tp_axis, sp_axis,
+                                 causal=False)
+
+    apply_block = maybe_remat(apply_block, remat)
     for p in params["blocks"]:
-        x = transformer_block(x, p, cfg.head_dim, tp_axis, sp_axis,
-                              causal=False)
+        x = apply_block(x, p)
     h = jax.nn.gelu(x.astype(jnp.float32) @ params["mlm_w"] + params["mlm_b"])
     h = _layernorm(h, params["mlm_ln_g"], params["mlm_ln_b"])
     return h @ params["wte"].T.astype(jnp.float32) + params["mlm_bias"]
@@ -113,7 +119,8 @@ def bert_forward(params, tokens: jnp.ndarray, cfg: BertConfig,
 def bert_mlm_loss(params, tokens, targets, mask, cfg: BertConfig,
                   dp_axis: Optional[str] = None,
                   tp_axis: Optional[str] = None,
-                  sp_axis: Optional[str] = None) -> jnp.ndarray:
+                  sp_axis: Optional[str] = None,
+                  remat: bool = False) -> jnp.ndarray:
     """Masked-LM cross-entropy over ``mask`` positions only.
 
     ``tokens`` are the corrupted inputs, ``targets`` the originals, ``mask``
@@ -122,7 +129,7 @@ def bert_mlm_loss(params, tokens, targets, mask, cfg: BertConfig,
     dp_axis given).
     """
     logits = bert_forward(params, tokens, cfg, tp_axis=tp_axis,
-                          sp_axis=sp_axis)
+                          sp_axis=sp_axis, remat=remat)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     m = mask.astype(jnp.float32)

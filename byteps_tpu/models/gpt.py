@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from byteps_tpu.parallel.remat import maybe_remat
 from byteps_tpu.parallel.ring_attention import ring_attention
 from byteps_tpu.parallel.tp import col_parallel_matmul, row_parallel_matmul
 
@@ -182,7 +183,8 @@ def _readout_nll(params, h: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
 
 def gpt_forward(params, tokens: jnp.ndarray, cfg: GPTConfig,
                 tp_axis: Optional[str] = None,
-                sp_axis: Optional[str] = None) -> jnp.ndarray:
+                sp_axis: Optional[str] = None,
+                remat: bool = False) -> jnp.ndarray:
     """Per-device forward: tokens (B_local, S_local) → logits (f32).
 
     Single chip: all axes None, tokens are the whole batch/sequence.
@@ -197,16 +199,25 @@ def gpt_forward(params, tokens: jnp.ndarray, cfg: GPTConfig,
         off = 0
     pos = off + jnp.arange(S_loc)
     x = (params["wte"][tokens] + params["wpe"][pos]).astype(cfg.dtype)
+
+    def apply_block(x, p):
+        return transformer_block(x, p, cfg.head_dim, tp_axis, sp_axis,
+                                 causal=True)
+
+    # rematerialize per block: activations recomputed in backward — HBM
+    # for FLOPs, the long-context lever (see maybe_remat for the tp/sp
+    # collective-recompute caveat)
+    apply_block = maybe_remat(apply_block, remat)
     for p in params["blocks"]:
-        x = transformer_block(x, p, cfg.head_dim, tp_axis, sp_axis,
-                              causal=True)
+        x = apply_block(x, p)
     # weight-tied readout, f32 logits for a stable softmax/loss
     return _readout(params, x)
 
 
 def gpt_pp_loss(params, tokens, targets, cfg: GPTConfig,
                 pp_axis: str, n_micro: int,
-                tp_axis: Optional[str] = None) -> jnp.ndarray:
+                tp_axis: Optional[str] = None,
+                remat: bool = False) -> jnp.ndarray:
     """Pipeline-parallel next-token loss (inside shard_map over pp).
 
     ``params["blocks"]`` is THIS stage's stacked layer slab
@@ -236,7 +247,8 @@ def gpt_pp_loss(params, tokens, targets, cfg: GPTConfig,
         return transformer_block(h, p, cfg.head_dim, tp_axis, None,
                                  causal=True)
 
-    y_mb = pipeline_apply(x_mb, params["blocks"], blk, pp_axis)
+    y_mb = pipeline_apply(x_mb, params["blocks"], blk, pp_axis,
+                          remat=remat)
     y = y_mb.reshape(B, S, -1)
     nll = _readout_nll(params, y, targets)
     # only the last stage's outputs are real; other stages' readout math
@@ -249,7 +261,8 @@ def gpt_pp_loss(params, tokens, targets, cfg: GPTConfig,
 def gpt_loss(params, tokens, targets, cfg: GPTConfig,
              dp_axis: Optional[str] = None,
              tp_axis: Optional[str] = None,
-             sp_axis: Optional[str] = None) -> jnp.ndarray:
+             sp_axis: Optional[str] = None,
+             remat: bool = False) -> jnp.ndarray:
     """Mean next-token cross-entropy, identical (replicated) on every device.
 
     The replication is what makes per-device ``jax.grad`` correct under
@@ -257,7 +270,8 @@ def gpt_loss(params, tokens, targets, cfg: GPTConfig,
     dp/sp-replicated weights need a psum over (dp, sp) — exactly the
     aggregation `DistributedOptimizer` / `sync_grads` provide.
     """
-    logits = gpt_forward(params, tokens, cfg, tp_axis, sp_axis)
+    logits = gpt_forward(params, tokens, cfg, tp_axis, sp_axis,
+                         remat=remat)
     loss = _nll(logits, targets).mean()
     axes = tuple(a for a in (dp_axis, sp_axis) if a is not None)
     if axes:

@@ -27,6 +27,7 @@ from byteps_tpu.models.gpt import (
     block_specs,
 )
 from byteps_tpu.parallel.moe import moe_ffn, moe_init, moe_specs
+from byteps_tpu.parallel.remat import maybe_remat
 
 
 @dataclasses.dataclass(frozen=True)
@@ -97,15 +98,21 @@ def moe_transformer_block(x, p, cfg: MoEGPTConfig,
 
 
 def moe_gpt_loss(params, tokens, targets, cfg: MoEGPTConfig,
-                 ep_axis: Optional[str] = None) -> jnp.ndarray:
+                 ep_axis: Optional[str] = None,
+                 remat: bool = False) -> jnp.ndarray:
     """Per-device next-token loss + Switch aux loss (local mean — dp/ep
     averaging is the train step's job)."""
     B, S = tokens.shape
     pos = jnp.arange(S)
     x = (params["wte"][tokens] + params["wpe"][pos]).astype(cfg.dtype)
     aux_total = jnp.zeros((), jnp.float32)
+
+    def apply_block(x, p):
+        return moe_transformer_block(x, p, cfg, ep_axis)
+
+    apply_block = maybe_remat(apply_block, remat)
     for p in params["blocks"]:
-        x, aux = moe_transformer_block(x, p, cfg, ep_axis)
+        x, aux = apply_block(x, p)
         aux_total = aux_total + aux
     nll = _readout_nll(params, x, targets)
     return nll.mean() + cfg.aux_coef * aux_total / cfg.n_layers

@@ -157,12 +157,15 @@ def make_gpt_train_step(
     base_tx: optax.GradientTransformation,
     compression_params: Optional[Dict[str, Any]] = None,
     partition_bytes: Optional[int] = None,
+    remat: bool = False,
 ):
     """Returns ``(step, params, opt_state, batch_sharding)``.
 
     ``step(params, opt_state, tokens, targets) -> (loss, params, opt_state)``
     is jitted over ``mesh``; tokens/targets are global (B, S) arrays
-    sharded (dp, sp) by ``batch_sharding``.
+    sharded (dp, sp) by ``batch_sharding``. ``remat=True`` rematerializes
+    each transformer block in the backward pass (HBM for FLOPs — the
+    long-context lever; numerics unchanged).
     """
     dp, tp, sp = _axis(mesh, "dp"), _axis(mesh, "tp"), _axis(mesh, "sp")
     use_vma = compression_params is None
@@ -181,7 +184,8 @@ def make_gpt_train_step(
     # workers is DistributedOptimizer's job (push_pull average=True). A dp
     # pmean inside the loss would double-apply the 1/n_dp.
     loss_fn = functools.partial(
-        gpt_loss, cfg=cfg, dp_axis=None, tp_axis=tp, sp_axis=sp
+        gpt_loss, cfg=cfg, dp_axis=None, tp_axis=tp, sp_axis=sp,
+        remat=remat,
     )
 
     def build_jit(pb):
@@ -223,6 +227,7 @@ def make_gpt_pp_train_step(
     base_tx: optax.GradientTransformation,
     n_micro: int = 4,
     partition_bytes: Optional[int] = None,
+    remat: bool = False,
 ):
     """Pipeline-parallel GPT train step over a (pp, dp) mesh.
 
@@ -269,7 +274,7 @@ def make_gpt_pp_train_step(
     )
     batch_spec = P(dp)
     loss_fn = functools.partial(
-        gpt_pp_loss, cfg=cfg, pp_axis=pp, n_micro=n_micro
+        gpt_pp_loss, cfg=cfg, pp_axis=pp, n_micro=n_micro, remat=remat
     )
 
     def build_jit(pb):
@@ -315,6 +320,7 @@ def make_gpt_moe_train_step(
     mesh: Mesh,
     base_tx: optax.GradientTransformation,
     partition_bytes: Optional[int] = None,
+    remat: bool = False,
 ):
     """Expert-parallel MoE GPT train step over a (dp, ep) mesh.
 
@@ -352,7 +358,8 @@ def make_gpt_moe_train_step(
         params, pspecs, dp,
     )
     batch_spec = P((dp, ep) if dp and ep else (dp or ep))
-    loss_fn = functools.partial(moe_gpt_loss, cfg=cfg, ep_axis=ep)
+    loss_fn = functools.partial(moe_gpt_loss, cfg=cfg, ep_axis=ep,
+                                remat=remat)
 
     def _fix_ep(g, spec):
         if ep is None:
@@ -398,6 +405,7 @@ def make_bert_train_step(
     base_tx: optax.GradientTransformation,
     compression_params: Optional[Dict[str, Any]] = None,
     partition_bytes: Optional[int] = None,
+    remat: bool = False,
 ):
     """``step(params, opt_state, tokens, targets, mask)`` — MLM pretraining
     step (BASELINE config 3 shape), same sharding story as GPT."""
@@ -413,7 +421,8 @@ def make_bert_train_step(
     batch_spec = P(dp, sp)
     resym = _make_resymmetrize(pspecs, dp)
     loss_fn = functools.partial(
-        bert_mlm_loss, cfg=cfg, dp_axis=None, tp_axis=tp, sp_axis=sp
+        bert_mlm_loss, cfg=cfg, dp_axis=None, tp_axis=tp, sp_axis=sp,
+        remat=remat,
     )
 
     def build_jit(pb):

@@ -49,6 +49,7 @@ def pipeline_apply(
     stacked: Any,
     block_fn: Callable[[jnp.ndarray, Any], jnp.ndarray],
     pp_axis: str,
+    remat: bool = False,
 ) -> jnp.ndarray:
     """Run microbatches through the pp-staged layer pipeline.
 
@@ -70,9 +71,13 @@ def pipeline_apply(
     mb_shape = x_mb.shape[1:]
     perm = [(i, (i + 1) % nstages) for i in range(nstages)]
 
+    from byteps_tpu.parallel.remat import maybe_remat
+
+    fn = maybe_remat(block_fn, remat)
+
     def local_slab(x):
         def body(h, layer):
-            return block_fn(h, layer), None
+            return fn(h, layer), None
 
         h, _ = jax.lax.scan(body, x, stacked)
         return h

@@ -1,0 +1,19 @@
+"""Rematerialization helper shared by every model family and the pipeline."""
+
+from __future__ import annotations
+
+import jax
+
+
+def maybe_remat(fn, remat: bool):
+    """Wrap a per-layer block fn in ``jax.checkpoint`` when ``remat`` is on.
+
+    Full-block remat trades HBM for FLOPs — and, on tp/sp-sharded meshes,
+    for INTERCONNECT: the backward pass re-runs everything in the block,
+    including tp psums and sp ring-attention ppermutes, roughly doubling
+    per-layer collective traffic. If ICI is the bottleneck, switch to a
+    ``jax.checkpoint`` policy that saves collective outputs (e.g.
+    ``checkpoint_name`` on the collective results +
+    ``save_only_these_names``) instead of flipping this helper off.
+    """
+    return jax.checkpoint(fn) if remat else fn

@@ -109,3 +109,27 @@ def test_dp_only_mesh_with_compression():
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
+
+
+def test_remat_is_a_numerics_noop():
+    """remat=True recomputes activations in backward instead of storing
+    them — the loss trajectory must be identical to remat=False."""
+    from jax.sharding import Mesh
+
+    cfg = GPTConfig.tiny()
+    tokens, targets = synthetic_batch(jax.random.PRNGKey(5), cfg, 4, 32)
+
+    losses = {}
+    for remat in (False, True):
+        mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+        step, params, opt_state, bsh = make_gpt_train_step(
+            cfg, mesh, optax.adamw(1e-3), remat=remat
+        )
+        t = jax.device_put(tokens, bsh)
+        g = jax.device_put(targets, bsh)
+        ls = []
+        for _ in range(3):
+            loss, params, opt_state = step(params, opt_state, t, g)
+            ls.append(float(loss))
+        losses[remat] = ls
+    np.testing.assert_allclose(losses[False], losses[True], rtol=1e-6)

@@ -161,3 +161,30 @@ def test_gpt_pp_rejects_bad_configs():
                      n_layers=3, d_ff=64)
     with pytest.raises(ValueError, match="not divisible"):
         make_gpt_pp_train_step(cfg3, _mesh((2,), ("pp",)), optax.sgd(0.1))
+
+
+def test_pp_remat_is_a_numerics_noop():
+    import optax
+
+    from byteps_tpu.models import GPTConfig
+    from byteps_tpu.models.train import (
+        make_gpt_pp_train_step,
+        synthetic_batch,
+    )
+
+    cfg = GPTConfig.tiny()
+    tokens, targets = synthetic_batch(jax.random.PRNGKey(6), cfg, 4, 32)
+    losses = {}
+    for remat in (False, True):
+        mesh = _mesh((2,), ("pp",))
+        step, params, opt_state, bsh = make_gpt_pp_train_step(
+            cfg, mesh, optax.adamw(1e-3), n_micro=2, remat=remat
+        )
+        t = jax.device_put(tokens, bsh)
+        g = jax.device_put(targets, bsh)
+        ls = []
+        for _ in range(3):
+            loss, params, opt_state = step(params, opt_state, t, g)
+            ls.append(float(loss))
+        losses[remat] = ls
+    np.testing.assert_allclose(losses[False], losses[True], rtol=1e-6)
