@@ -217,7 +217,8 @@ def gpt_forward(params, tokens: jnp.ndarray, cfg: GPTConfig,
 def gpt_pp_loss(params, tokens, targets, cfg: GPTConfig,
                 pp_axis: str, n_micro: int,
                 tp_axis: Optional[str] = None,
-                remat: bool = False) -> jnp.ndarray:
+                remat: bool = False,
+                vma_axes: tuple = ()) -> jnp.ndarray:
     """Pipeline-parallel next-token loss (inside shard_map over pp).
 
     ``params["blocks"]`` is THIS stage's stacked layer slab
@@ -248,7 +249,7 @@ def gpt_pp_loss(params, tokens, targets, cfg: GPTConfig,
                                  causal=True)
 
     y_mb = pipeline_apply(x_mb, params["blocks"], blk, pp_axis,
-                          remat=remat)
+                          remat=remat, vma_axes=vma_axes)
     y = y_mb.reshape(B, S, -1)
     nll = _readout_nll(params, y, targets)
     # only the last stage's outputs are real; other stages' readout math

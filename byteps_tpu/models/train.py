@@ -113,6 +113,14 @@ def _finalize_step(build_jit, partition_bytes, dp):
     return build_jit(partition_bytes)
 
 
+def _collapse_vma(x):
+    """pmean away conservative VMA widening on a replicated value — a
+    numerical identity (the values already agree across the collapsed
+    axes); returns x untouched when it carries no varying axes."""
+    vma = tuple(sorted(getattr(jax.typeof(x), "vma", ()) or ()))
+    return jax.lax.pmean(x, vma) if vma else x
+
+
 def _spec_axes(spec) -> set:
     """Flatten a PartitionSpec's entries to the set of mesh axis names."""
     axes = set()
@@ -229,30 +237,33 @@ def make_gpt_pp_train_step(
     partition_bytes: Optional[int] = None,
     remat: bool = False,
 ):
-    """Pipeline-parallel GPT train step over a (pp, dp) mesh.
+    """Pipeline-parallel GPT train step over a (pp, dp[, tp]) mesh.
 
     Transformer blocks are stacked on a leading layer axis and sharded
     ``P('pp')`` — each stage owns n_layers/pp contiguous layers and its
     optimizer moments for them; microbatches flow stage-to-stage via
-    ppermute (GPipe schedule, backward derived by AD). dp aggregation is
-    DistributedOptimizer as everywhere else; grads of pp-replicated leaves
-    (embeddings, final LN) are psum'd over pp first. Compression is not
-    yet supported on the pp path (EF state is sized per-device and block
-    grads are pp-sharded).
+    ppermute (GPipe schedule, backward derived by AD). A tp axis composes
+    inside the stages (Megatron col/row-parallel matmuls per layer, their
+    psums typed by VMA — the step runs check_vma=True, so replicated
+    params' tp cotangents get their collectives auto-inserted exactly as
+    in the dense factory). dp aggregation is DistributedOptimizer as
+    everywhere else; grads of pp-replicated leaves (embeddings, final LN)
+    are psum'd over pp first. Compression is not yet supported on the pp
+    path (EF state is sized per-device and block grads are pp-sharded).
 
     Returns ``(step, params, opt_state, batch_sharding)`` like
     :func:`make_gpt_train_step`; ``params["blocks"]`` is the stacked slab.
     """
     from byteps_tpu.parallel.pipeline import stack_blocks, stacked_specs
 
-    dp, pp = _axis(mesh, "dp"), _axis(mesh, "pp")
+    dp, pp, tp = _axis(mesh, "dp"), _axis(mesh, "pp"), _axis(mesh, "tp")
     if pp is None:
         raise ValueError("mesh has no pp axis — use make_gpt_train_step")
-    for ax in ("tp", "sp"):
-        if _axis(mesh, ax) is not None:
-            raise NotImplementedError(
-                f"pp currently composes with dp only (mesh has {ax})"
-            )
+    if _axis(mesh, "sp") is not None:
+        raise NotImplementedError(
+            "pp currently composes with dp and tp (sp ring attention "
+            "inside pipeline stages is future work)"
+        )
     nstages = mesh.shape[pp]
     if cfg.n_layers % nstages != 0:
         raise ValueError(
@@ -266,25 +277,28 @@ def make_gpt_pp_train_step(
     }
     pspecs = {
         "wte": P(), "wpe": P(), "lnf_g": P(), "lnf_b": P(),
-        "blocks": stacked_specs(block_specs(None), pp),
+        "blocks": stacked_specs(block_specs(tp), pp),
     }
     params, opt_state, ospecs = _shard_params_state(
         mesh, _make_tx(mesh, base_tx, None, partition_bytes, dp),
         params, pspecs, dp,
     )
     batch_spec = P(dp)
+    resym = _make_resymmetrize(pspecs, dp)
     loss_fn = functools.partial(
-        gpt_pp_loss, cfg=cfg, pp_axis=pp, n_micro=n_micro, remat=remat
+        gpt_pp_loss, cfg=cfg, pp_axis=pp, n_micro=n_micro, tp_axis=tp,
+        remat=remat, vma_axes=tuple(mesh.axis_names),
     )
 
     def build_jit(pb):
         tx = _make_tx(mesh, base_tx, None, pb, dp)
 
         def per_device_step(params, opt_state, tokens, targets):
+            grad_params = _pcast_dp(params, dp, mesh, True)
             # loss_fn returns the last-stage-masked loss: grading through
             # an already-replicated psum double-counts (psum transpose)
             loss, grads = jax.value_and_grad(loss_fn)(
-                params, tokens, targets
+                grad_params, tokens, targets
             )
             loss = jax.lax.psum(loss, pp)  # replicate for reporting
             # stage-partial grads of the pp-replicated leaves sum to the
@@ -294,10 +308,16 @@ def make_gpt_pp_train_step(
                    for k in ("wte", "wpe", "lnf_g", "lnf_b")},
                 "blocks": grads["blocks"],
             }
+            # collapse conservative VMA widening (tp, residual pp) — a
+            # numerical identity, values already agree
+            grads = resym(grads)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             if dp is not None:
                 loss = jax.lax.pmean(loss, dp)
+            # collapse conservative VMA widening on the reported scalar
+            # (the pipeline widens to every axis)
+            loss = _collapse_vma(loss)
             return loss, params, opt_state
 
         sharded = jax.shard_map(
@@ -305,7 +325,7 @@ def make_gpt_pp_train_step(
             mesh=mesh,
             in_specs=(pspecs, ospecs, batch_spec, batch_spec),
             out_specs=(P(), pspecs, ospecs),
-            check_vma=False,
+            check_vma=True,
         )
         return jax.jit(sharded, donate_argnums=(0, 1))
 
@@ -499,15 +519,7 @@ def make_resnet_train_step(
                 grads = resym(grads)
                 # SyncBN pmean makes stats unvarying, but conservative VMA
                 # can widen the state type the same way it widens grads
-                new_bn = jax.tree.map(
-                    lambda s: jax.lax.pmean(
-                        s, tuple(sorted(
-                            a for a in
-                            (getattr(jax.typeof(s), "vma", ()) or ())
-                        ))
-                    ) if (getattr(jax.typeof(s), "vma", ()) or ()) else s,
-                    new_bn,
-                )
+                new_bn = jax.tree.map(_collapse_vma, new_bn)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             if dp is not None:

@@ -24,6 +24,8 @@ from typing import Any, Callable, List
 import jax
 import jax.numpy as jnp
 
+from byteps_tpu.parallel.remat import maybe_remat
+
 
 def stack_blocks(blocks: List[Any]):
     """Stack a list of identically-shaped block pytrees into one pytree
@@ -44,12 +46,28 @@ def stacked_specs(block_spec, pp_axis: str):
     )
 
 
+def _widen_to(axes):
+    """Return f(z) casting z's VMA type up to exactly ``axes`` (adding any
+    missing ones as varying). ONLY call under ``check_vma=True``: without
+    VMA types every axis looks missing and pcast's transpose (a psum over
+    a varying operand) breaks differentiation — pipeline_apply guards the
+    call site on vma_mode for exactly this reason."""
+
+    def widen(z):
+        have = set(getattr(jax.typeof(z), "vma", ()) or ())
+        need = tuple(sorted(set(axes) - have))
+        return jax.lax.pcast(z, need, to="varying") if need else z
+
+    return widen
+
+
 def pipeline_apply(
     x_mb: jnp.ndarray,
     stacked: Any,
     block_fn: Callable[[jnp.ndarray, Any], jnp.ndarray],
     pp_axis: str,
     remat: bool = False,
+    vma_axes: tuple = (),
 ) -> jnp.ndarray:
     """Run microbatches through the pp-staged layer pipeline.
 
@@ -64,14 +82,28 @@ def pipeline_apply(
     (a ``lax.scan`` over its layers) and ships the result to the next
     stage via ring ``ppermute`` (the wraparound edge feeds stage 0, which
     ignores it in favor of the next injected microbatch).
+
+    Under ``check_vma=True`` pass ``vma_axes`` = the mesh axes the carried
+    activations may vary over (e.g. every mesh axis name): the scan carry
+    must be a type fixed point, so both the zero init and each tick's
+    block output are widened to ``vma_axes ∪ {pp}`` — a block whose
+    row-parallel psum makes outputs tp-INvariant would otherwise narrow
+    the carry type mid-scan. Widening is semantically free (varying is
+    the weaker claim); collapse it downstream with a pmean if needed.
     """
     nstages = jax.lax.axis_size(pp_axis)
     stage = jax.lax.axis_index(pp_axis)
     M = x_mb.shape[0]
     mb_shape = x_mb.shape[1:]
     perm = [(i, (i + 1) % nstages) for i in range(nstages)]
-
-    from byteps_tpu.parallel.remat import maybe_remat
+    # widen only under check_vma=True (where axis_index is typed varying):
+    # pcast's transpose is a psum whose operand must be varying, so a
+    # widen under check_vma=False would break differentiation
+    vma_mode = bool(getattr(jax.typeof(stage), "vma", ()) or ())
+    widen = (
+        _widen_to(tuple(set(vma_axes) | {pp_axis})) if vma_mode
+        else (lambda z: z)
+    )
 
     fn = maybe_remat(block_fn, remat)
 
@@ -86,7 +118,7 @@ def pipeline_apply(
         recv, outs = carry
         inject = x_mb[jnp.clip(t, 0, M - 1)]
         xin = jnp.where(stage == 0, inject, recv)
-        y = local_slab(xin)
+        y = widen(local_slab(xin))
         out_t = t - (nstages - 1)
         valid = (out_t >= 0) & (out_t < M) & (stage == nstages - 1)
         start = (jnp.clip(out_t, 0, M - 1),) + (0,) * len(mb_shape)
@@ -101,6 +133,10 @@ def pipeline_apply(
         jnp.zeros(mb_shape, x_mb.dtype),
         jnp.zeros((M,) + mb_shape, x_mb.dtype),
     )
+    # under check_vma=True the tick outputs are (at least) pp-varying
+    # (axis_index / ppermute), so the zero init must be cast to match the
+    # carry type; a no-op under check_vma=False
+    init = jax.tree.map(widen, init)
     (_, outs), _ = jax.lax.scan(
         tick, init, jnp.arange(M + nstages - 1)
     )
