@@ -217,6 +217,7 @@ def gpt_forward(params, tokens: jnp.ndarray, cfg: GPTConfig,
 def gpt_pp_loss(params, tokens, targets, cfg: GPTConfig,
                 pp_axis: str, n_micro: int,
                 tp_axis: Optional[str] = None,
+                sp_axis: Optional[str] = None,
                 remat: bool = False,
                 vma_axes: tuple = ()) -> jnp.ndarray:
     """Pipeline-parallel next-token loss (inside shard_map over pp).
@@ -236,27 +237,34 @@ def gpt_pp_loss(params, tokens, targets, cfg: GPTConfig,
     """
     from byteps_tpu.parallel.pipeline import pipeline_apply
 
-    B, S = tokens.shape
+    B, S_loc = tokens.shape
     if B % n_micro != 0:
         raise ValueError(f"local batch {B} not divisible by {n_micro} "
                          "microbatches")
-    pos = jnp.arange(S)
+    off = (jax.lax.axis_index(sp_axis) * S_loc if sp_axis is not None
+           else 0)
+    pos = off + jnp.arange(S_loc)
     x = (params["wte"][tokens] + params["wpe"][pos]).astype(cfg.dtype)
-    x_mb = x.reshape(n_micro, B // n_micro, S, x.shape[-1])
+    x_mb = x.reshape(n_micro, B // n_micro, S_loc, x.shape[-1])
 
     def blk(h, p):
-        return transformer_block(h, p, cfg.head_dim, tp_axis, None,
+        return transformer_block(h, p, cfg.head_dim, tp_axis, sp_axis,
                                  causal=True)
 
     y_mb = pipeline_apply(x_mb, params["blocks"], blk, pp_axis,
                           remat=remat, vma_axes=vma_axes)
-    y = y_mb.reshape(B, S, -1)
+    y = y_mb.reshape(B, S_loc, -1)
     nll = _readout_nll(params, y, targets)
+    loss = nll.mean()
+    if sp_axis is not None:
+        # mean over the sequence shards (inside the grad — VMA types the
+        # sp pmean's transpose correctly, unlike the pp axis below)
+        loss = jax.lax.pmean(loss, sp_axis)
     # only the last stage's outputs are real; other stages' readout math
     # above is masked dead weight (grads through it are zeroed here)
     stage = jax.lax.axis_index(pp_axis)
     nstages = jax.lax.axis_size(pp_axis)
-    return jnp.where(stage == nstages - 1, nll.mean(), 0.0)
+    return jnp.where(stage == nstages - 1, loss, 0.0)
 
 
 def gpt_loss(params, tokens, targets, cfg: GPTConfig,

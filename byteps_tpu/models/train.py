@@ -237,17 +237,18 @@ def make_gpt_pp_train_step(
     partition_bytes: Optional[int] = None,
     remat: bool = False,
 ):
-    """Pipeline-parallel GPT train step over a (pp, dp[, tp]) mesh.
+    """Pipeline-parallel GPT train step over a (pp, dp[, tp][, sp]) mesh.
 
     Transformer blocks are stacked on a leading layer axis and sharded
     ``P('pp')`` — each stage owns n_layers/pp contiguous layers and its
     optimizer moments for them; microbatches flow stage-to-stage via
-    ppermute (GPipe schedule, backward derived by AD). A tp axis composes
-    inside the stages (Megatron col/row-parallel matmuls per layer, their
-    psums typed by VMA — the step runs check_vma=True, so replicated
-    params' tp cotangents get their collectives auto-inserted exactly as
-    in the dense factory). dp aggregation is DistributedOptimizer as
-    everywhere else; grads of pp-replicated leaves (embeddings, final LN)
+    ppermute (GPipe schedule, backward derived by AD). tp and sp axes
+    compose inside the stages (Megatron col/row-parallel matmuls and ring
+    attention per layer, their collectives typed by VMA — the step runs
+    check_vma=True, so replicated params' cotangents get their psums
+    auto-inserted exactly as in the dense factory). dp aggregation is
+    DistributedOptimizer as everywhere else; grads of pp-replicated
+    leaves (embeddings, final LN)
     are psum'd over pp first. Compression is not yet supported on the pp
     path (EF state is sized per-device and block grads are pp-sharded).
 
@@ -256,14 +257,10 @@ def make_gpt_pp_train_step(
     """
     from byteps_tpu.parallel.pipeline import stack_blocks, stacked_specs
 
-    dp, pp, tp = _axis(mesh, "dp"), _axis(mesh, "pp"), _axis(mesh, "tp")
+    dp, pp = _axis(mesh, "dp"), _axis(mesh, "pp")
+    tp, sp = _axis(mesh, "tp"), _axis(mesh, "sp")
     if pp is None:
         raise ValueError("mesh has no pp axis — use make_gpt_train_step")
-    if _axis(mesh, "sp") is not None:
-        raise NotImplementedError(
-            "pp currently composes with dp and tp (sp ring attention "
-            "inside pipeline stages is future work)"
-        )
     nstages = mesh.shape[pp]
     if cfg.n_layers % nstages != 0:
         raise ValueError(
@@ -283,11 +280,11 @@ def make_gpt_pp_train_step(
         mesh, _make_tx(mesh, base_tx, None, partition_bytes, dp),
         params, pspecs, dp,
     )
-    batch_spec = P(dp)
+    batch_spec = P(dp, sp)
     resym = _make_resymmetrize(pspecs, dp)
     loss_fn = functools.partial(
         gpt_pp_loss, cfg=cfg, pp_axis=pp, n_micro=n_micro, tp_axis=tp,
-        remat=remat, vma_axes=tuple(mesh.axis_names),
+        sp_axis=sp, remat=remat, vma_axes=tuple(mesh.axis_names),
     )
 
     def build_jit(pb):

@@ -186,6 +186,41 @@ def test_gpt_pp_tp_matches_dp_only_training():
     assert np.isfinite(float(l_pp))
 
 
+def test_gpt_pp_sp_matches_dp_only_training():
+    """(pp=2, dp=2, sp=2) — ring attention inside pipeline stages — still
+    tracks dp-only training step-for-step."""
+    from byteps_tpu.models import GPTConfig
+    from byteps_tpu.models.train import (
+        make_gpt_pp_train_step,
+        make_gpt_train_step,
+        synthetic_batch,
+    )
+
+    cfg = GPTConfig.tiny()
+    B, S = 8, 32
+    tokens, targets = synthetic_batch(jax.random.PRNGKey(11), cfg, B, S)
+
+    mesh_pp = _mesh((2, 2, 2), ("pp", "dp", "sp"))
+    step_pp, params_pp, opt_pp, bsh_pp = make_gpt_pp_train_step(
+        cfg, mesh_pp, optax.adamw(1e-3), n_micro=2
+    )
+    mesh_dp = _mesh((2,), ("dp",))
+    step_dp, params_dp, opt_dp, bsh_dp = make_gpt_train_step(
+        cfg, mesh_dp, optax.adamw(1e-3)
+    )
+
+    t_pp = jax.device_put(tokens, bsh_pp)
+    g_pp = jax.device_put(targets, bsh_pp)
+    t_dp = jax.device_put(tokens, bsh_dp)
+    g_dp = jax.device_put(targets, bsh_dp)
+    for _ in range(3):
+        l_pp, params_pp, opt_pp = step_pp(params_pp, opt_pp, t_pp, g_pp)
+        l_dp, params_dp, opt_dp = step_dp(params_dp, opt_dp, t_dp, g_dp)
+        np.testing.assert_allclose(float(l_pp), float(l_dp),
+                                   rtol=2e-4, atol=2e-4)
+    assert np.isfinite(float(l_pp))
+
+
 def test_gpt_pp_rejects_bad_configs():
     from byteps_tpu.models import GPTConfig
     from byteps_tpu.models.train import make_gpt_pp_train_step
