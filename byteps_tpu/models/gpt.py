@@ -165,6 +165,17 @@ def block_specs(tp_axis):
     }
 
 
+def _embed(params, tokens: jnp.ndarray, cfg: GPTConfig,
+           sp_axis) -> jnp.ndarray:
+    """Token + position embeddings with the sequence-shard offset, shared
+    by the dense and pipelined paths."""
+    S_loc = tokens.shape[1]
+    off = (jax.lax.axis_index(sp_axis) * S_loc if sp_axis is not None
+           else 0)
+    pos = off + jnp.arange(S_loc)
+    return (params["wte"][tokens] + params["wpe"][pos]).astype(cfg.dtype)
+
+
 def _readout(params, h: jnp.ndarray) -> jnp.ndarray:
     """Final LN → weight-tied fp32 readout, shared by the dense and
     pipelined paths so their numerics cannot diverge."""
@@ -192,13 +203,7 @@ def gpt_forward(params, tokens: jnp.ndarray, cfg: GPTConfig,
     weights its tp shard; output logits stay tp/dp/sp-local (replicated
     over tp by construction).
     """
-    B, S_loc = tokens.shape
-    if sp_axis is not None:
-        off = jax.lax.axis_index(sp_axis) * S_loc
-    else:
-        off = 0
-    pos = off + jnp.arange(S_loc)
-    x = (params["wte"][tokens] + params["wpe"][pos]).astype(cfg.dtype)
+    x = _embed(params, tokens, cfg, sp_axis)
 
     def apply_block(x, p):
         return transformer_block(x, p, cfg.head_dim, tp_axis, sp_axis,
@@ -241,10 +246,7 @@ def gpt_pp_loss(params, tokens, targets, cfg: GPTConfig,
     if B % n_micro != 0:
         raise ValueError(f"local batch {B} not divisible by {n_micro} "
                          "microbatches")
-    off = (jax.lax.axis_index(sp_axis) * S_loc if sp_axis is not None
-           else 0)
-    pos = off + jnp.arange(S_loc)
-    x = (params["wte"][tokens] + params["wpe"][pos]).astype(cfg.dtype)
+    x = _embed(params, tokens, cfg, sp_axis)
     x_mb = x.reshape(n_micro, B // n_micro, S_loc, x.shape[-1])
 
     def blk(h, p):

@@ -35,6 +35,7 @@ class MoEGPTConfig(GPTConfig):
     n_experts: int = 8
     capacity_factor: float = 1.25
     aux_coef: float = 0.01
+    router_topk: int = 1  # 1 = Switch, 2 = GShard-style top-2
 
     @classmethod
     def tiny(cls) -> "MoEGPTConfig":
@@ -93,7 +94,8 @@ def moe_transformer_block(x, p, cfg: MoEGPTConfig,
     x = x + _attention(_layernorm(x, p["ln1_g"], p["ln1_b"]), p,
                        cfg.head_dim, None, None, causal=True)
     m, aux = moe_ffn(_layernorm(x, p["ln2_g"], p["ln2_b"]), p["moe"],
-                     cfg.capacity_factor, ep_axis)
+                     cfg.capacity_factor, ep_axis,
+                     router_topk=cfg.router_topk)
     return x + m, aux
 
 

@@ -12,7 +12,8 @@ called inside ``jax.shard_map`` over a mesh built by :func:`make_mesh`.
 """
 
 from byteps_tpu.parallel.mesh import MeshAxes, make_mesh, factor_devices
-from byteps_tpu.parallel.moe import moe_ffn, moe_init, moe_specs, top1_dispatch
+from byteps_tpu.parallel.moe import (moe_ffn, moe_init, moe_specs,
+                                     top1_dispatch, topk_dispatch)
 from byteps_tpu.parallel.pipeline import (
     last_stage_value,
     pipeline_apply,
@@ -34,6 +35,7 @@ __all__ = [
     "moe_init",
     "moe_specs",
     "top1_dispatch",
+    "topk_dispatch",
     "pipeline_apply",
     "stack_blocks",
     "stacked_specs",

@@ -3,7 +3,7 @@ dispatch.
 
 The reference is data-parallel only; ep is the last of the "beyond
 reference" mesh axes (pp/tp/sp being the others). TPU-first design
-(Switch-Transformer style): top-1 gating with a static per-expert
+(Switch/GShard style): top-1 or top-2 gating with a static per-expert
 capacity (XLA needs static shapes — tokens beyond capacity are dropped,
 their residual path passes through untouched), dispatch/combine as
 einsums against a one-hot (token, expert, slot) tensor so the MXU does
@@ -25,34 +25,60 @@ import jax
 import jax.numpy as jnp
 
 
-def top1_dispatch(gate_logits: jnp.ndarray, capacity: int):
-    """Top-1 routing tensors from ``(T, E)`` gate logits.
+def topk_dispatch(gate_logits: jnp.ndarray, capacity: int, k: int = 1):
+    """Top-k routing tensors from ``(T, E)`` gate logits (k=1: Switch;
+    k=2: GShard-style, second choices take slots after first choices and
+    the two gates renormalize to sum 1 per token).
 
     Returns ``(dispatch, combine, aux_loss)``: ``dispatch`` is a one-hot
-    ``(T, E, C)`` float tensor mapping each kept token to its (expert,
-    slot); ``combine`` is ``dispatch`` scaled by the token's gate
-    probability; ``aux_loss`` is the Switch load-balancing loss
-    (mean_e frac_tokens_e · mean_prob_e · E).
+    ``(T, E, C)`` float tensor mapping each kept (token, choice) to its
+    (expert, slot); ``combine`` is ``dispatch`` scaled by the choice's
+    gate weight; ``aux_loss`` is the Switch load-balancing loss on the
+    FIRST choice (mean_e frac_tokens_e · mean_prob_e · E).
     """
     T, E = gate_logits.shape
     probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
-    expert = jnp.argmax(probs, axis=-1)                    # (T,)
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
-    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # (T, E)
-    # slot index = this token's rank among earlier tokens of its expert
-    slot = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot     # (T, E)
-    kept = (slot < capacity) & (onehot > 0)
-    slot_oh = jax.nn.one_hot(
-        jnp.sum(slot, axis=-1).astype(jnp.int32), capacity,
-        dtype=jnp.float32,
-    )                                                      # (T, C)
-    dispatch = (
-        kept.astype(jnp.float32)[:, :, None] * slot_oh[:, None, :]
-    )                                                      # (T, E, C)
-    combine = dispatch * gate[:, None, None]
-    frac = onehot.mean(axis=0)                             # tokens per expert
+    remaining = probs
+    onehots, gates = [], []
+    for _ in range(k):
+        expert = jnp.argmax(remaining, axis=-1)            # (T,)
+        oh = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # (T, E)
+        gates.append(jnp.sum(probs * oh, axis=-1))
+        onehots.append(oh)
+        remaining = remaining * (1.0 - oh)
+    if k > 1:
+        # renormalize so each token's kept choices sum to 1 (GShard).
+        # NEVER for k=1: that would collapse every weight to exactly 1.0,
+        # silencing the router's gradient through the task loss — Switch
+        # keeps the raw softmax prob as the combine weight
+        gate_sum = sum(gates)
+        gates = [g / jnp.maximum(gate_sum, 1e-9) for g in gates]
+
+    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    used = jnp.zeros((E,), jnp.float32)  # slots consumed by earlier ranks
+    for oh, gate in zip(onehots, gates):
+        # slot = rank among earlier tokens of this expert AT THIS CHOICE
+        # rank, offset by slots used by earlier choice ranks
+        slot = (jnp.cumsum(oh, axis=0) - 1.0) * oh + used[None, :] * oh
+        kept = (slot < capacity) & (oh > 0)
+        slot_oh = jax.nn.one_hot(
+            jnp.sum(jnp.clip(slot, 0, capacity - 1),
+                    axis=-1).astype(jnp.int32),
+            capacity, dtype=jnp.float32,
+        )
+        d = kept.astype(jnp.float32)[:, :, None] * slot_oh[:, None, :]
+        dispatch = dispatch + d
+        combine = combine + d * gate[:, None, None]
+        used = used + jnp.sum(oh, axis=0)
+    frac = onehots[0].mean(axis=0)
     aux = E * jnp.sum(frac * probs.mean(axis=0))
     return dispatch, combine, aux
+
+
+def top1_dispatch(gate_logits: jnp.ndarray, capacity: int):
+    """Switch-style top-1 routing (see :func:`topk_dispatch`)."""
+    return topk_dispatch(gate_logits, capacity, k=1)
 
 
 def moe_ffn(
@@ -61,6 +87,7 @@ def moe_ffn(
     capacity_factor: float = 1.25,
     ep_axis: Optional[str] = None,
     activation=jax.nn.gelu,
+    router_topk: int = 1,
 ):
     """MoE feed-forward over the trailing feature dim of ``x (..., d)``.
 
@@ -86,8 +113,8 @@ def moe_ffn(
     # matmuls and the all_to_all payload run in x.dtype like the dense
     # family's _mlp — bf16 configs keep full MXU rate and half ICI bytes
     gate_logits = xt.astype(jnp.float32) @ params["wg"].astype(jnp.float32)
-    cap = max(1, int(capacity_factor * T / E))
-    dispatch, combine, aux = top1_dispatch(gate_logits, cap)
+    cap = max(1, int(capacity_factor * router_topk * T / E))
+    dispatch, combine, aux = topk_dispatch(gate_logits, cap, k=router_topk)
     slots = jnp.einsum(
         "tec,td->ecd", dispatch.astype(x.dtype), xt
     )                                                      # (E, cap, d)

@@ -149,3 +149,90 @@ def test_moe_gpt_rejects_bad_expert_count():
     mesh = Mesh(np.array(jax.devices()[:2]), ("ep",))
     with pytest.raises(ValueError, match="not divisible"):
         make_gpt_moe_train_step(cfg, mesh, optax.sgd(0.1))
+
+
+def test_top2_dispatch_semantics():
+    from byteps_tpu.parallel.moe import topk_dispatch
+
+    # 3 tokens, 3 experts: logits pick distinct top-2 per token
+    logits = jnp.asarray([
+        [5.0, 4.0, 0.0],   # -> experts 0, 1
+        [0.0, 5.0, 4.0],   # -> experts 1, 2
+        [4.0, 0.0, 5.0],   # -> experts 2, 0
+    ])
+    dispatch, combine, aux = topk_dispatch(logits, capacity=4, k=2)
+    # every (token, choice) kept: 6 dispatch entries
+    assert float(dispatch.sum()) == 6.0
+    # per-token combine weights renormalize to 1
+    np.testing.assert_allclose(
+        np.asarray(combine.sum(axis=(1, 2))), 1.0, rtol=1e-6
+    )
+    # no slot double-booked: per (expert, slot) at most one token
+    assert float(dispatch.sum(axis=0).max()) <= 1.0
+    assert np.isfinite(float(aux))
+
+
+def test_top2_second_choice_respects_capacity():
+    from byteps_tpu.parallel.moe import topk_dispatch
+
+    # 4 tokens all with first choice expert 0, second choice expert 1;
+    # capacity 2: only 2 first choices and 2 second choices survive
+    logits = jnp.zeros((4, 2)).at[:, 0].set(5.0).at[:, 1].set(4.0)
+    dispatch, combine, _ = topk_dispatch(logits, capacity=2, k=2)
+    assert float(dispatch[:, 0].sum()) == 2.0
+    assert float(dispatch[:, 1].sum()) == 2.0
+
+
+def test_moe_ffn_top2_ep_matches_dense(moe_params):
+    x = jnp.asarray(np.random.RandomState(4).randn(24, 16).astype(np.float32))
+    dense, aux_d = moe_ffn(x, moe_params, capacity_factor=8.0,
+                           router_topk=2)
+    mesh = _mesh(4)
+    sharded, specs = _shard_params(moe_params, mesh)
+    y, aux = jax.jit(jax.shard_map(
+        lambda x, p: moe_ffn(x, p, capacity_factor=8.0, ep_axis="ep",
+                             router_topk=2),
+        mesh=mesh, in_specs=(P(), specs), out_specs=(P(), P()),
+        check_vma=False,
+    ))(x, sharded)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(dense),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(aux), float(aux_d), rtol=1e-6)
+
+
+def test_moe_gpt_trains_with_top2():
+    import dataclasses
+
+    import optax
+
+    from byteps_tpu.models.moe_gpt import MoEGPTConfig
+    from byteps_tpu.models.train import make_gpt_moe_train_step, synthetic_batch
+
+    cfg = dataclasses.replace(MoEGPTConfig.tiny(), router_topk=2)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "ep"))
+    step, p, o, bsh = make_gpt_moe_train_step(cfg, mesh, optax.adamw(1e-3))
+    tok, tgt = synthetic_batch(jax.random.PRNGKey(8), cfg, 8, 32)
+    t, g = jax.device_put(tok, bsh), jax.device_put(tgt, bsh)
+    first = None
+    for _ in range(5):
+        loss, p, o = step(p, o, t, g)
+        if first is None:
+            first = float(loss)
+    assert np.isfinite(float(loss)) and float(loss) < first
+
+
+def test_top1_combine_uses_raw_softmax_prob():
+    """Switch semantics: the top-1 combine weight is the router's softmax
+    probability (NOT renormalized to 1.0 — that would silence the router's
+    gradient through the task loss)."""
+    from byteps_tpu.parallel.moe import topk_dispatch
+
+    logits = jnp.asarray([[2.0, 0.0, 0.0]])
+    dispatch, combine, _ = topk_dispatch(logits, capacity=2, k=1)
+    want = float(jax.nn.softmax(logits, axis=-1)[0, 0])
+    np.testing.assert_allclose(float(combine.sum()), want, rtol=1e-6)
+    # and the router gets task-loss gradient through combine
+    g = jax.grad(
+        lambda lg: topk_dispatch(lg, capacity=2, k=1)[1].sum()
+    )(logits)
+    assert float(jnp.abs(g).max()) > 1e-3
