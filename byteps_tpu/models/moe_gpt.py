@@ -70,37 +70,40 @@ def moe_gpt_init(rng, cfg: MoEGPTConfig) -> Dict[str, Any]:
     }
 
 
-def moe_block_specs(ep_axis: Optional[str]):
+def moe_block_specs(ep_axis: Optional[str], tp_axis: Optional[str] = None):
     # derive from the dense family's specs exactly like moe_block_init
     # derives from block_init, so new attention params cannot diverge
-    s = block_specs(None)
+    s = block_specs(tp_axis)
     for k in ("w1", "b1", "w2", "b2"):
         del s[k]
-    s["moe"] = moe_specs(ep_axis)
+    s["moe"] = moe_specs(ep_axis, tp_axis)
     return s
 
 
-def moe_gpt_param_specs(cfg: MoEGPTConfig,
-                        ep_axis: Optional[str]) -> Dict[str, Any]:
+def moe_gpt_param_specs(cfg: MoEGPTConfig, ep_axis: Optional[str],
+                        tp_axis: Optional[str] = None) -> Dict[str, Any]:
     return {
         "wte": P(), "wpe": P(), "lnf_g": P(), "lnf_b": P(),
-        "blocks": [moe_block_specs(ep_axis) for _ in range(cfg.n_layers)],
+        "blocks": [moe_block_specs(ep_axis, tp_axis)
+                   for _ in range(cfg.n_layers)],
     }
 
 
 def moe_transformer_block(x, p, cfg: MoEGPTConfig,
-                          ep_axis: Optional[str]):
+                          ep_axis: Optional[str],
+                          tp_axis: Optional[str] = None):
     """Pre-LN attention + MoE FFN; returns (x, aux_loss)."""
     x = x + _attention(_layernorm(x, p["ln1_g"], p["ln1_b"]), p,
-                       cfg.head_dim, None, None, causal=True)
+                       cfg.head_dim, tp_axis, None, causal=True)
     m, aux = moe_ffn(_layernorm(x, p["ln2_g"], p["ln2_b"]), p["moe"],
                      cfg.capacity_factor, ep_axis,
-                     router_topk=cfg.router_topk)
+                     router_topk=cfg.router_topk, tp_axis=tp_axis)
     return x + m, aux
 
 
 def moe_gpt_loss(params, tokens, targets, cfg: MoEGPTConfig,
                  ep_axis: Optional[str] = None,
+                 tp_axis: Optional[str] = None,
                  remat: bool = False) -> jnp.ndarray:
     """Per-device next-token loss + Switch aux loss (local mean — dp/ep
     averaging is the train step's job)."""
@@ -110,7 +113,7 @@ def moe_gpt_loss(params, tokens, targets, cfg: MoEGPTConfig,
     aux_total = jnp.zeros((), jnp.float32)
 
     def apply_block(x, p):
-        return moe_transformer_block(x, p, cfg, ep_axis)
+        return moe_transformer_block(x, p, cfg, ep_axis, tp_axis)
 
     apply_block = maybe_remat(apply_block, remat)
     for p in params["blocks"]:

@@ -339,14 +339,15 @@ def make_gpt_moe_train_step(
     partition_bytes: Optional[int] = None,
     remat: bool = False,
 ):
-    """Expert-parallel MoE GPT train step over a (dp, ep) mesh.
+    """Expert-parallel MoE GPT train step over a (dp, ep[, tp]) mesh.
 
     The batch shards over dp AND ep (every device routes its own tokens to
-    all experts via all_to_all); expert-stacked FFN weights shard P('ep').
-    Gradient assembly treats the global loss as the mean of per-device
-    local means: expert-slab grads already SUM their ep peers' token
-    contributions through the all_to_all transpose, so they divide by
-    ep; everything else pmeans over ep; dp averaging stays in
+    all experts via all_to_all); expert-stacked FFN weights shard P('ep')
+    and, with a tp axis, Megatron col/row shard their ff dim (attention
+    runs tp-parallel too). The step runs check_vma=True: VMA auto-inserts
+    the collectives for replicated-param cotangents over ep/tp, and one
+    uniform /ep turns the summed per-device grads into the mean the
+    mean-of-local-means loss needs; dp averaging stays in
     DistributedOptimizer as everywhere else.
 
     Returns ``(step, params, opt_state, batch_sharding)``.
@@ -357,48 +358,50 @@ def make_gpt_moe_train_step(
         moe_gpt_param_specs,
     )
 
-    dp, ep = _axis(mesh, "dp"), _axis(mesh, "ep")
-    for ax in ("tp", "sp", "pp"):
+    dp, ep, tp = _axis(mesh, "dp"), _axis(mesh, "ep"), _axis(mesh, "tp")
+    for ax in ("sp", "pp"):
         if _axis(mesh, ax) is not None:
             raise NotImplementedError(
-                f"MoE currently composes dp x ep only (mesh has {ax})"
+                f"MoE currently composes dp x ep x tp (mesh has {ax})"
             )
     ep_size = mesh.shape[ep] if ep is not None else 1
     if ep is not None and cfg.n_experts % ep_size != 0:
         raise ValueError(
             f"n_experts={cfg.n_experts} not divisible by ep={ep_size}"
         )
-    pspecs = moe_gpt_param_specs(cfg, ep)
+    pspecs = moe_gpt_param_specs(cfg, ep, tp)
     params = moe_gpt_init(jax.random.PRNGKey(0), cfg)
     params, opt_state, ospecs = _shard_params_state(
         mesh, _make_tx(mesh, base_tx, None, partition_bytes, dp),
         params, pspecs, dp,
     )
     batch_spec = P((dp, ep) if dp and ep else (dp or ep))
+    resym = _make_resymmetrize(pspecs, dp)
     loss_fn = functools.partial(moe_gpt_loss, cfg=cfg, ep_axis=ep,
-                                remat=remat)
-
-    def _fix_ep(g, spec):
-        if ep is None:
-            return g
-        if ep in _spec_axes(spec):  # expert slab: peers' sums included
-            return g / ep_size
-        return jax.lax.pmean(g, ep)
+                                tp_axis=tp, remat=remat)
 
     def build_jit(pb):
         tx = _make_tx(mesh, base_tx, None, pb, dp)
 
         def per_device_step(params, opt_state, tokens, targets):
+            grad_params = _pcast_dp(params, dp, mesh, True)
             loss, grads = jax.value_and_grad(loss_fn)(
-                params, tokens, targets
+                grad_params, tokens, targets
             )
-            grads = jax.tree.map(_fix_ep, grads, pspecs,
-                                 is_leaf=lambda x: x is None)
+            if ep is not None:
+                # the global loss is the MEAN of per-device local means;
+                # under check_vma=True the ep-invariant leaves' grads
+                # arrive SUMMED over ep (VMA auto-psum) and the expert
+                # slabs already summed their peers' contributions through
+                # the all_to_all transpose — one uniform /ep gives means
+                grads = jax.tree.map(lambda g: g / ep_size, grads)
+            grads = resym(grads)  # collapse conservative VMA widening
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             axes = tuple(a for a in (dp, ep) if a is not None)
             if axes:
                 loss = jax.lax.pmean(loss, axes)
+            loss = _collapse_vma(loss)
             return loss, params, opt_state
 
         sharded = jax.shard_map(
@@ -406,7 +409,7 @@ def make_gpt_moe_train_step(
             mesh=mesh,
             in_specs=(pspecs, ospecs, batch_spec, batch_spec),
             out_specs=(P(), pspecs, ospecs),
-            check_vma=False,
+            check_vma=True,
         )
         return jax.jit(sharded, donate_argnums=(0, 1))
 

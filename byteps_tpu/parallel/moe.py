@@ -37,6 +37,9 @@ def topk_dispatch(gate_logits: jnp.ndarray, capacity: int, k: int = 1):
     FIRST choice (mean_e frac_tokens_e · mean_prob_e · E).
     """
     T, E = gate_logits.shape
+    if not 1 <= k <= E:
+        raise ValueError(f"router top-k must satisfy 1 <= k <= n_experts "
+                         f"({E}); got k={k}")
     probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
     remaining = probs
     onehots, gates = [], []
@@ -88,13 +91,17 @@ def moe_ffn(
     ep_axis: Optional[str] = None,
     activation=jax.nn.gelu,
     router_topk: int = 1,
+    tp_axis: Optional[str] = None,
 ):
     """MoE feed-forward over the trailing feature dim of ``x (..., d)``.
 
     ``params``: ``wg (d, E)`` gate; expert-stacked ``w1 (E_loc, d, ff)``,
     ``b1 (E_loc, ff)``, ``w2 (E_loc, ff, d)``, ``b2 (E_loc, d)`` — with
     ``ep_axis`` set these are THIS device's expert slab (global tensors
-    sharded ``P('ep')``); without it they hold all experts.
+    sharded ``P('ep')``); without it they hold all experts. With
+    ``tp_axis`` the experts are additionally Megatron-sharded: w1/b1
+    column-parallel over the ff dim, w2 row-parallel with a psum over tp
+    restoring the full output (`moe_specs(ep, tp)` gives the layout).
 
     Returns ``(y, aux_loss)`` with ``y`` shaped like ``x``. Dropped
     (over-capacity) tokens produce zero — add the residual outside, as the
@@ -132,6 +139,9 @@ def moe_ffn(
     h = jnp.einsum("ecd,edf->ecf", slots, params["w1"].astype(x.dtype))
     h = activation(h + params["b1"][:, None, :].astype(x.dtype))
     y = jnp.einsum("ecf,efd->ecd", h, params["w2"].astype(x.dtype))
+    if tp_axis is not None:
+        # row-parallel: each tp shard computed a partial over its ff slice
+        y = jax.lax.psum(y, tp_axis)
     y = y + params["b2"][:, None, :].astype(x.dtype)
     if ep_axis is not None:
         y = y.reshape(e_loc, ep, cap, d).transpose(1, 0, 2, 3)
@@ -156,12 +166,15 @@ def moe_init(rng, d: int, ff: int, n_experts: int, std: float = 0.02):
     }
 
 
-def moe_specs(ep_axis: Optional[str]):
-    """PartitionSpec dict for :func:`moe_init` output."""
+def moe_specs(ep_axis: Optional[str], tp_axis: Optional[str] = None):
+    """PartitionSpec dict for :func:`moe_init` output: experts over ep,
+    and (optionally) Megatron col/row sharding of each expert's ff dim
+    over tp."""
     from jax.sharding import PartitionSpec as P
 
-    e = ep_axis
+    e, t = ep_axis, tp_axis
     return {
         "wg": P(),
-        "w1": P(e), "b1": P(e), "w2": P(e), "b2": P(e),
+        "w1": P(e, None, t), "b1": P(e, t),
+        "w2": P(e, t, None), "b2": P(e),
     }

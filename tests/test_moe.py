@@ -236,3 +236,39 @@ def test_top1_combine_uses_raw_softmax_prob():
         lambda lg: topk_dispatch(lg, capacity=2, k=1)[1].sum()
     )(logits)
     assert float(jnp.abs(g).max()) > 1e-3
+
+
+def test_moe_gpt_ep_tp_matches_dense_training():
+    """(dp=2, ep=2, tp=2) — Megatron-sharded experts + tp attention —
+    tracks the (dp=2, ep=2) step step-for-step (which is itself pinned to
+    dense-expert numerics by test_moe_gpt_ep_matches_dense_training);
+    adding tp must not change the math."""
+    import optax
+
+    from byteps_tpu.models.moe_gpt import MoEGPTConfig
+    from byteps_tpu.models.train import make_gpt_moe_train_step, synthetic_batch
+
+    cfg = MoEGPTConfig.tiny()
+    B, S = 8, 32
+    tokens, targets = synthetic_batch(jax.random.PRNGKey(12), cfg, B, S)
+
+    mesh_big = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                    ("dp", "ep", "tp"))
+    step_b, p_b, o_b, bsh_b = make_gpt_moe_train_step(
+        cfg, mesh_big, optax.adamw(1e-3)
+    )
+    # golden = the already-pinned (dp=2, ep=2) MoE step: same 4-way
+    # batch sharding (tp replicates), so only the tp layout differs
+    mesh_sm = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "ep"))
+    step_s, p_s, o_s, bsh_s = make_gpt_moe_train_step(
+        cfg, mesh_sm, optax.adamw(1e-3)
+    )
+
+    tb, gb = jax.device_put(tokens, bsh_b), jax.device_put(targets, bsh_b)
+    ts, gs = jax.device_put(tokens, bsh_s), jax.device_put(targets, bsh_s)
+    for _ in range(3):
+        l_b, p_b, o_b = step_b(p_b, o_b, tb, gb)
+        l_s, p_s, o_s = step_s(p_s, o_s, ts, gs)
+        np.testing.assert_allclose(float(l_b), float(l_s),
+                                   rtol=2e-4, atol=2e-4)
+    assert np.isfinite(float(l_b))
