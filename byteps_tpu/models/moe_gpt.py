@@ -21,6 +21,7 @@ from jax.sharding import PartitionSpec as P
 from byteps_tpu.models.gpt import (
     GPTConfig,
     _attention,
+    _embed,
     _layernorm,
     _readout_nll,
     block_init,
@@ -91,10 +92,11 @@ def moe_gpt_param_specs(cfg: MoEGPTConfig, ep_axis: Optional[str],
 
 def moe_transformer_block(x, p, cfg: MoEGPTConfig,
                           ep_axis: Optional[str],
-                          tp_axis: Optional[str] = None):
+                          tp_axis: Optional[str] = None,
+                          sp_axis: Optional[str] = None):
     """Pre-LN attention + MoE FFN; returns (x, aux_loss)."""
     x = x + _attention(_layernorm(x, p["ln1_g"], p["ln1_b"]), p,
-                       cfg.head_dim, tp_axis, None, causal=True)
+                       cfg.head_dim, tp_axis, sp_axis, causal=True)
     m, aux = moe_ffn(_layernorm(x, p["ln2_g"], p["ln2_b"]), p["moe"],
                      cfg.capacity_factor, ep_axis,
                      router_topk=cfg.router_topk, tp_axis=tp_axis)
@@ -104,20 +106,23 @@ def moe_transformer_block(x, p, cfg: MoEGPTConfig,
 def moe_gpt_loss(params, tokens, targets, cfg: MoEGPTConfig,
                  ep_axis: Optional[str] = None,
                  tp_axis: Optional[str] = None,
+                 sp_axis: Optional[str] = None,
                  remat: bool = False) -> jnp.ndarray:
-    """Per-device next-token loss + Switch aux loss (local mean — dp/ep
-    averaging is the train step's job)."""
-    B, S = tokens.shape
-    pos = jnp.arange(S)
-    x = (params["wte"][tokens] + params["wpe"][pos]).astype(cfg.dtype)
+    """Per-device next-token loss + Switch aux loss (local mean over this
+    device's tokens, pmean'd over sequence shards — dp/ep averaging is
+    the train step's job)."""
+    x = _embed(params, tokens, cfg, sp_axis)
     aux_total = jnp.zeros((), jnp.float32)
 
     def apply_block(x, p):
-        return moe_transformer_block(x, p, cfg, ep_axis, tp_axis)
+        return moe_transformer_block(x, p, cfg, ep_axis, tp_axis, sp_axis)
 
     apply_block = maybe_remat(apply_block, remat)
     for p in params["blocks"]:
         x, aux = apply_block(x, p)
         aux_total = aux_total + aux
     nll = _readout_nll(params, x, targets)
-    return nll.mean() + cfg.aux_coef * aux_total / cfg.n_layers
+    loss = nll.mean() + cfg.aux_coef * aux_total / cfg.n_layers
+    if sp_axis is not None:
+        loss = jax.lax.pmean(loss, sp_axis)
+    return loss

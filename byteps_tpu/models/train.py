@@ -339,7 +339,7 @@ def make_gpt_moe_train_step(
     partition_bytes: Optional[int] = None,
     remat: bool = False,
 ):
-    """Expert-parallel MoE GPT train step over a (dp, ep[, tp]) mesh.
+    """Expert-parallel MoE GPT train step over a (dp, ep[, tp][, sp]) mesh.
 
     The batch shards over dp AND ep (every device routes its own tokens to
     all experts via all_to_all); expert-stacked FFN weights shard P('ep')
@@ -358,12 +358,12 @@ def make_gpt_moe_train_step(
         moe_gpt_param_specs,
     )
 
-    dp, ep, tp = _axis(mesh, "dp"), _axis(mesh, "ep"), _axis(mesh, "tp")
-    for ax in ("sp", "pp"):
-        if _axis(mesh, ax) is not None:
-            raise NotImplementedError(
-                f"MoE currently composes dp x ep x tp (mesh has {ax})"
-            )
+    dp, ep = _axis(mesh, "dp"), _axis(mesh, "ep")
+    tp, sp = _axis(mesh, "tp"), _axis(mesh, "sp")
+    if _axis(mesh, "pp") is not None:
+        raise NotImplementedError(
+            "MoE currently composes dp x ep x tp x sp (mesh has pp)"
+        )
     ep_size = mesh.shape[ep] if ep is not None else 1
     if ep is not None and cfg.n_experts % ep_size != 0:
         raise ValueError(
@@ -375,10 +375,10 @@ def make_gpt_moe_train_step(
         mesh, _make_tx(mesh, base_tx, None, partition_bytes, dp),
         params, pspecs, dp,
     )
-    batch_spec = P((dp, ep) if dp and ep else (dp or ep))
+    batch_spec = P((dp, ep) if dp and ep else (dp or ep), sp)
     resym = _make_resymmetrize(pspecs, dp)
     loss_fn = functools.partial(moe_gpt_loss, cfg=cfg, ep_axis=ep,
-                                tp_axis=tp, remat=remat)
+                                tp_axis=tp, sp_axis=sp, remat=remat)
 
     def build_jit(pb):
         tx = _make_tx(mesh, base_tx, None, pb, dp)

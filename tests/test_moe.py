@@ -106,36 +106,41 @@ def test_moe_ffn_differentiable_through_all_to_all(moe_params):
     assert float(jnp.abs(grads["wg"]).sum()) > 0
 
 
+
+def _assert_moe_steps_match(cfg, shape_a, names_a, shape_b, names_b,
+                            seed, steps=3, tol=2e-4):
+    """Train the same MoE config on two meshes over the same global batch
+    and assert per-step loss equality to `tol`."""
+    import optax
+
+    from byteps_tpu.models.train import make_gpt_moe_train_step, synthetic_batch
+
+    tokens, targets = synthetic_batch(jax.random.PRNGKey(seed), cfg, 8, 32)
+    runs = []
+    for shape, names in ((shape_a, names_a), (shape_b, names_b)):
+        n = int(np.prod(shape))
+        mesh = Mesh(np.array(jax.devices()[:n]).reshape(shape), names)
+        step, p, o, bsh = make_gpt_moe_train_step(cfg, mesh,
+                                                  optax.adamw(1e-3))
+        runs.append((step, p, o, jax.device_put(tokens, bsh),
+                     jax.device_put(targets, bsh)))
+    (sa, pa, oa, ta, ga), (sb, pb, ob, tb, gb) = runs
+    for _ in range(steps):
+        la, pa, oa = sa(pa, oa, ta, ga)
+        lb, pb, ob = sb(pb, ob, tb, gb)
+        np.testing.assert_allclose(float(la), float(lb), rtol=tol, atol=tol)
+    assert np.isfinite(float(la))
+
+
 def test_moe_gpt_ep_matches_dense_training():
     """(dp=2, ep=2) expert-parallel MoE GPT tracks (dp=4) dense-expert
     training step-for-step: same init, same batch shards, same routing —
     expert placement is a layout choice, not a numerics change."""
-    import optax
-
     from byteps_tpu.models.moe_gpt import MoEGPTConfig
-    from byteps_tpu.models.train import make_gpt_moe_train_step, synthetic_batch
 
-    cfg = MoEGPTConfig.tiny()
-    B, S = 8, 32
-    tokens, targets = synthetic_batch(jax.random.PRNGKey(3), cfg, B, S)
-
-    mesh_ep = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "ep"))
-    step_ep, p_ep, o_ep, bsh_ep = make_gpt_moe_train_step(
-        cfg, mesh_ep, optax.adamw(1e-3)
-    )
-    mesh_dp = Mesh(np.array(jax.devices()[:4]), ("dp",))
-    step_dp, p_dp, o_dp, bsh_dp = make_gpt_moe_train_step(
-        cfg, mesh_dp, optax.adamw(1e-3)
-    )
-
-    te, ge = jax.device_put(tokens, bsh_ep), jax.device_put(targets, bsh_ep)
-    td, gd = jax.device_put(tokens, bsh_dp), jax.device_put(targets, bsh_dp)
-    for _ in range(4):
-        l_ep, p_ep, o_ep = step_ep(p_ep, o_ep, te, ge)
-        l_dp, p_dp, o_dp = step_dp(p_dp, o_dp, td, gd)
-        np.testing.assert_allclose(float(l_ep), float(l_dp),
-                                   rtol=2e-4, atol=2e-4)
-    assert np.isfinite(float(l_ep))
+    _assert_moe_steps_match(MoEGPTConfig.tiny(),
+                            (2, 2), ("dp", "ep"), (4,), ("dp",), seed=3,
+                            steps=4)
 
 
 def test_moe_gpt_rejects_bad_expert_count():
@@ -243,32 +248,24 @@ def test_moe_gpt_ep_tp_matches_dense_training():
     tracks the (dp=2, ep=2) step step-for-step (which is itself pinned to
     dense-expert numerics by test_moe_gpt_ep_matches_dense_training);
     adding tp must not change the math."""
-    import optax
-
     from byteps_tpu.models.moe_gpt import MoEGPTConfig
-    from byteps_tpu.models.train import make_gpt_moe_train_step, synthetic_batch
 
-    cfg = MoEGPTConfig.tiny()
-    B, S = 8, 32
-    tokens, targets = synthetic_batch(jax.random.PRNGKey(12), cfg, B, S)
+    _assert_moe_steps_match(MoEGPTConfig.tiny(),
+                            (2, 2, 2), ("dp", "ep", "tp"),
+                            (2, 2), ("dp", "ep"), seed=12)
 
-    mesh_big = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
-                    ("dp", "ep", "tp"))
-    step_b, p_b, o_b, bsh_b = make_gpt_moe_train_step(
-        cfg, mesh_big, optax.adamw(1e-3)
-    )
-    # golden = the already-pinned (dp=2, ep=2) MoE step: same 4-way
-    # batch sharding (tp replicates), so only the tp layout differs
-    mesh_sm = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "ep"))
-    step_s, p_s, o_s, bsh_s = make_gpt_moe_train_step(
-        cfg, mesh_sm, optax.adamw(1e-3)
-    )
 
-    tb, gb = jax.device_put(tokens, bsh_b), jax.device_put(targets, bsh_b)
-    ts, gs = jax.device_put(tokens, bsh_s), jax.device_put(targets, bsh_s)
-    for _ in range(3):
-        l_b, p_b, o_b = step_b(p_b, o_b, tb, gb)
-        l_s, p_s, o_s = step_s(p_s, o_s, ts, gs)
-        np.testing.assert_allclose(float(l_b), float(l_s),
-                                   rtol=2e-4, atol=2e-4)
-    assert np.isfinite(float(l_b))
+def test_moe_gpt_ep_sp_matches_ep_only_training():
+    """(dp=2, ep=2, sp=2) — ring attention + per-sequence-shard routing —
+    tracks the pinned (dp=2, ep=2) step APPROXIMATELY: the nll path
+    matches exactly only because tiny()'s capacity_factor=4.0 makes
+    capacity non-binding (each sp shard routes a 32-token half with cap
+    32 vs the golden's joint 64/64 — binding capacity would drop
+    different tokens), and the Switch aux loss is nonlinear in token
+    statistics so the pmean of per-half aux values differs slightly from
+    the joint aux. Hence the 10x looser tolerance than the tp twin."""
+    from byteps_tpu.models.moe_gpt import MoEGPTConfig
+
+    _assert_moe_steps_match(MoEGPTConfig.tiny(),
+                            (2, 2, 2), ("dp", "ep", "sp"),
+                            (2, 2), ("dp", "ep"), seed=13, tol=2e-3)
