@@ -15,6 +15,7 @@ from byteps_tpu.models.bert import (
 )
 from byteps_tpu.models.moe_gpt import (
     MoEGPTConfig, moe_gpt_init, moe_gpt_loss, moe_gpt_param_specs,
+    moe_gpt_pp_loss,
 )
 from byteps_tpu.models.resnet import (
     ResNetConfig, resnet_init, resnet_forward, resnet_loss,
@@ -27,6 +28,7 @@ __all__ = [
     "BertConfig", "bert_init", "bert_forward", "bert_mlm_loss",
     "bert_param_specs",
     "MoEGPTConfig", "moe_gpt_init", "moe_gpt_loss", "moe_gpt_param_specs",
+    "moe_gpt_pp_loss",
     "ResNetConfig", "resnet_init", "resnet_forward", "resnet_loss",
     "resnet_param_specs",
 ]

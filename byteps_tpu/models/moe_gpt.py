@@ -126,3 +126,50 @@ def moe_gpt_loss(params, tokens, targets, cfg: MoEGPTConfig,
     if sp_axis is not None:
         loss = jax.lax.pmean(loss, sp_axis)
     return loss
+
+
+def moe_gpt_pp_loss(params, tokens, targets, cfg: MoEGPTConfig,
+                    pp_axis: str, n_micro: int,
+                    ep_axis: Optional[str] = None,
+                    tp_axis: Optional[str] = None,
+                    sp_axis: Optional[str] = None,
+                    remat: bool = False,
+                    vma_axes: tuple = ()) -> jnp.ndarray:
+    """Pipelined MoE loss (inside shard_map over pp): ``params["blocks"]``
+    is THIS stage's stacked MoE-block slab. Same conventions as
+    ``gpt_pp_loss`` — the returned scalar is per-device (masked nll on the
+    last stage + this stage's own aux term); never psum it over pp inside
+    the grad."""
+    from byteps_tpu.parallel.pipeline import pipeline_apply
+
+    B, S_loc = tokens.shape
+    if B % n_micro != 0:
+        raise ValueError(f"local batch {B} not divisible by {n_micro} "
+                         "microbatches")
+    x = _embed(params, tokens, cfg, sp_axis)
+    x_mb = x.reshape(n_micro, B // n_micro, S_loc, x.shape[-1])
+
+    def blk(h, p):
+        return moe_transformer_block(h, p, cfg, ep_axis, tp_axis, sp_axis)
+
+    y_mb, aux_total = pipeline_apply(
+        x_mb, params["blocks"], blk, pp_axis,
+        remat=remat, vma_axes=vma_axes, has_aux=True,
+    )
+    y = y_mb.reshape(B, S_loc, -1)
+    nll = _readout_nll(params, y, targets).mean()
+    stage = jax.lax.axis_index(pp_axis)
+    nstages = jax.lax.axis_size(pp_axis)
+    masked_nll = jnp.where(stage == nstages - 1, nll, 0.0)
+    # aux_total covers THIS stage's layers x all M microbatches; every
+    # (layer, microbatch) is counted once across the stages, so the
+    # per-device terms sum to the model-wide per-layer mean the dense
+    # family uses
+    aux_term = cfg.aux_coef * aux_total / (cfg.n_layers * n_micro)
+    total = masked_nll + aux_term
+    if sp_axis is not None:
+        # pmean the WHOLE per-device scalar over sp — pmeaning only the
+        # nll would leave the aux term's sp-summed cotangents unscaled,
+        # multiplying the load-balancing gradient by sp_size
+        total = jax.lax.pmean(total, sp_axis)
+    return total

@@ -149,6 +149,49 @@ def _make_resymmetrize(pspecs, dp):
     return apply
 
 
+def _build_pp_jit(mesh, pspecs, ospecs, batch_spec, loss_fn, tx, dp, pp,
+                  ep_size=1, mean_axes=()):
+    """The grad-assembly skeleton both pipeline factories share: per-device
+    masked loss -> pp psum of the replicated GPT leaves, stage-local slab
+    grads, optional uniform /ep, resym, dp aggregation via ``tx``, and
+    VMA-collapsed loss reporting. check_vma=True throughout."""
+    resym = _make_resymmetrize(pspecs, dp)
+
+    def per_device_step(params, opt_state, tokens, targets):
+        grad_params = _pcast_dp(params, dp, mesh, True)
+        # loss_fn returns the last-stage-masked loss: grading through an
+        # already-replicated psum double-counts (psum transpose)
+        loss, grads = jax.value_and_grad(loss_fn)(
+            grad_params, tokens, targets
+        )
+        loss = jax.lax.psum(loss, pp)  # replicate for reporting
+        # stage-partial grads of the pp-replicated leaves sum to the
+        # true grad; slab grads are already stage-local and final
+        grads = {
+            **{k: jax.lax.psum(grads[k], pp)
+               for k in ("wte", "wpe", "lnf_g", "lnf_b")},
+            "blocks": grads["blocks"],
+        }
+        if ep_size > 1:
+            grads = jax.tree.map(lambda g: g / ep_size, grads)
+        grads = resym(grads)  # collapse conservative VMA widening
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        if mean_axes:
+            loss = jax.lax.pmean(loss, mean_axes)
+        loss = _collapse_vma(loss)
+        return loss, params, opt_state
+
+    sharded = jax.shard_map(
+        per_device_step,
+        mesh=mesh,
+        in_specs=(pspecs, ospecs, batch_spec, batch_spec),
+        out_specs=(P(), pspecs, ospecs),
+        check_vma=True,
+    )
+    return jax.jit(sharded, donate_argnums=(0, 1))
+
+
 def _pcast_dp(params, dp, mesh, use_vma):
     """Mark params dp-varying so AD yields per-replica LOCAL grads
     (dp aggregation must stay in DistributedOptimizer, the framework's
@@ -281,7 +324,6 @@ def make_gpt_pp_train_step(
         params, pspecs, dp,
     )
     batch_spec = P(dp, sp)
-    resym = _make_resymmetrize(pspecs, dp)
     loss_fn = functools.partial(
         gpt_pp_loss, cfg=cfg, pp_axis=pp, n_micro=n_micro, tp_axis=tp,
         sp_axis=sp, remat=remat, vma_axes=tuple(mesh.axis_names),
@@ -289,42 +331,10 @@ def make_gpt_pp_train_step(
 
     def build_jit(pb):
         tx = _make_tx(mesh, base_tx, None, pb, dp)
-
-        def per_device_step(params, opt_state, tokens, targets):
-            grad_params = _pcast_dp(params, dp, mesh, True)
-            # loss_fn returns the last-stage-masked loss: grading through
-            # an already-replicated psum double-counts (psum transpose)
-            loss, grads = jax.value_and_grad(loss_fn)(
-                grad_params, tokens, targets
-            )
-            loss = jax.lax.psum(loss, pp)  # replicate for reporting
-            # stage-partial grads of the pp-replicated leaves sum to the
-            # true grad; slab grads are already stage-local and final
-            grads = {
-                **{k: jax.lax.psum(grads[k], pp)
-                   for k in ("wte", "wpe", "lnf_g", "lnf_b")},
-                "blocks": grads["blocks"],
-            }
-            # collapse conservative VMA widening (tp, residual pp) — a
-            # numerical identity, values already agree
-            grads = resym(grads)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            if dp is not None:
-                loss = jax.lax.pmean(loss, dp)
-            # collapse conservative VMA widening on the reported scalar
-            # (the pipeline widens to every axis)
-            loss = _collapse_vma(loss)
-            return loss, params, opt_state
-
-        sharded = jax.shard_map(
-            per_device_step,
-            mesh=mesh,
-            in_specs=(pspecs, ospecs, batch_spec, batch_spec),
-            out_specs=(P(), pspecs, ospecs),
-            check_vma=True,
+        return _build_pp_jit(
+            mesh, pspecs, ospecs, batch_spec, loss_fn, tx, dp, pp,
+            mean_axes=(dp,) if dp is not None else (),
         )
-        return jax.jit(sharded, donate_argnums=(0, 1))
 
     return (
         _finalize_step(build_jit, partition_bytes, dp),
@@ -361,8 +371,9 @@ def make_gpt_moe_train_step(
     dp, ep = _axis(mesh, "dp"), _axis(mesh, "ep")
     tp, sp = _axis(mesh, "tp"), _axis(mesh, "sp")
     if _axis(mesh, "pp") is not None:
-        raise NotImplementedError(
-            "MoE currently composes dp x ep x tp x sp (mesh has pp)"
+        raise ValueError(
+            "mesh has a pp axis — use make_gpt_moe_pp_train_step for "
+            "pipelined MoE"
         )
     ep_size = mesh.shape[ep] if ep is not None else 1
     if ep is not None and cfg.n_experts % ep_size != 0:
@@ -412,6 +423,83 @@ def make_gpt_moe_train_step(
             check_vma=True,
         )
         return jax.jit(sharded, donate_argnums=(0, 1))
+
+    return (
+        _finalize_step(build_jit, partition_bytes, dp),
+        params, opt_state, NamedSharding(mesh, batch_spec),
+    )
+
+
+def make_gpt_moe_pp_train_step(
+    cfg,
+    mesh: Mesh,
+    base_tx: optax.GradientTransformation,
+    n_micro: int = 4,
+    partition_bytes: Optional[int] = None,
+    remat: bool = False,
+):
+    """Pipelined MoE GPT over a (pp, dp[, ep][, tp][, sp]) mesh — the full
+    composition: GPipe microbatch pipelining whose stages hold MoE blocks
+    with all_to_all expert dispatch (ep), Megatron-sharded experts and
+    attention (tp), and ring attention (sp), all typed by VMA in one
+    jitted program. Routing happens per microbatch (capacity from the
+    microbatch token count). Grad assembly combines the pp and ep rules:
+    pp-replicated leaves psum over pp, then everything divides by ep
+    (mean of per-device local means); dp aggregation stays in
+    DistributedOptimizer.
+
+    Returns ``(step, params, opt_state, batch_sharding)``;
+    ``params["blocks"]`` is the stacked MoE-block slab.
+    """
+    from byteps_tpu.models.moe_gpt import (
+        moe_block_specs,
+        moe_gpt_init,
+        moe_gpt_pp_loss,
+    )
+    from byteps_tpu.parallel.pipeline import stack_blocks, stacked_specs
+
+    dp, pp = _axis(mesh, "dp"), _axis(mesh, "pp")
+    ep, tp, sp = _axis(mesh, "ep"), _axis(mesh, "tp"), _axis(mesh, "sp")
+    if pp is None:
+        raise ValueError("mesh has no pp axis — use make_gpt_moe_train_step")
+    nstages = mesh.shape[pp]
+    ep_size = mesh.shape[ep] if ep is not None else 1
+    if cfg.n_layers % nstages != 0:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by pp={nstages}"
+        )
+    if ep is not None and cfg.n_experts % ep_size != 0:
+        raise ValueError(
+            f"n_experts={cfg.n_experts} not divisible by ep={ep_size}"
+        )
+    raw = moe_gpt_init(jax.random.PRNGKey(0), cfg)
+    params = {
+        "wte": raw["wte"], "wpe": raw["wpe"],
+        "lnf_g": raw["lnf_g"], "lnf_b": raw["lnf_b"],
+        "blocks": stack_blocks(raw["blocks"]),
+    }
+    pspecs = {
+        "wte": P(), "wpe": P(), "lnf_g": P(), "lnf_b": P(),
+        "blocks": stacked_specs(moe_block_specs(ep, tp), pp),
+    }
+    params, opt_state, ospecs = _shard_params_state(
+        mesh, _make_tx(mesh, base_tx, None, partition_bytes, dp),
+        params, pspecs, dp,
+    )
+    batch_spec = P((dp, ep) if dp and ep else (dp or ep), sp)
+    loss_fn = functools.partial(
+        moe_gpt_pp_loss, cfg=cfg, pp_axis=pp, n_micro=n_micro,
+        ep_axis=ep, tp_axis=tp, sp_axis=sp, remat=remat,
+        vma_axes=tuple(mesh.axis_names),
+    )
+
+    def build_jit(pb):
+        tx = _make_tx(mesh, base_tx, None, pb, dp)
+        return _build_pp_jit(
+            mesh, pspecs, ospecs, batch_spec, loss_fn, tx, dp, pp,
+            ep_size=ep_size if ep is not None else 1,
+            mean_axes=tuple(a for a in (dp, ep) if a is not None),
+        )
 
     return (
         _finalize_step(build_jit, partition_bytes, dp),

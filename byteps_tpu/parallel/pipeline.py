@@ -68,6 +68,7 @@ def pipeline_apply(
     pp_axis: str,
     remat: bool = False,
     vma_axes: tuple = (),
+    has_aux: bool = False,
 ) -> jnp.ndarray:
     """Run microbatches through the pp-staged layer pipeline.
 
@@ -75,8 +76,12 @@ def pipeline_apply(
     ``(M, mb, ...)`` (identical on every stage — only stage 0 injects it);
     ``stacked`` is THIS stage's ``(layers_per_stage, ...)`` parameter slab;
     ``block_fn(x, layer_params) -> x`` applies one layer and must preserve
-    shape. Returns ``(M, mb, ...)`` pipeline outputs, valid on the LAST pp
-    stage (zeros elsewhere — mask with ``lax.axis_index(pp_axis)``).
+    shape — or, with ``has_aux``, returns ``(x, scalar_aux)`` (an MoE
+    block's load-balancing loss) and the call returns ``(outs,
+    aux_total)`` where ``aux_total`` sums THIS stage's layers' aux over
+    every real microbatch (warmup/drain ticks masked out). Returns
+    ``(M, mb, ...)`` pipeline outputs, valid on the LAST pp stage (zeros
+    elsewhere — mask with ``lax.axis_index(pp_axis)``).
 
     Schedule: M + S − 1 ticks; at each tick every stage applies its slab
     (a ``lax.scan`` over its layers) and ships the result to the next
@@ -108,17 +113,29 @@ def pipeline_apply(
     fn = maybe_remat(block_fn, remat)
 
     def local_slab(x):
-        def body(h, layer):
-            return fn(h, layer), None
+        def body(carry, layer):
+            h, aux = carry
+            out = fn(h, layer)
+            if has_aux:
+                h, a = out
+                return (h, aux + a), None
+            return (out, aux), None
 
-        h, _ = jax.lax.scan(body, x, stacked)
-        return h
+        (h, aux), _ = jax.lax.scan(
+            body, (x, widen(jnp.zeros((), jnp.float32))), stacked
+        )
+        return h, aux
 
     def tick(carry, t):
-        recv, outs = carry
+        recv, outs, aux_acc = carry
         inject = x_mb[jnp.clip(t, 0, M - 1)]
         xin = jnp.where(stage == 0, inject, recv)
-        y = widen(local_slab(xin))
+        y, aux = local_slab(xin)
+        y = widen(y)
+        # stage s processes microbatch t - s at tick t; aux from warmup /
+        # drain ticks is garbage-data noise and must not count
+        mb_valid = ((t - stage) >= 0) & ((t - stage) < M)
+        aux_acc = aux_acc + jnp.where(mb_valid, aux, 0.0)
         out_t = t - (nstages - 1)
         valid = (out_t >= 0) & (out_t < M) & (stage == nstages - 1)
         start = (jnp.clip(out_t, 0, M - 1),) + (0,) * len(mb_shape)
@@ -127,19 +144,24 @@ def pipeline_apply(
         )
         outs = jnp.where(valid, updated, outs)
         recv = jax.lax.ppermute(y, pp_axis, perm)
-        return (recv, outs), None
+        return (recv, outs, aux_acc), None
 
     init = (
         jnp.zeros(mb_shape, x_mb.dtype),
         jnp.zeros((M,) + mb_shape, x_mb.dtype),
+        jnp.zeros((), jnp.float32),
     )
     # under check_vma=True the tick outputs are (at least) pp-varying
     # (axis_index / ppermute), so the zero init must be cast to match the
     # carry type; a no-op under check_vma=False
     init = jax.tree.map(widen, init)
-    (_, outs), _ = jax.lax.scan(
+    (_, outs, aux_total), _ = jax.lax.scan(
         tick, init, jnp.arange(M + nstages - 1)
     )
+    if has_aux:
+        # THIS stage's layers' aux, summed over its layers and all M
+        # microbatches — psum over pp (and normalize) in the caller
+        return outs, aux_total
     return outs
 
 
