@@ -165,12 +165,11 @@ def _build_pp_jit(mesh, pspecs, ospecs, batch_spec, loss_fn, tx, dp, pp,
             grad_params, tokens, targets
         )
         loss = jax.lax.psum(loss, pp)  # replicate for reporting
-        # stage-partial grads of the pp-replicated leaves sum to the
-        # true grad; slab grads are already stage-local and final
+        # stage-partial grads of the pp-replicated leaves (everything
+        # outside the stage-local blocks slab) sum to the true grad
         grads = {
-            **{k: jax.lax.psum(grads[k], pp)
-               for k in ("wte", "wpe", "lnf_g", "lnf_b")},
-            "blocks": grads["blocks"],
+            k: g if k == "blocks" else jax.lax.psum(g, pp)
+            for k, g in grads.items()
         }
         if ep_size > 1:
             grads = jax.tree.map(lambda g: g / ep_size, grads)

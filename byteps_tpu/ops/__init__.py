@@ -8,6 +8,12 @@ Backend selection: Pallas on TPU, jnp elsewhere; override with
 ``BYTEPS_KERNEL_BACKEND=pallas|jnp``.
 """
 
+from byteps_tpu.ops.flash_attention import (
+    attention_jnp,
+    flash_attention,
+    flash_attention_lse,
+    merge_attention,
+)
 from byteps_tpu.ops.onebit_kernels import (
     onebit_pack,
     onebit_unpack,
@@ -16,5 +22,7 @@ from byteps_tpu.ops.onebit_kernels import (
 )
 
 __all__ = [
+    "attention_jnp", "flash_attention", "flash_attention_lse",
+    "merge_attention",
     "onebit_pack", "onebit_unpack", "onebit_unpack_sum", "packed_words",
 ]

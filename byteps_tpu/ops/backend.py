@@ -1,0 +1,25 @@
+"""Kernel-backend selection shared by every op module.
+
+One dispatch rule for the whole ops package (the reference's analog is its
+compile-time CUDA/CPU split; here it's a runtime choice): Pallas on TPU,
+jnp elsewhere, overridable with ``BYTEPS_KERNEL_BACKEND=pallas|jnp``
+(``pallas`` off-TPU means interpret mode — see docs/env.md for the
+``check_vma`` caveat).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def kernel_backend() -> str:
+    env = os.environ.get("BYTEPS_KERNEL_BACKEND", "")
+    if env in ("pallas", "jnp"):
+        return env
+    return "pallas" if jax.default_backend() == "tpu" else "jnp"
+
+
+def use_pallas() -> bool:
+    return kernel_backend() == "pallas"

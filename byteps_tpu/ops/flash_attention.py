@@ -1,0 +1,519 @@
+"""Flash attention — Pallas TPU kernels for the transformer hot op.
+
+No reference analog: the reference delegates attention math to torch/TF
+kernels (its models live in example scripts, e.g.
+``example/pytorch/benchmark_byteps.py``); on TPU the attention inner loop
+is OURS to own, and it is the one op in the model families where the
+naive form materializes a ``(B, H, S, S)`` score tensor in HBM.
+
+Design (flash-attention-2 schedule, TPU-shaped):
+
+* Layout ``(B*H, S, D)`` — batch×heads is the embarrassingly parallel
+  grid axis; ``S`` is tiled into (bq, bk) blocks sized to the MXU
+  (128 where the sequence allows); ``D`` (head_dim ≤ 256) stays whole so
+  every matmul in the kernel is an MXU op on full tiles.
+* Forward: grid ``(BH, nq, nk)``, innermost ``nk`` sequential
+  ("arbitrary") with the online-softmax state ``(m, l, acc)`` carried in
+  VMEM scratch — scores for one ``(bq, bk)`` tile only ever exist in
+  VMEM. Emits the per-row logsumexp for the backward and for cross-shard
+  combination.
+* Backward: two kernels — ``dq`` (grid ``(BH, nq, nk)``) and ``dkv``
+  (grid ``(BH, nk, nq)``) — each recomputing ``P = exp(S − lse)`` per
+  tile, so the backward reads O(S·D) and never stores P.
+  ``delta = rowsum(dO ∘ O)`` is one fused jnp pass. The lse output's own
+  cotangent folds in exactly (``dS = P ∘ (dP − Δ + dlse)``), which is
+  what lets ring attention differentiate through the cross-shard merge.
+* Causal masking compares *global* positions: the q/k sequence offsets
+  are runtime scalars (SMEM), so the same compiled kernel serves the
+  single-device case (offsets 0), and every step of ring attention —
+  diagonal (part-masked), below-diagonal (all-live), above-diagonal
+  (all-masked, skipped tile-by-tile by ``pl.when``). Rows with no live
+  key yield ``o = 0, lse = −1e30`` and drop out of the ring merge.
+
+Numerics: all accumulation in float32 regardless of input dtype (bf16
+in, bf16 out, f32 state) — same contract as
+:func:`byteps_tpu.parallel.ring_attention.plain_attention`, which is the
+golden for the tests and the jnp fallback for shapes/platforms the
+kernel doesn't cover.
+
+Known jax limitation: ``BYTEPS_KERNEL_BACKEND=pallas`` off-TPU runs the
+kernels in interpret mode, which jax cannot evaluate inside
+``shard_map(check_vma=True)`` (its error suggests ``check_vma=False``;
+kernel-internal program_id math can't be pvaried to the SMEM scalars'
+varying axes). Compiled TPU kernels are unaffected — only the boundary
+is vma-typed there (:func:`_out_struct` / :func:`_unify_vma`). Off-TPU
+the default backend is jnp, so the check_vma=True train factories are
+only incompatible with *forcing* pallas interpret mode under them.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30
+_MAX_HEAD_DIM = 256     # D beyond this spills VMEM tile budgets → fallback
+
+
+def _pick_block(S: int) -> Optional[int]:
+    """Largest MXU-friendly tile dividing S (None → jnp fallback)."""
+    for b in (256, 128, 64, 32, 16, 8):
+        if S % b == 0 and S >= b:
+            return b
+    return None
+
+
+from byteps_tpu.ops.backend import use_pallas  # noqa: E402 (re-export)
+
+
+def supported(Sq: int, Sk: int, D: int) -> bool:
+    return (_pick_block(Sq) is not None and _pick_block(Sk) is not None
+            and D <= _MAX_HEAD_DIM)
+
+
+# --------------------------------------------------------------------------
+# jnp fallback (also the numerics golden; mirrors ring_attention._block_attn)
+# --------------------------------------------------------------------------
+def attention_jnp(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                  causal: bool = True) -> jnp.ndarray:
+    """Single-device softmax attention, (B, S, H, D) layout, f32 softmax."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        Sq, Sk = q.shape[1], k.shape[1]
+        mask = jnp.arange(Sq)[:, None] >= jnp.arange(Sk)[None, :]
+        s = jnp.where(mask[None, None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return o.astype(q.dtype)
+
+
+def _out_struct(shape, dtype, *args):
+    """ShapeDtypeStruct whose vma is the union of the inputs' — required
+    for pallas_call under ``shard_map(check_vma=True)`` (outputs vary over
+    whatever mesh axes the inputs vary over)."""
+    try:
+        vma = frozenset().union(*(jax.typeof(a).vma for a in args))
+    except (AttributeError, TypeError):
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+
+
+def _unify_vma(*xs):
+    """pvary every array to the union of the group's varying axes, so the
+    pallas_call boundary sees one consistent vma. (Interpret mode under
+    check_vma=True still rejects kernel-internal program_id mixing — a
+    known jax limitation whose error message recommends check_vma=False;
+    the compiled TPU path only type-checks the boundary.)"""
+    try:
+        vmas = [jax.typeof(x).vma for x in xs]
+    except AttributeError:
+        return xs
+    union = frozenset().union(*vmas)
+    return tuple(
+        jax.lax.pvary(x, tuple(union - v)) if union - v else x
+        for x, v in zip(xs, vmas)
+    )
+
+
+def _read_offsets(qoff_ref, koff_ref):
+    """Scalar SMEM loads (the only form mosaic allows)."""
+    return (qoff_ref[0, 0].astype(jnp.int32),
+            koff_ref[0, 0].astype(jnp.int32))
+
+
+def _mask_tile(s, q_off, k_off, q_start, k_start, bq, bk):
+    rows = q_off + q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = k_off + k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(rows >= cols, s, _NEG)
+
+
+# --------------------------------------------------------------------------
+# forward kernel
+# --------------------------------------------------------------------------
+def _fwd_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, bq, bk, nk):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, _NEG, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    q_start, k_start = qi * bq, ki * bk
+    q_off, k_off = _read_offsets(qoff_ref, koff_ref)
+
+    def _tile():
+        q = q_ref[0].astype(jnp.float32)                     # (bq, D)
+        k = k_ref[0].astype(jnp.float32)                     # (bk, D)
+        v = v_ref[0].astype(jnp.float32)                     # (bk, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # (bq, bk)
+        if causal:
+            s = _mask_tile(s, q_off, k_off, q_start, k_start, bq, bk)
+        m_prev = m_scr[:]                                    # (bq, 1)
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                               # (bq, bk)
+        if causal:
+            # exp(_NEG - m) underflows to 0 except when the whole row is
+            # masked (m == _NEG) — zero those lanes explicitly
+            p = jnp.where(s > _NEG / 2, p, 0.0)
+        l_scr[:] = l_scr[:] * alpha + p.sum(axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bq, D)
+        m_scr[:] = m_new
+
+    if causal:
+        # tile live iff some global q_pos >= some global k_pos
+        @pl.when(q_off + q_start + bq - 1 >= k_off + k_start)
+        def _():
+            _tile()
+    else:
+        _tile()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_scr[:]                                          # (bq, 1)
+        l_safe = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(l > 0.0, m_scr[:] + jnp.log(l_safe), _NEG)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "interpret"))
+def _fwd(q3, k3, v3, qoff, koff, causal: bool, interpret: bool):
+    """q3/k3/v3: (BH, S, D) → (o (BH, Sq, D), lse (BH, Sq, 1) f32)."""
+    BH, Sq, D = q3.shape
+    Sk = k3.shape[1]
+    bq, bk = _pick_block(Sq), _pick_block(Sk)
+    nq, nk = Sq // bq, Sk // bk
+    scale = 1.0 / (D ** 0.5)
+    kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                             bq=bq, bk=bk, nk=nk)
+    return pl.pallas_call(
+        kern,
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bq, D), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, qi, ki: (b, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, qi, ki: (b, qi, 0)),
+        ],
+        out_shape=[
+            _out_struct((BH, Sq, D), q3.dtype, q3, k3, v3, qoff, koff),
+            _out_struct((BH, Sq, 1), jnp.float32, q3, k3, v3, qoff, koff),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),    # m (row max)
+            pltpu.VMEM((bq, 1), jnp.float32),    # l (row sum)
+            pltpu.VMEM((bq, D), jnp.float32),    # acc
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qoff, koff, q3, k3, v3)
+
+
+# --------------------------------------------------------------------------
+# backward kernels
+# --------------------------------------------------------------------------
+def _dq_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+               dl_ref, dlse_ref, dq_ref, dq_scr,
+               *, scale, causal, bq, bk, nk):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    q_start, k_start = qi * bq, ki * bk
+    q_off, k_off = _read_offsets(qoff_ref, koff_ref)
+
+    def _tile():
+        q = q_ref[0].astype(jnp.float32)                     # (bq, D)
+        k = k_ref[0].astype(jnp.float32)                     # (bk, D)
+        v = v_ref[0].astype(jnp.float32)                     # (bk, D)
+        do = do_ref[0].astype(jnp.float32)                   # (bq, D)
+        lse = lse_ref[0]                                     # (bq, 1)
+        delta = dl_ref[0]                                    # (bq, 1)
+        dlse = dlse_ref[0]                                   # (bq, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _mask_tile(s, q_off, k_off, q_start, k_start, bq, bk)
+        p = jnp.exp(s - lse)                                  # (bq, bk)
+        if causal:
+            p = jnp.where(s > _NEG / 2, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bq, bk)
+        ds = p * (dp - delta + dlse)
+        dq_scr[:] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bq, D)
+
+    if causal:
+        @pl.when(q_off + q_start + bq - 1 >= k_off + k_start)
+        def _():
+            _tile()
+    else:
+        _tile()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                dl_ref, dlse_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                *, scale, causal, bq, bk, nq):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[:] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    q_start, k_start = qi * bq, ki * bk
+    q_off, k_off = _read_offsets(qoff_ref, koff_ref)
+
+    def _tile():
+        q = q_ref[0].astype(jnp.float32)                     # (bq, D)
+        k = k_ref[0].astype(jnp.float32)                     # (bk, D)
+        v = v_ref[0].astype(jnp.float32)                     # (bk, D)
+        do = do_ref[0].astype(jnp.float32)                   # (bq, D)
+        lse = lse_ref[0]                                     # (bq, 1)
+        delta = dl_ref[0]                                    # (bq, 1)
+        dlse = dlse_ref[0]                                   # (bq, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _mask_tile(s, q_off, k_off, q_start, k_start, bq, bk)
+        p = jnp.exp(s - lse)                                  # (bq, bk)
+        if causal:
+            p = jnp.where(s > _NEG / 2, p, 0.0)
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bk, D)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bq, bk)
+        ds = p * (dp - delta + dlse)
+        dk_scr[:] += scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bk, D)
+
+    if causal:
+        @pl.when(q_off + q_start + bq - 1 >= k_off + k_start)
+        def _():
+            _tile()
+    else:
+        _tile()
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "interpret"))
+def _bwd(q3, k3, v3, o3, lse, qoff, koff, do3, dlse,
+         causal: bool, interpret: bool):
+    BH, Sq, D = q3.shape
+    Sk = k3.shape[1]
+    bq, bk = _pick_block(Sq), _pick_block(Sk)
+    nq, nk = Sq // bq, Sk // bk
+    scale = 1.0 / (D ** 0.5)
+    # delta_i = Σ_d dO_id · O_id  (one fused elementwise pass, f32)
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1, keepdims=True)                   # (BH, Sq, 1)
+    q3, k3, v3, do3, lse, delta, dlse, qoff, koff = _unify_vma(
+        q3, k3, v3, do3, lse, delta, dlse, qoff, koff)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk),
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bq, D), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, qi, ki: (b, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, qi, ki: (b, qi, 0)),
+        out_shape=_out_struct((BH, Sq, D), q3.dtype,
+                              q3, k3, v3, do3, lse, delta, dlse, qoff, koff),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qoff, koff, q3, k3, v3, do3, lse, delta, dlse)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nq=nq),
+        grid=(BH, nk, nq),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bq, D), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, ki, qi: (b, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, ki, qi: (b, ki, 0)),
+        ],
+        out_shape=[
+            _out_struct((BH, Sk, D), k3.dtype,
+                        q3, k3, v3, do3, lse, delta, dlse, qoff, koff),
+            _out_struct((BH, Sk, D), v3.dtype,
+                        q3, k3, v3, do3, lse, delta, dlse, qoff, koff),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qoff, koff, q3, k3, v3, do3, lse, delta, dlse)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# custom-VJP core on the (BH, S, D) layout
+# --------------------------------------------------------------------------
+# qoff/koff are (1, 1) float32 on purpose: they are *traced* values (ring
+# attention passes axis_index-derived offsets), and float avoids the
+# symbolic-zero cotangent dance custom_vjp requires for int-dtype
+# arguments — their gradient is identically zero.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _flash_core(q3, k3, v3, qoff, koff, causal: bool, interpret: bool):
+    return _fwd(q3, k3, v3, qoff, koff, causal, interpret)
+
+
+def _flash_core_fwd(q3, k3, v3, qoff, koff, causal, interpret):
+    o, lse = _fwd(q3, k3, v3, qoff, koff, causal, interpret)
+    return (o, lse), (q3, k3, v3, o, lse, qoff, koff)
+
+
+def _flash_core_bwd(causal, interpret, res, cts):
+    q3, k3, v3, o3, lse, qoff, koff = res
+    do3, dlse = cts
+    dlse = jnp.asarray(dlse, jnp.float32)
+    dq, dk, dv = _bwd(q3, k3, v3, o3, lse, qoff, koff, do3, dlse,
+                      causal, interpret)
+    zero = jnp.zeros((1, 1), jnp.float32)
+    return dq, dk, dv, zero, zero
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def _to3(x: jnp.ndarray) -> jnp.ndarray:
+    B, S, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+
+def _from3(x3: jnp.ndarray, B: int, H: int) -> jnp.ndarray:
+    BH, S, D = x3.shape
+    return x3.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+def flash_attention_lse(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        q_offset, k_offset,
+                        causal: bool = True
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Flash attention with logsumexp, for cross-shard combination.
+
+    q/k/v: (B, S, H, D); offsets are (possibly traced) global sequence
+    positions of element 0 of the q/k blocks — causal masking compares
+    ``q_offset + i >= k_offset + j``. Returns ``(o (B, Sq, H, D),
+    lse (B, Sq, H) f32)``; rows with no live key give ``o = 0,
+    lse = −1e30`` so a ring merge drops them. Callers must check
+    :func:`supported` / :func:`use_pallas` first.
+    """
+    B, Sq, H, D = q.shape
+    if not supported(Sq, k.shape[1], D):
+        raise ValueError(
+            f"flash_attention_lse: unsupported shape Sq={Sq} Sk={k.shape[1]} "
+            f"head_dim={D} — sequence lengths must divide into 8..256 tiles "
+            f"and head_dim must be ≤ {_MAX_HEAD_DIM}; gate on "
+            "byteps_tpu.ops.flash_attention.supported() or use "
+            "flash_attention()/attention_jnp() which fall back")
+    qoff = jnp.asarray(q_offset, jnp.float32).reshape(1, 1)
+    koff = jnp.asarray(k_offset, jnp.float32).reshape(1, 1)
+    interpret = jax.default_backend() != "tpu"
+    q3, k3, v3, qoff, koff = _unify_vma(_to3(q), _to3(k), _to3(v),
+                                        qoff, koff)
+    o3, lse3 = _flash_core(q3, k3, v3, qoff, koff, causal, interpret)
+    o = _from3(o3, B, H)
+    lse = lse3.reshape(B, H, Sq).transpose(0, 2, 1)           # (B, Sq, H)
+    return o, lse
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    causal: bool = True) -> jnp.ndarray:
+    """Softmax attention, (B, S, H, D) layout, flash kernel when possible.
+
+    Drop-in numerics-equivalent of :func:`attention_jnp` (f32 accumulate,
+    output in input dtype); falls back to it off-TPU (unless
+    ``BYTEPS_KERNEL_BACKEND=pallas`` forces interpret mode) and for
+    sequence lengths not divisible into MXU tiles. Differentiable via the
+    flash backward kernels — O(S·D) memory in both passes.
+    """
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    if not (use_pallas() and supported(Sq, Sk, D)):
+        return attention_jnp(q, k, v, causal=causal)
+    o, _ = flash_attention_lse(q, k, v, 0, 0, causal=causal)
+    return o
+
+
+def merge_attention(o_a, lse_a, o_b, lse_b):
+    """Combine two attention partials over disjoint key sets.
+
+    o: (B, S, H, D) normalized outputs; lse: (B, S, H) logsumexps
+    (−1e30 ≡ no live keys). Returns the merged (o, lse). Exact (not an
+    approximation) and differentiable — gradients flow into both o's and
+    both lse's, which the flash backward folds into dS.
+    """
+    m = jnp.maximum(lse_a, lse_b)
+    wa = jnp.exp(lse_a - m)
+    wb = jnp.exp(lse_b - m)
+    denom = wa + wb
+    safe = jnp.where(denom > 0.0, denom, 1.0)
+    o = (o_a.astype(jnp.float32) * wa[..., None]
+         + o_b.astype(jnp.float32) * wb[..., None]) / safe[..., None]
+    lse = jnp.where(denom > 0.0, m + jnp.log(safe), _NEG)
+    return o.astype(o_a.dtype), lse

@@ -40,11 +40,7 @@ def _block(L: int) -> int:
     return L
 
 
-def _backend() -> str:
-    env = os.environ.get("BYTEPS_KERNEL_BACKEND", "")
-    if env in ("pallas", "jnp"):
-        return env
-    return "pallas" if jax.default_backend() == "tpu" else "jnp"
+from byteps_tpu.ops.backend import kernel_backend as _backend  # noqa: E402
 
 
 def packed_words(n: int) -> int:

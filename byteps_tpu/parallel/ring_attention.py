@@ -8,8 +8,21 @@ online softmax accumulates the output. Wire traffic per step is one K/V
 block over nearest-neighbour ICI links; compute of step t overlaps the
 ppermute of step t+1 on real hardware (XLA async collective).
 
+Two implementations share this ring schedule:
+
+* **Pallas** (TPU, or forced via ``BYTEPS_KERNEL_BACKEND=pallas``): each
+  step runs the flash kernel (:mod:`byteps_tpu.ops.flash_attention`) on
+  the local Q against the visiting K/V block with *global* position
+  offsets for causal masking, and the per-step ``(o, lse)`` partials are
+  merged exactly with :func:`merge_attention` — O(S_loc·D) memory per
+  device, scores never materialize even blockwise.
+* **jnp fallback**: the same online softmax with per-step
+  ``(m, l, o)`` carried at the jnp level (materializes one
+  ``(B, H, S_loc, S_loc)`` score block per step).
+
 Differentiable: the ppermute transposes to the reverse rotation, so the
-backward pass is itself a ring.
+backward pass is itself a ring; on the Pallas path the lse cotangent of
+the merge folds into the flash backward's dS.
 """
 
 from __future__ import annotations
@@ -18,6 +31,14 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from byteps_tpu.ops.flash_attention import (
+    flash_attention as _flash_attention,
+    flash_attention_lse as _flash_attention_lse,
+    merge_attention as _merge_attention,
+    supported as _flash_supported,
+    use_pallas as _use_pallas,
+)
 
 _NEG = -1e30  # masked-score value; avoids -inf NaN in the online softmax
 
@@ -46,22 +67,10 @@ def _block_attn(q, k, v, q_pos, k_pos, scale, causal, m, l, o):
 
 def plain_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True) -> jnp.ndarray:
-    """Single-device softmax attention, (B, S, H, D) layout. The numerics
-    golden for :func:`ring_attention` and the entry()/single-chip path."""
-    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
-    S, Sk = q.shape[1], k.shape[1]
-    pos_q = jnp.arange(S)
-    pos_k = jnp.arange(Sk)
-    B, _, H, D = q.shape
-    m = jnp.full((B, H, S), _NEG, jnp.float32)
-    l = jnp.zeros((B, H, S), jnp.float32)
-    o = jnp.zeros((B, S, H, D), jnp.float32)
-    m, l, o = _block_attn(
-        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
-        pos_q, pos_k, scale.astype(jnp.float32), causal, m, l, o,
-    )
-    out = o / l.transpose(0, 2, 1)[..., None]
-    return out.astype(q.dtype)
+    """Single-device softmax attention, (B, S, H, D) layout — the
+    entry()/single-chip path. Runs the flash kernel where supported;
+    :func:`byteps_tpu.ops.attention_jnp` is the golden / fallback."""
+    return _flash_attention(q, k, v, causal=causal)
 
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -77,6 +86,9 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     n = jax.lax.axis_size(sp_axis)
     if n == 1:
         return plain_attention(q, k, v, causal=causal)
+    if _use_pallas() and _flash_supported(q.shape[1], k.shape[1],
+                                          q.shape[-1]):
+        return _ring_flash(q, k, v, sp_axis, n, causal)
     idx = jax.lax.axis_index(sp_axis)
     B, S_loc, H, D = q.shape
     scale = jnp.float32(1.0 / (D ** 0.5))
@@ -102,3 +114,32 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             v_blk = jax.lax.ppermute(v_blk, sp_axis, perm)
     out = o / l.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
+
+
+def _ring_flash(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                sp_axis: str, n: int, causal: bool) -> jnp.ndarray:
+    """Flash-kernel ring: per-step flash partials merged by logsumexp.
+
+    The visiting K/V block's global offset feeds the kernel's causal
+    mask, so above-diagonal steps contribute (o=0, lse=−1e30) partials
+    that the merge drops exactly; the merge itself runs in f32 at the
+    jnp level (fused elementwise by XLA) and its lse gradients flow back
+    through the flash backward kernels.
+    """
+    idx = jax.lax.axis_index(sp_axis)
+    B, S_loc, H, D = q.shape
+    q_off = idx * S_loc
+
+    o = jnp.zeros((B, S_loc, H, D), jnp.float32)
+    lse = jnp.full((B, S_loc, H), _NEG, jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    k_blk, v_blk = k, v
+    for step in range(n):
+        src = (idx - step) % n                # owner of the block we hold
+        o_s, lse_s = _flash_attention_lse(
+            q, k_blk, v_blk, q_off, src * S_loc, causal=causal)
+        o, lse = _merge_attention(o, lse, o_s, lse_s)
+        if step + 1 < n:
+            k_blk = jax.lax.ppermute(k_blk, sp_axis, perm)
+            v_blk = jax.lax.ppermute(v_blk, sp_axis, perm)
+    return o.astype(q.dtype)

@@ -1,0 +1,188 @@
+"""Flash attention kernels vs the jnp golden (interpret mode on CPU).
+
+Covers the fwd/bwd Pallas kernels, the global-offset causal masking, the
+logsumexp merge, and the flash ring-attention path under shard_map —
+mirroring the reference's compressor-vs-golden test style
+(SURVEY §4: every kernel has a dense-math twin asserted bit-close).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from byteps_tpu.ops.flash_attention import (
+    _NEG,
+    attention_jnp,
+    flash_attention,
+    flash_attention_lse,
+    merge_attention,
+    supported,
+)
+from byteps_tpu.parallel import (
+    MeshAxes,
+    make_mesh,
+    ring_attention,
+)
+
+
+@pytest.fixture(autouse=True)
+def _force_pallas(monkeypatch):
+    monkeypatch.setenv("BYTEPS_KERNEL_BACKEND", "pallas")
+
+
+def _rand_qkv(rng, B=2, S=64, H=2, D=16, dtype=jnp.float32):
+    ks = jax.random.split(rng, 3)
+    return tuple(jax.random.normal(k, (B, S, H, D), dtype) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("shape", [(2, 64, 2, 16), (1, 128, 3, 32)])
+def test_forward_matches_golden(shape, causal):
+    B, S, H, D = shape
+    q, k, v = _rand_qkv(jax.random.PRNGKey(0), B, S, H, D)
+    got = flash_attention(q, k, v, causal=causal)
+    want = attention_jnp(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_grads_match_golden(causal):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(1))
+
+    def loss(attn):
+        return lambda q, k, v: (attn(q, k, v, causal=causal) ** 2).sum()
+
+    got = jax.grad(loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss(attention_jnp), argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_bf16_forward_close_to_f32_golden():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(2), dtype=jnp.bfloat16)
+    got = flash_attention(q, k, v)
+    assert got.dtype == jnp.bfloat16
+    want = attention_jnp(q.astype(jnp.float32), k.astype(jnp.float32),
+                         v.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(got, dtype=np.float32),
+                               np.asarray(want), rtol=2e-2, atol=2e-2)
+
+
+def test_global_offsets_mask_against_manual_golden():
+    """q block at global rows 32.., k block at global cols 16..: the kernel
+    must mask exactly where (32 + i) < (16 + j)."""
+    B, Sq, Sk, H, D = 1, 32, 64, 2, 16
+    rng = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(rng[0], (B, Sq, H, D), jnp.float32)
+    k = jax.random.normal(rng[1], (B, Sk, H, D), jnp.float32)
+    v = jax.random.normal(rng[2], (B, Sk, H, D), jnp.float32)
+    q_off, k_off = 32, 16
+
+    o, lse = flash_attention_lse(q, k, v, q_off, k_off, causal=True)
+
+    scale = 1.0 / (D ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = (q_off + jnp.arange(Sq))[:, None] >= (k_off + jnp.arange(Sk))
+    s = jnp.where(mask[None, None], s, _NEG)
+    want = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    # lse golden: logsumexp of live scores per row
+    want_lse = jax.nn.logsumexp(s, axis=-1).transpose(0, 2, 1)  # (B, Sq, H)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(want_lse),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_fully_masked_rows_are_neutral():
+    """k block strictly in the future → o = 0, lse = −1e30 (merge-neutral)."""
+    B, S, H, D = 1, 16, 1, 8
+    rng = jax.random.split(jax.random.PRNGKey(4), 3)
+    q = jax.random.normal(rng[0], (B, S, H, D), jnp.float32)
+    k = jax.random.normal(rng[1], (B, S, H, D), jnp.float32)
+    v = jax.random.normal(rng[2], (B, S, H, D), jnp.float32)
+    o, lse = flash_attention_lse(q, k, v, 0, 1000, causal=True)
+    assert np.all(np.asarray(o) == 0.0)
+    assert np.all(np.asarray(lse) <= _NEG / 2)
+
+
+def test_merge_reconstructs_split_attention():
+    """Attention over [K_a ; K_b] == merge(attn(K_a), attn(K_b))."""
+    B, S, H, D = 2, 64, 2, 16
+    rng = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(rng[0], (B, S, H, D), jnp.float32)
+    k = jax.random.normal(rng[1], (B, 2 * S, H, D), jnp.float32)
+    v = jax.random.normal(rng[2], (B, 2 * S, H, D), jnp.float32)
+
+    o_a, lse_a = flash_attention_lse(q, k[:, :S], v[:, :S], 0, 0,
+                                     causal=False)
+    o_b, lse_b = flash_attention_lse(q, k[:, S:], v[:, S:], 0, 0,
+                                     causal=False)
+    o, _ = merge_attention(o_a, lse_a, o_b, lse_b)
+    want = attention_jnp(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.fixture(scope="module")
+def sp_mesh():
+    return make_mesh(MeshAxes(sp=4), devices=jax.devices()[:4])
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_flash_matches_golden(sp_mesh, causal):
+    # S_loc = 16 ≥ the kernel's min block → the flash ring path engages
+    q, k, v = _rand_qkv(jax.random.PRNGKey(6), S=64)
+    want = attention_jnp(q, k, v, causal=causal)
+    got = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, "sp", causal=causal),
+            mesh=sp_mesh,
+            in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(None, "sp"),
+            check_vma=False,
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_flash_grads_match_golden(sp_mesh):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(7), S=64)
+
+    def gold(q, k, v):
+        return (attention_jnp(q, k, v) ** 2).sum()
+
+    want = jax.grad(gold, argnums=(0, 1, 2))(q, k, v)
+
+    # Per-device loss WITHOUT psum: the global objective is the sum of
+    # per-device losses, and the ppermute transpose already routes each
+    # device's k/v cotangent contributions around the ring — so local
+    # grads == global grads, with no vma requirement. (check_vma=True +
+    # interpret-mode pallas is a known jax gap; its own error message
+    # recommends check_vma=False.)
+    def local(q, k, v):
+        o = ring_attention(q, k, v, "sp")
+        return (o.astype(jnp.float32) ** 2).sum()
+
+    got = jax.jit(
+        jax.shard_map(
+            jax.grad(local, argnums=(0, 1, 2)), mesh=sp_mesh,
+            in_specs=(P(None, "sp"),) * 3,
+            out_specs=(P(None, "sp"),) * 3,
+            check_vma=False,
+        )
+    )(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_supported_shapes():
+    assert supported(64, 64, 16)
+    assert supported(128, 256, 64)
+    assert not supported(100, 64, 16)   # S not tileable
+    assert not supported(64, 64, 512)   # head_dim beyond VMEM budget
