@@ -241,6 +241,8 @@ def DistributedOptimizer(
     average: bool = True,
     partition_bytes: Optional[int] = None,
     seed: int = 0,
+    per_device_numel: Optional[int] = None,
+    state_leading: tuple = (),
 ) -> optax.GradientTransformation:
     """Wrap an optax transformation with BytePS gradient aggregation.
 
@@ -248,6 +250,15 @@ def DistributedOptimizer(
     the dp ``axis``. Gradients entering ``update`` are per-device; the
     wrapper aggregates them (compressed if configured), updates EF/momentum
     state, then applies the inner transformation to the aggregated grads.
+
+    When the step composes other model-parallel axes (pp stages, ep expert
+    groups) each device's gradient pytree is a *shard* of the params:
+    pass ``per_device_numel`` (that shard's element count) and
+    ``state_leading`` (the sizes of those axes, e.g. ``(n_pp,)``) so the
+    EF/momentum worker buffers come out shaped
+    ``state_leading + (n_dp * per_device_numel,)`` — shard them
+    ``P(pp_axis, ..., dp_axis)`` and every device sees exactly its own
+    flat residual (``update`` ravels whatever block arrives).
 
     Reference: ``DistributedOptimizer(optimizer, named_parameters,
     compression, ...)`` in byteps/torch — same contract, functional form.
@@ -259,22 +270,24 @@ def DistributedOptimizer(
     def init_fn(params):
         # count elements from shapes — params may be tp-sharded global
         # arrays here (no ravel/concat, which would force a resharding)
-        total = sum(
+        total = per_device_numel if per_device_numel is not None else sum(
             int(np.prod(l.shape)) if l.ndim else 1
             for l in jax.tree.leaves(params)
         )
         # EF / momentum are PER-DEVICE worker state (each device is one
-        # reference worker): globally (n * total,), sharded over the dp axis
-        # so each device's shard_map block is its own (total,) buffer. Shard
-        # with `dp_state_specs()`; see that helper's docstring.
+        # reference worker): globally state_leading + (n * total,), sharded
+        # over (those axes..., dp) so each device's shard_map block is its
+        # own (total,) buffer. Shard with `dp_state_specs()`; see that
+        # helper's docstring.
         n = num_devices if num_devices is not None else len(jax.devices())
+        shape = tuple(state_leading) + (n * total,)
         ef = (
-            jnp.zeros((n * total,), jnp.float32)
+            jnp.zeros(shape, jnp.float32)
             if (spec.enabled and spec.ef)
             else None
         )
         mom = (
-            jnp.zeros((n * total,), jnp.float32)
+            jnp.zeros(shape, jnp.float32)
             if (spec.enabled and spec.momentum)
             else None
         )
@@ -294,6 +307,16 @@ def DistributedOptimizer(
             int(np.prod(l.shape)) if l.ndim else 1
             for l in jax.tree.leaves(grads)
         )
+        # inside shard_map the state block may carry collapsed leading axes
+        # ((1, ..., total) under a (pp, ..., dp) sharding) — work on the
+        # flat view and restore the block shape on return
+        ef_shape = state.ef.shape if state.ef is not None else None
+        mom_shape = state.momentum.shape if state.momentum is not None else None
+        state = state._replace(
+            ef=state.ef.ravel() if state.ef is not None else None,
+            momentum=(state.momentum.ravel()
+                      if state.momentum is not None else None),
+        )
         for buf, kind in ((state.ef, "EF"), (state.momentum, "momentum")):
             if buf is not None and buf.shape[0] != total:
                 raise ValueError(
@@ -301,7 +324,8 @@ def DistributedOptimizer(
                     f"this device's gradients have {total}. Most likely "
                     "DistributedOptimizer was built without num_devices= on a "
                     "mesh whose dp axis does not span all jax.devices() — "
-                    "pass num_devices=mesh.shape['dp']."
+                    "pass num_devices=mesh.shape['dp'] (and per_device_numel= "
+                    "on pp/ep meshes where each device grads a param shard)."
                 )
 
         mom = state.momentum
@@ -345,6 +369,10 @@ def DistributedOptimizer(
             )
 
         updates, new_inner = tx.update(agg, state.inner, params)
+        if new_ef is not None:
+            new_ef = new_ef.reshape(ef_shape)
+        if mom is not None:
+            mom = mom.reshape(mom_shape)
         return updates, DistributedOptState(
             inner=new_inner, count=state.count + 1, ef=new_ef, momentum=mom
         )
@@ -378,7 +406,8 @@ def _fused_trace_callback(count, total_elems: int, chunks: int) -> None:
     )
 
 
-def dp_state_specs(axis: Optional[str] = None) -> DistributedOptState:
+def dp_state_specs(axis: Optional[str] = None,
+                   leading_axes: tuple = ()) -> DistributedOptState:
     """PartitionSpec prefix-tree for a ``DistributedOptState``.
 
     Use as the shard_map in/out spec for the optimizer state: the inner
@@ -390,8 +419,13 @@ def dp_state_specs(axis: Optional[str] = None) -> DistributedOptState:
         step = jax.shard_map(per_device_step, mesh=mesh,
                              in_specs=(P(), spec, P("dp"), P("dp")),
                              out_specs=(P(), spec), check_vma=False)
+
+    ``leading_axes`` names the extra state axes of a pp/ep-composed
+    optimizer built with ``state_leading`` (buffer spec becomes
+    ``P(*leading_axes, dp)``).
     """
     from jax.sharding import PartitionSpec as P
 
     axis = axis or get_config().dp_axis
-    return DistributedOptState(inner=P(), count=P(), ef=P(axis), momentum=P(axis))
+    buf = P(*leading_axes, axis)
+    return DistributedOptState(inner=P(), count=P(), ef=buf, momentum=buf)

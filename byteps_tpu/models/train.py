@@ -23,6 +23,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -57,13 +58,55 @@ def _axis(mesh: Mesh, name: str) -> Optional[str]:
 def _check_compression_mesh(use_vma, tp, sp):
     if not use_vma and (tp is not None or sp is not None):
         raise NotImplementedError(
-            "compressed aggregation currently requires a dp-only mesh "
-            "(tp/sp axes need the VMA path, which the compressed collective "
-            "does not yet support)"
+            "compressed aggregation requires a mesh without tp/sp axes "
+            "(their in-forward collectives need the VMA path, which the "
+            "compressed collective does not support; pp and ep compose — "
+            "their grad psums run explicitly in check_vma=False mode)"
         )
 
 
-def _make_tx(mesh, base_tx, compression_params, partition_bytes, dp):
+def _state_axes(mesh, pspecs, dp) -> tuple:
+    """Mesh axes (besides dp) that shard the params — each combination of
+    their indices is a distinct "worker" whose EF/momentum residual must be
+    its own buffer (pp stages grad different layer slabs, ep groups
+    different expert slabs). Ordered by mesh axis order."""
+    used = set()
+    for spec in jax.tree.leaves(pspecs, is_leaf=lambda x: isinstance(x, P)):
+        used |= _spec_axes(spec)
+    return tuple(a for a in mesh.axis_names if a in used and a != dp)
+
+
+def _per_device_numel(params, pspecs, mesh) -> int:
+    """Element count of one device's gradient pytree: each leaf's numel
+    divided by the sizes of the mesh axes its spec shards it over."""
+
+    def leaf_numel(leaf, spec):
+        n = int(np.prod(leaf.shape)) if leaf.ndim else 1
+        for a in _spec_axes(spec):
+            n //= mesh.shape[a]
+        return n
+
+    counts = jax.tree.map(leaf_numel, params, pspecs,
+                          is_leaf=lambda x: x is None)
+    return sum(jax.tree.leaves(counts))
+
+
+def _manual_axis_sums(grads, pspecs, axes):
+    """No-vma grad assembly: psum each leaf over the listed mesh axes it is
+    NOT sharded on (its stage-partial contributions), leaving sharded
+    leaves (whose spec names the axis) stage-local. Under check_vma=True
+    these psums are what VMA auto-inserts; the compressed paths run
+    check_vma=False and do them explicitly."""
+
+    def fix(g, spec):
+        need = tuple(a for a in axes if a not in _spec_axes(spec))
+        return jax.lax.psum(g, need) if need else g
+
+    return jax.tree.map(fix, grads, pspecs, is_leaf=lambda x: x is None)
+
+
+def _make_tx(mesh, base_tx, compression_params, partition_bytes, dp,
+             per_device_numel=None, state_leading=()):
     """Wrap base_tx with dp aggregation (or pass through on a dp-less mesh).
 
     Separated from the params/state sharding so the auto-tuner can rebuild
@@ -75,10 +118,11 @@ def _make_tx(mesh, base_tx, compression_params, partition_bytes, dp):
     return DistributedOptimizer(
         base_tx, compression_params=compression_params, axis=dp,
         num_devices=mesh.shape[dp], partition_bytes=partition_bytes,
+        per_device_numel=per_device_numel, state_leading=state_leading,
     )
 
 
-def _shard_params_state(mesh, tx, params, pspecs, dp):
+def _shard_params_state(mesh, tx, params, pspecs, dp, state_axes=()):
     """device_put params, init + shard the optimizer state."""
     params = jax.device_put(
         params, jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs)
@@ -86,10 +130,12 @@ def _shard_params_state(mesh, tx, params, pspecs, dp):
     opt_state = tx.init(params)
     ospecs = opt_state_specs(opt_state, params, pspecs)
     if dp is not None:
-        # EF / momentum flats are per-dp-worker state (see dp_state_specs)
+        # EF / momentum flats are per-worker state: one buffer per (pp/ep
+        # stage combination, dp worker) — see dp_state_specs
+        buf = P(*state_axes, dp)
         ospecs = ospecs._replace(
-            ef=P(dp) if opt_state.ef is not None else None,
-            momentum=P(dp) if opt_state.momentum is not None else None,
+            ef=buf if opt_state.ef is not None else None,
+            momentum=buf if opt_state.momentum is not None else None,
         )
     opt_state = jax.device_put(
         opt_state, jax.tree.map(lambda s: NamedSharding(mesh, s), ospecs)
@@ -150,30 +196,32 @@ def _make_resymmetrize(pspecs, dp):
 
 
 def _build_pp_jit(mesh, pspecs, ospecs, batch_spec, loss_fn, tx, dp, pp,
-                  ep_size=1, mean_axes=()):
+                  ep=None, ep_size=1, mean_axes=(), use_vma=True):
     """The grad-assembly skeleton both pipeline factories share: per-device
-    masked loss -> pp psum of the replicated GPT leaves, stage-local slab
-    grads, optional uniform /ep, resym, dp aggregation via ``tx``, and
-    VMA-collapsed loss reporting. check_vma=True throughout."""
+    masked loss -> psum of each leaf's stage-partial grads over the axes it
+    is NOT sharded on (pp always; ep too under check_vma=False, where no
+    VMA auto-psum exists), optional uniform /ep, resym, dp aggregation via
+    ``tx``, and VMA-collapsed loss reporting. ``use_vma=False`` is the
+    compressed mode (the compressed collective defeats VMA's replication
+    analysis)."""
     resym = _make_resymmetrize(pspecs, dp)
+    # under check_vma=True VMA auto-inserts the ep psums for ep-invariant
+    # leaves; manual-summing them too would double-count
+    sum_axes = (pp,) if use_vma else tuple(a for a in (pp, ep) if a)
 
     def per_device_step(params, opt_state, tokens, targets):
-        grad_params = _pcast_dp(params, dp, mesh, True)
+        grad_params = _pcast_dp(params, dp, mesh, use_vma)
         # loss_fn returns the last-stage-masked loss: grading through an
         # already-replicated psum double-counts (psum transpose)
         loss, grads = jax.value_and_grad(loss_fn)(
             grad_params, tokens, targets
         )
         loss = jax.lax.psum(loss, pp)  # replicate for reporting
-        # stage-partial grads of the pp-replicated leaves (everything
-        # outside the stage-local blocks slab) sum to the true grad
-        grads = {
-            k: g if k == "blocks" else jax.lax.psum(g, pp)
-            for k, g in grads.items()
-        }
+        grads = _manual_axis_sums(grads, pspecs, sum_axes)
         if ep_size > 1:
             grads = jax.tree.map(lambda g: g / ep_size, grads)
-        grads = resym(grads)  # collapse conservative VMA widening
+        grads = resym(grads)  # collapse conservative VMA widening (no-op
+        # without VMA types, as is _collapse_vma below)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         if mean_axes:
@@ -186,7 +234,7 @@ def _build_pp_jit(mesh, pspecs, ospecs, batch_spec, loss_fn, tx, dp, pp,
         mesh=mesh,
         in_specs=(pspecs, ospecs, batch_spec, batch_spec),
         out_specs=(P(), pspecs, ospecs),
-        check_vma=True,
+        check_vma=use_vma,
     )
     return jax.jit(sharded, donate_argnums=(0, 1))
 
@@ -276,6 +324,7 @@ def make_gpt_pp_train_step(
     mesh: Mesh,
     base_tx: optax.GradientTransformation,
     n_micro: int = 4,
+    compression_params: Optional[Dict[str, Any]] = None,
     partition_bytes: Optional[int] = None,
     remat: bool = False,
 ):
@@ -290,9 +339,12 @@ def make_gpt_pp_train_step(
     check_vma=True, so replicated params' cotangents get their psums
     auto-inserted exactly as in the dense factory). dp aggregation is
     DistributedOptimizer as everywhere else; grads of pp-replicated
-    leaves (embeddings, final LN)
-    are psum'd over pp first. Compression is not yet supported on the pp
-    path (EF state is sized per-device and block grads are pp-sharded).
+    leaves (embeddings, final LN) are psum'd over pp first.
+
+    ``compression_params`` enables compressed dp aggregation on a
+    (pp, dp)-only mesh (check_vma=False mode, like the dense factory's):
+    each stage compresses its own slab + replicated-leaf grads over dp,
+    with per-(stage, worker) EF/momentum state.
 
     Returns ``(step, params, opt_state, batch_sharding)`` like
     :func:`make_gpt_train_step`; ``params["blocks"]`` is the stacked slab.
@@ -303,6 +355,8 @@ def make_gpt_pp_train_step(
     tp, sp = _axis(mesh, "tp"), _axis(mesh, "sp")
     if pp is None:
         raise ValueError("mesh has no pp axis — use make_gpt_train_step")
+    use_vma = compression_params is None
+    _check_compression_mesh(use_vma, tp, sp)
     nstages = mesh.shape[pp]
     if cfg.n_layers % nstages != 0:
         raise ValueError(
@@ -318,21 +372,29 @@ def make_gpt_pp_train_step(
         "wte": P(), "wpe": P(), "lnf_g": P(), "lnf_b": P(),
         "blocks": stacked_specs(block_specs(tp), pp),
     }
+    state_axes = _state_axes(mesh, pspecs, dp)
+    tx_kw = dict(
+        per_device_numel=_per_device_numel(params, pspecs, mesh),
+        state_leading=tuple(mesh.shape[a] for a in state_axes),
+    )
     params, opt_state, ospecs = _shard_params_state(
-        mesh, _make_tx(mesh, base_tx, None, partition_bytes, dp),
-        params, pspecs, dp,
+        mesh,
+        _make_tx(mesh, base_tx, compression_params, partition_bytes, dp,
+                 **tx_kw),
+        params, pspecs, dp, state_axes=state_axes,
     )
     batch_spec = P(dp, sp)
     loss_fn = functools.partial(
         gpt_pp_loss, cfg=cfg, pp_axis=pp, n_micro=n_micro, tp_axis=tp,
-        sp_axis=sp, remat=remat, vma_axes=tuple(mesh.axis_names),
+        sp_axis=sp, remat=remat,
+        vma_axes=tuple(mesh.axis_names) if use_vma else (),
     )
 
     def build_jit(pb):
-        tx = _make_tx(mesh, base_tx, None, pb, dp)
+        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, **tx_kw)
         return _build_pp_jit(
             mesh, pspecs, ospecs, batch_spec, loss_fn, tx, dp, pp,
-            mean_axes=(dp,) if dp is not None else (),
+            mean_axes=(dp,) if dp is not None else (), use_vma=use_vma,
         )
 
     return (
@@ -345,6 +407,7 @@ def make_gpt_moe_train_step(
     cfg,
     mesh: Mesh,
     base_tx: optax.GradientTransformation,
+    compression_params: Optional[Dict[str, Any]] = None,
     partition_bytes: Optional[int] = None,
     remat: bool = False,
 ):
@@ -358,6 +421,11 @@ def make_gpt_moe_train_step(
     uniform /ep turns the summed per-device grads into the mean the
     mean-of-local-means loss needs; dp averaging stays in
     DistributedOptimizer as everywhere else.
+
+    ``compression_params`` enables compressed dp aggregation on a
+    (dp, ep)-only mesh (check_vma=False mode): the ep psums of
+    ep-invariant leaves run explicitly, then each (ep group, dp worker)
+    compresses its grads over dp with its own EF/momentum state.
 
     Returns ``(step, params, opt_state, batch_sharding)``.
     """
@@ -374,6 +442,8 @@ def make_gpt_moe_train_step(
             "mesh has a pp axis — use make_gpt_moe_pp_train_step for "
             "pipelined MoE"
         )
+    use_vma = compression_params is None
+    _check_compression_mesh(use_vma, tp, sp)
     ep_size = mesh.shape[ep] if ep is not None else 1
     if ep is not None and cfg.n_experts % ep_size != 0:
         raise ValueError(
@@ -381,9 +451,16 @@ def make_gpt_moe_train_step(
         )
     pspecs = moe_gpt_param_specs(cfg, ep, tp)
     params = moe_gpt_init(jax.random.PRNGKey(0), cfg)
+    state_axes = _state_axes(mesh, pspecs, dp)
+    tx_kw = dict(
+        per_device_numel=_per_device_numel(params, pspecs, mesh),
+        state_leading=tuple(mesh.shape[a] for a in state_axes),
+    )
     params, opt_state, ospecs = _shard_params_state(
-        mesh, _make_tx(mesh, base_tx, None, partition_bytes, dp),
-        params, pspecs, dp,
+        mesh,
+        _make_tx(mesh, base_tx, compression_params, partition_bytes, dp,
+                 **tx_kw),
+        params, pspecs, dp, state_axes=state_axes,
     )
     batch_spec = P((dp, ep) if dp and ep else (dp or ep), sp)
     resym = _make_resymmetrize(pspecs, dp)
@@ -391,19 +468,22 @@ def make_gpt_moe_train_step(
                                 tp_axis=tp, sp_axis=sp, remat=remat)
 
     def build_jit(pb):
-        tx = _make_tx(mesh, base_tx, None, pb, dp)
+        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, **tx_kw)
 
         def per_device_step(params, opt_state, tokens, targets):
-            grad_params = _pcast_dp(params, dp, mesh, True)
+            grad_params = _pcast_dp(params, dp, mesh, use_vma)
             loss, grads = jax.value_and_grad(loss_fn)(
                 grad_params, tokens, targets
             )
             if ep is not None:
                 # the global loss is the MEAN of per-device local means;
-                # under check_vma=True the ep-invariant leaves' grads
-                # arrive SUMMED over ep (VMA auto-psum) and the expert
-                # slabs already summed their peers' contributions through
-                # the all_to_all transpose — one uniform /ep gives means
+                # the ep-invariant leaves' grads must arrive SUMMED over
+                # ep (VMA auto-psum under check_vma=True, explicit psums
+                # in compressed mode) and the expert slabs already summed
+                # their peers' contributions through the all_to_all
+                # transpose — one uniform /ep gives means
+                if not use_vma:
+                    grads = _manual_axis_sums(grads, pspecs, (ep,))
                 grads = jax.tree.map(lambda g: g / ep_size, grads)
             grads = resym(grads)  # collapse conservative VMA widening
             updates, opt_state = tx.update(grads, opt_state, params)
@@ -419,7 +499,7 @@ def make_gpt_moe_train_step(
             mesh=mesh,
             in_specs=(pspecs, ospecs, batch_spec, batch_spec),
             out_specs=(P(), pspecs, ospecs),
-            check_vma=True,
+            check_vma=use_vma,
         )
         return jax.jit(sharded, donate_argnums=(0, 1))
 
@@ -434,6 +514,7 @@ def make_gpt_moe_pp_train_step(
     mesh: Mesh,
     base_tx: optax.GradientTransformation,
     n_micro: int = 4,
+    compression_params: Optional[Dict[str, Any]] = None,
     partition_bytes: Optional[int] = None,
     remat: bool = False,
 ):
@@ -461,6 +542,8 @@ def make_gpt_moe_pp_train_step(
     ep, tp, sp = _axis(mesh, "ep"), _axis(mesh, "tp"), _axis(mesh, "sp")
     if pp is None:
         raise ValueError("mesh has no pp axis — use make_gpt_moe_train_step")
+    use_vma = compression_params is None
+    _check_compression_mesh(use_vma, tp, sp)
     nstages = mesh.shape[pp]
     ep_size = mesh.shape[ep] if ep is not None else 1
     if cfg.n_layers % nstages != 0:
@@ -481,23 +564,31 @@ def make_gpt_moe_pp_train_step(
         "wte": P(), "wpe": P(), "lnf_g": P(), "lnf_b": P(),
         "blocks": stacked_specs(moe_block_specs(ep, tp), pp),
     }
+    state_axes = _state_axes(mesh, pspecs, dp)
+    tx_kw = dict(
+        per_device_numel=_per_device_numel(params, pspecs, mesh),
+        state_leading=tuple(mesh.shape[a] for a in state_axes),
+    )
     params, opt_state, ospecs = _shard_params_state(
-        mesh, _make_tx(mesh, base_tx, None, partition_bytes, dp),
-        params, pspecs, dp,
+        mesh,
+        _make_tx(mesh, base_tx, compression_params, partition_bytes, dp,
+                 **tx_kw),
+        params, pspecs, dp, state_axes=state_axes,
     )
     batch_spec = P((dp, ep) if dp and ep else (dp or ep), sp)
     loss_fn = functools.partial(
         moe_gpt_pp_loss, cfg=cfg, pp_axis=pp, n_micro=n_micro,
         ep_axis=ep, tp_axis=tp, sp_axis=sp, remat=remat,
-        vma_axes=tuple(mesh.axis_names),
+        vma_axes=tuple(mesh.axis_names) if use_vma else (),
     )
 
     def build_jit(pb):
-        tx = _make_tx(mesh, base_tx, None, pb, dp)
+        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, **tx_kw)
         return _build_pp_jit(
             mesh, pspecs, ospecs, batch_spec, loss_fn, tx, dp, pp,
-            ep_size=ep_size if ep is not None else 1,
+            ep=ep, ep_size=ep_size if ep is not None else 1,
             mean_axes=tuple(a for a in (dp, ep) if a is not None),
+            use_vma=use_vma,
         )
 
     return (

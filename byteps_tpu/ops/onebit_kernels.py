@@ -19,7 +19,6 @@ arrays.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional
 
 import jax
