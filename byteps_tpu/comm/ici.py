@@ -83,6 +83,31 @@ def broadcast_flat(
     return _broadcast_impl(x, mesh=mesh, axis=axis, root=root)
 
 
+def _compress_push(g, rng, compressor, axis, n):
+    """Shared COMPRESS → "PUSH" half: segment, per-segment compress,
+    all_to_all so owner j receives every peer's segment j. Returns
+    ``(payload, seg_keys, recv, seg)``. Per-segment rng keys must agree
+    across devices (randomk index agreement, reference's
+    synchronized-seed requirement): derive from the replicated base key +
+    segment id only."""
+    segs, seg = _segment(g, n)      # (n, seg): row j goes to owner j
+    seg_keys = jax.vmap(lambda j: jax.random.fold_in(rng, j))(jnp.arange(n))
+    payload = jax.vmap(compressor.compress)(segs, seg_keys)
+    recv = jax.tree.map(
+        lambda a: jax.lax.all_to_all(a, axis, 0, 0, tiled=True), payload
+    )
+    return payload, seg_keys, recv, seg
+
+
+def _ef_residual(g, payload, seg_keys, compressor, seg, L):
+    """new_residual = input − D(C(input)) from the own-payload decompress
+    (reference ``FastUpdateError``; no second compression)."""
+    local_approx = jax.vmap(
+        lambda p, k: compressor.decompress(p, seg, jnp.float32, k)
+    )(payload, seg_keys)
+    return g - local_approx.reshape(-1)[:L]
+
+
 def compressed_allreduce_local(
     g: jnp.ndarray,
     rng: jnp.ndarray,
@@ -103,25 +128,13 @@ def compressed_allreduce_local(
 
     If ``ef_residual`` is given, error feedback is applied: the compressed
     input is ``g + ef_residual`` and the return value is a tuple
-    ``(out, new_residual)`` with ``new_residual = input − D(C(input))``
-    (reference ``FastUpdateError``; the own-payload decompress costs one
-    extra local decompress, no second compression).
+    ``(out, new_residual)`` with ``new_residual = input − D(C(input))``.
     """
     L = g.shape[0]
     g = g.astype(jnp.float32)
     if ef_residual is not None:
         g = g + ef_residual
-    segs, seg = _segment(g, n)      # (n, seg): row j goes to owner j
-    # Per-segment rng keys must agree across devices (randomk index
-    # agreement, reference's synchronized-seed requirement): derive from
-    # the replicated base key + segment id only.
-    seg_keys = jax.vmap(lambda j: jax.random.fold_in(rng, j))(jnp.arange(n))
-    payload = jax.vmap(compressor.compress)(segs, seg_keys)
-
-    # COMPRESS → "PUSH": owner j receives every peer's segment j.
-    recv = jax.tree.map(
-        lambda a: jax.lax.all_to_all(a, axis, 0, 0, tiled=True), payload
-    )
+    payload, seg_keys, recv, seg = _compress_push(g, rng, compressor, axis, n)
     my_id = jax.lax.axis_index(axis)
     my_key = jax.random.fold_in(rng, my_id)
 
@@ -155,11 +168,48 @@ def compressed_allreduce_local(
     out = out / n if average else out
     if ef_residual is None:
         return out
-    local_approx = jax.vmap(
-        lambda p, k: compressor.decompress(p, seg, jnp.float32, k)
-    )(payload, seg_keys)
-    new_residual = g - local_approx.reshape(-1)[:L]
-    return out, new_residual
+    return out, _ef_residual(g, payload, seg_keys, compressor, seg, L)
+
+
+def compressed_reduce_scatter_local(
+    g: jnp.ndarray,
+    rng: jnp.ndarray,
+    compressor: Compressor,
+    axis: str,
+    n: int,
+    average: bool = True,
+    ef_residual: Optional[jnp.ndarray] = None,
+):
+    """First half of the compressed all-reduce: COMPRESS → "PUSH" → owner
+    fp32 sum — WITHOUT the all_gather "PULL" back.
+
+    Call inside shard_map. Returns this device's owned ``(ceil(L/n),)``
+    fp32 segment of the aggregated gradient (the ZeRO-style sharded
+    aggregation primitive: the caller applies its optimizer shard to the
+    segment and all_gathers the *updates*, so the second wire direction
+    carries update bytes instead of gradient bytes). With ``ef_residual``
+    returns ``(segment, new_residual)`` — error feedback is identical to
+    :func:`compressed_allreduce_local`'s (compress(g + residual), residual
+    from the own-payload decompress).
+    """
+    L = g.shape[0]
+    g = g.astype(jnp.float32)
+    if ef_residual is not None:
+        g = g + ef_residual
+    payload, seg_keys, recv, seg = _compress_push(g, rng, compressor, axis, n)
+    my_id = jax.lax.axis_index(axis)
+    my_key = jax.random.fold_in(rng, my_id)
+    if compressor.presummable:
+        summed = jax.tree.map(lambda a: a.sum(axis=0), recv)
+        s = compressor.decompress(summed, seg, jnp.float32, my_key)
+    else:
+        my_keys = jnp.broadcast_to(my_key, (n,) + my_key.shape) \
+            if compressor.stochastic else None
+        s = compressor.decompress_sum(recv, seg, jnp.float32, my_keys)
+    s = s / n if average else s
+    if ef_residual is None:
+        return s
+    return s, _ef_residual(g, payload, seg_keys, compressor, seg, L)
 
 
 @functools.partial(

@@ -23,7 +23,10 @@ import numpy as np
 import optax
 
 from byteps_tpu.common.config import get_config
-from byteps_tpu.comm.ici import compressed_allreduce_local
+from byteps_tpu.comm.ici import (
+    compressed_allreduce_local,
+    compressed_reduce_scatter_local,
+)
 from byteps_tpu.compression import from_params
 from byteps_tpu.compression.error_feedback import CompressionSpec, momentum_step
 
@@ -243,6 +246,7 @@ def DistributedOptimizer(
     seed: int = 0,
     per_device_numel: Optional[int] = None,
     state_leading: tuple = (),
+    zero: bool = False,
 ) -> optax.GradientTransformation:
     """Wrap an optax transformation with BytePS gradient aggregation.
 
@@ -250,6 +254,19 @@ def DistributedOptimizer(
     the dp ``axis``. Gradients entering ``update`` are per-device; the
     wrapper aggregates them (compressed if configured), updates EF/momentum
     state, then applies the inner transformation to the aggregated grads.
+
+    ``zero=True`` is ZeRO-1 (no reference analog — the reference keeps
+    full optimizer replicas per GPU worker): the inner state lives on one
+    flat fp32 vector sharded over dp, gradients arrive at each worker as
+    its owned segment (``psum_scatter``, or the compressed collective's
+    owner-sum half), the inner ``tx`` steps only that segment, and the
+    resulting *updates* segment is all_gathered — optimizer-state HBM
+    drops to 1/n_dp, and the second wire direction carries update bytes
+    instead of gradient bytes. Requires the check_vma=False step mode
+    (the all_gathered updates are replicated but typed dp-varying). The
+    flat gradient aggregates as ONE scatter — ``partition_bytes``
+    chunking does not apply (chunk boundaries would bake into the inner
+    state layout, breaking the tuner's retrace-without-reinit contract).
 
     When the step composes other model-parallel axes (pp stages, ep expert
     groups) each device's gradient pytree is a *shard* of the params:
@@ -274,6 +291,13 @@ def DistributedOptimizer(
             int(np.prod(l.shape)) if l.ndim else 1
             for l in jax.tree.leaves(params)
         )
+        if zero:
+            n = num_devices if num_devices is not None else len(jax.devices())
+            seg = -(-total // n)
+            proto = jnp.zeros(tuple(state_leading) + (n * seg,), jnp.float32)
+            inner = tx.init(proto)
+        else:
+            inner = tx.init(params)
         # EF / momentum are PER-DEVICE worker state (each device is one
         # reference worker): globally state_leading + (n * total,), sharded
         # over (those axes..., dp) so each device's shard_map block is its
@@ -292,7 +316,77 @@ def DistributedOptimizer(
             else None
         )
         return DistributedOptState(
-            inner=tx.init(params), count=jnp.zeros((), jnp.int32), ef=ef, momentum=mom
+            inner=inner, count=jnp.zeros((), jnp.int32), ef=ef, momentum=mom
+        )
+
+    def _zero_update(grads, state, params, n, rng, ef_shape, mom_shape):
+        """ZeRO-1 step: segment-owner aggregation → inner tx on the owned
+        segment → all_gather of the updates segment."""
+        if params is None:
+            raise ValueError(
+                "ZeRO mode requires params= in update (the inner transform "
+                "steps a params segment)")
+        flat, sizes = _flatten_concat(grads)
+        total = flat.shape[0]
+        seg = -(-total // n)
+        mom = state.momentum
+        if spec.enabled and mom is not None:
+            flat, mom = momentum_step(flat, mom, spec.mu)
+        if spec.enabled:
+            if state.ef is not None:
+                my_seg, new_ef = compressed_reduce_scatter_local(
+                    flat, rng, spec.compressor, axis_name, n,
+                    average=average, ef_residual=state.ef)
+            else:
+                my_seg = compressed_reduce_scatter_local(
+                    flat, rng, spec.compressor, axis_name, n,
+                    average=average)
+                new_ef = None
+        else:
+            # BYTEPS_REDUCE_DTYPE applies here as on the chunked path:
+            # bf16 halves the scatter's wire bytes, sum accuracy reduced
+            padded = jnp.pad(flat, (0, n * seg - total)).astype(
+                jnp.dtype(cfg.reduce_dtype))
+            s = jax.lax.psum_scatter(
+                padded, axis_name, scatter_dimension=0,
+                tiled=True).astype(jnp.float32)
+            my_seg = s / n if average else s
+            new_ef = state.ef
+        if cfg.trace_on and _host_callbacks_supported():
+            jax.debug.callback(
+                _fused_trace_callback, state.count,
+                total_elems=total, chunks=1,
+            )
+        # the inner state block arrives (1, ..., 1, seg) under its
+        # (pp/ep..., dp) sharding — flatten for the segment step, restore
+        # the block shape on the way out
+        lead = len(state_leading)
+
+        def to_seg(l):
+            if (hasattr(l, "ndim") and l.ndim == lead + 1
+                    and l.shape[-1] == seg):
+                return l.reshape(seg)
+            return l
+
+        inner_seg = jax.tree.map(to_seg, state.inner)
+        p_flat, _ = _flatten_concat(params)
+        p_pad = jnp.pad(p_flat, (0, n * seg - total))
+        my_id = jax.lax.axis_index(axis_name)
+        p_seg = jax.lax.dynamic_slice_in_dim(p_pad, my_id * seg, seg)
+        upd_seg, new_inner_seg = tx.update(my_seg, inner_seg, p_seg)
+        upd_full = jax.lax.all_gather(upd_seg, axis_name, axis=0, tiled=True)
+        updates = _unconcat_unflatten(upd_full[:total], grads, sizes)
+        new_inner = jax.tree.map(
+            lambda nl, ol: nl.reshape(ol.shape)
+            if (hasattr(ol, "shape") and hasattr(nl, "shape")
+                and nl.shape != ol.shape) else nl,
+            new_inner_seg, state.inner)
+        if new_ef is not None:
+            new_ef = new_ef.reshape(ef_shape)
+        if mom is not None:
+            mom = mom.reshape(mom_shape)
+        return updates, DistributedOptState(
+            inner=new_inner, count=state.count + 1, ef=new_ef, momentum=mom
         )
 
     def update_fn(grads, state: DistributedOptState, params=None):
@@ -327,6 +421,10 @@ def DistributedOptimizer(
                     "pass num_devices=mesh.shape['dp'] (and per_device_numel= "
                     "on pp/ep meshes where each device grads a param shard)."
                 )
+
+        if zero:
+            return _zero_update(grads, state, params, n, rng,
+                                ef_shape, mom_shape)
 
         mom = state.momentum
         if spec.enabled and mom is not None:

@@ -27,7 +27,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from byteps_tpu.jax.optimizer import DistributedOptimizer
+from byteps_tpu.jax.optimizer import DistributedOptimizer, dp_state_specs
 from byteps_tpu.models.bert import (
     BertConfig,
     bert_init,
@@ -58,11 +58,26 @@ def _axis(mesh: Mesh, name: str) -> Optional[str]:
 def _check_compression_mesh(use_vma, tp, sp):
     if not use_vma and (tp is not None or sp is not None):
         raise NotImplementedError(
-            "compressed aggregation requires a mesh without tp/sp axes "
-            "(their in-forward collectives need the VMA path, which the "
-            "compressed collective does not support; pp and ep compose — "
-            "their grad psums run explicitly in check_vma=False mode)"
+            "compressed aggregation and ZeRO-1 (zero_1=True) require a "
+            "mesh without tp/sp axes: their in-forward collectives need "
+            "the VMA path, which neither the compressed collective nor "
+            "the ZeRO all_gather supports. pp and ep compose — their grad "
+            "psums run explicitly in check_vma=False mode."
         )
+
+
+def _dist_state_setup(mesh, params, pspecs, dp, zero_1):
+    """The per-factory distributed-state bookkeeping: which mesh axes give
+    each device its own worker state, the per-device grads numel, and the
+    kwargs both _make_tx and _shard_params_state need."""
+    state_axes = _state_axes(mesh, pspecs, dp)
+    pd_numel = _per_device_numel(params, pspecs, mesh)
+    tx_kw = dict(
+        per_device_numel=pd_numel,
+        state_leading=tuple(mesh.shape[a] for a in state_axes),
+        zero=zero_1,
+    )
+    return state_axes, tx_kw, (pd_numel if zero_1 else None)
 
 
 def _state_axes(mesh, pspecs, dp) -> tuple:
@@ -106,7 +121,7 @@ def _manual_axis_sums(grads, pspecs, axes):
 
 
 def _make_tx(mesh, base_tx, compression_params, partition_bytes, dp,
-             per_device_numel=None, state_leading=()):
+             per_device_numel=None, state_leading=(), zero=False):
     """Wrap base_tx with dp aggregation (or pass through on a dp-less mesh).
 
     Separated from the params/state sharding so the auto-tuner can rebuild
@@ -119,11 +134,19 @@ def _make_tx(mesh, base_tx, compression_params, partition_bytes, dp,
         base_tx, compression_params=compression_params, axis=dp,
         num_devices=mesh.shape[dp], partition_bytes=partition_bytes,
         per_device_numel=per_device_numel, state_leading=state_leading,
+        zero=zero,
     )
 
 
-def _shard_params_state(mesh, tx, params, pspecs, dp, state_axes=()):
-    """device_put params, init + shard the optimizer state."""
+def _shard_params_state(mesh, tx, params, pspecs, dp, state_axes=(),
+                        zero_numel=None):
+    """device_put params, init + shard the optimizer state.
+
+    ``zero_numel`` (ZeRO-1 mode, = per-device grads numel) switches the
+    inner-state sharding rule: the inner transform's state lives on flat
+    vectors shaped ``state_leading + (n_dp * ceil(numel/n_dp),)``, sharded
+    ``P(*state_axes, dp)`` so each worker holds only its segment's
+    moments."""
     params = jax.device_put(
         params, jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs)
     )
@@ -131,28 +154,42 @@ def _shard_params_state(mesh, tx, params, pspecs, dp, state_axes=()):
     ospecs = opt_state_specs(opt_state, params, pspecs)
     if dp is not None:
         # EF / momentum flats are per-worker state: one buffer per (pp/ep
-        # stage combination, dp worker) — see dp_state_specs
-        buf = P(*state_axes, dp)
+        # stage combination, dp worker)
+        buf_specs = dp_state_specs(axis=dp, leading_axes=state_axes)
+        buf = buf_specs.ef
         ospecs = ospecs._replace(
             ef=buf if opt_state.ef is not None else None,
             momentum=buf if opt_state.momentum is not None else None,
         )
+        if zero_numel is not None:
+            n = mesh.shape[dp]
+            proto_shape = tuple(mesh.shape[a] for a in state_axes) + (
+                n * (-(-zero_numel // n)),
+            )
+            ospecs = ospecs._replace(inner=jax.tree.map(
+                lambda l: buf if getattr(l, "shape", None) == proto_shape
+                else P(),
+                opt_state.inner,
+            ))
     opt_state = jax.device_put(
         opt_state, jax.tree.map(lambda s: NamedSharding(mesh, s), ospecs)
     )
     return params, opt_state, ospecs
 
 
-def _finalize_step(build_jit, partition_bytes, dp):
+def _finalize_step(build_jit, partition_bytes, dp, tunable=True):
     """Return the jitted step, auto-tuned when BYTEPS_AUTO_TUNE=1.
 
     The tuned wrapper re-invokes ``build_jit`` with new partition sizes as
     the search moves (ByteScheduler's online partition tuning, SURVEY §2.6,
-    transposed to the fused path where a move costs one cached retrace)."""
+    transposed to the fused path where a move costs one cached retrace).
+    ``tunable=False`` (ZeRO-1 mode) skips the tuner: the zero path
+    aggregates the whole flat gradient in one scatter, so partition size
+    changes nothing and every 'move' would retrace an identical program."""
     from byteps_tpu.common.config import get_config
 
     cfg = get_config()
-    if cfg.auto_tune and dp is not None:
+    if cfg.auto_tune and dp is not None and tunable:
         from byteps_tpu.jax.tuned_step import AutoTunedStep
 
         return AutoTunedStep(build_jit, partition_bytes or cfg.partition_bytes)
@@ -256,6 +293,7 @@ def make_gpt_train_step(
     compression_params: Optional[Dict[str, Any]] = None,
     partition_bytes: Optional[int] = None,
     remat: bool = False,
+    zero_1: bool = False,
 ):
     """Returns ``(step, params, opt_state, batch_sharding)``.
 
@@ -263,16 +301,23 @@ def make_gpt_train_step(
     is jitted over ``mesh``; tokens/targets are global (B, S) arrays
     sharded (dp, sp) by ``batch_sharding``. ``remat=True`` rematerializes
     each transformer block in the backward pass (HBM for FLOPs — the
-    long-context lever; numerics unchanged).
+    long-context lever; numerics unchanged). ``zero_1=True`` shards the
+    inner optimizer state over dp (ZeRO-1: psum_scatter'd grads, segment
+    update, all_gathered updates — 1/n_dp the optimizer HBM; composes
+    with compression_params, whose EF residuals stay per-worker).
     """
     dp, tp, sp = _axis(mesh, "dp"), _axis(mesh, "tp"), _axis(mesh, "sp")
-    use_vma = compression_params is None
+    use_vma = compression_params is None and not zero_1
     _check_compression_mesh(use_vma, tp, sp)
     pspecs = gpt_param_specs(cfg, tp)
     params = gpt_init(jax.random.PRNGKey(0), cfg)
+    state_axes, tx_kw, zero_numel = _dist_state_setup(
+        mesh, params, pspecs, dp, zero_1)
     params, opt_state, ospecs = _shard_params_state(
-        mesh, _make_tx(mesh, base_tx, compression_params, partition_bytes, dp),
-        params, pspecs, dp,
+        mesh,
+        _make_tx(mesh, base_tx, compression_params, partition_bytes, dp,
+                 **tx_kw),
+        params, pspecs, dp, state_axes=state_axes, zero_numel=zero_numel,
     )
     batch_spec = P(dp, sp)
     resym = _make_resymmetrize(pspecs, dp)
@@ -287,7 +332,7 @@ def make_gpt_train_step(
     )
 
     def build_jit(pb):
-        tx = _make_tx(mesh, base_tx, compression_params, pb, dp)
+        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, **tx_kw)
 
         def per_device_step(params, opt_state, tokens, targets):
             grad_params = _pcast_dp(params, dp, mesh, use_vma)
@@ -314,7 +359,7 @@ def make_gpt_train_step(
         return jax.jit(sharded, donate_argnums=(0, 1))
 
     return (
-        _finalize_step(build_jit, partition_bytes, dp),
+        _finalize_step(build_jit, partition_bytes, dp, tunable=not zero_1),
         params, opt_state, NamedSharding(mesh, batch_spec),
     )
 
@@ -327,6 +372,7 @@ def make_gpt_pp_train_step(
     compression_params: Optional[Dict[str, Any]] = None,
     partition_bytes: Optional[int] = None,
     remat: bool = False,
+    zero_1: bool = False,
 ):
     """Pipeline-parallel GPT train step over a (pp, dp[, tp][, sp]) mesh.
 
@@ -355,7 +401,7 @@ def make_gpt_pp_train_step(
     tp, sp = _axis(mesh, "tp"), _axis(mesh, "sp")
     if pp is None:
         raise ValueError("mesh has no pp axis — use make_gpt_train_step")
-    use_vma = compression_params is None
+    use_vma = compression_params is None and not zero_1
     _check_compression_mesh(use_vma, tp, sp)
     nstages = mesh.shape[pp]
     if cfg.n_layers % nstages != 0:
@@ -372,16 +418,13 @@ def make_gpt_pp_train_step(
         "wte": P(), "wpe": P(), "lnf_g": P(), "lnf_b": P(),
         "blocks": stacked_specs(block_specs(tp), pp),
     }
-    state_axes = _state_axes(mesh, pspecs, dp)
-    tx_kw = dict(
-        per_device_numel=_per_device_numel(params, pspecs, mesh),
-        state_leading=tuple(mesh.shape[a] for a in state_axes),
-    )
+    state_axes, tx_kw, zero_numel = _dist_state_setup(
+        mesh, params, pspecs, dp, zero_1)
     params, opt_state, ospecs = _shard_params_state(
         mesh,
         _make_tx(mesh, base_tx, compression_params, partition_bytes, dp,
                  **tx_kw),
-        params, pspecs, dp, state_axes=state_axes,
+        params, pspecs, dp, state_axes=state_axes, zero_numel=zero_numel,
     )
     batch_spec = P(dp, sp)
     loss_fn = functools.partial(
@@ -398,7 +441,7 @@ def make_gpt_pp_train_step(
         )
 
     return (
-        _finalize_step(build_jit, partition_bytes, dp),
+        _finalize_step(build_jit, partition_bytes, dp, tunable=not zero_1),
         params, opt_state, NamedSharding(mesh, batch_spec),
     )
 
@@ -410,6 +453,7 @@ def make_gpt_moe_train_step(
     compression_params: Optional[Dict[str, Any]] = None,
     partition_bytes: Optional[int] = None,
     remat: bool = False,
+    zero_1: bool = False,
 ):
     """Expert-parallel MoE GPT train step over a (dp, ep[, tp][, sp]) mesh.
 
@@ -442,7 +486,7 @@ def make_gpt_moe_train_step(
             "mesh has a pp axis — use make_gpt_moe_pp_train_step for "
             "pipelined MoE"
         )
-    use_vma = compression_params is None
+    use_vma = compression_params is None and not zero_1
     _check_compression_mesh(use_vma, tp, sp)
     ep_size = mesh.shape[ep] if ep is not None else 1
     if ep is not None and cfg.n_experts % ep_size != 0:
@@ -451,16 +495,13 @@ def make_gpt_moe_train_step(
         )
     pspecs = moe_gpt_param_specs(cfg, ep, tp)
     params = moe_gpt_init(jax.random.PRNGKey(0), cfg)
-    state_axes = _state_axes(mesh, pspecs, dp)
-    tx_kw = dict(
-        per_device_numel=_per_device_numel(params, pspecs, mesh),
-        state_leading=tuple(mesh.shape[a] for a in state_axes),
-    )
+    state_axes, tx_kw, zero_numel = _dist_state_setup(
+        mesh, params, pspecs, dp, zero_1)
     params, opt_state, ospecs = _shard_params_state(
         mesh,
         _make_tx(mesh, base_tx, compression_params, partition_bytes, dp,
                  **tx_kw),
-        params, pspecs, dp, state_axes=state_axes,
+        params, pspecs, dp, state_axes=state_axes, zero_numel=zero_numel,
     )
     batch_spec = P((dp, ep) if dp and ep else (dp or ep), sp)
     resym = _make_resymmetrize(pspecs, dp)
@@ -504,7 +545,7 @@ def make_gpt_moe_train_step(
         return jax.jit(sharded, donate_argnums=(0, 1))
 
     return (
-        _finalize_step(build_jit, partition_bytes, dp),
+        _finalize_step(build_jit, partition_bytes, dp, tunable=not zero_1),
         params, opt_state, NamedSharding(mesh, batch_spec),
     )
 
@@ -517,6 +558,7 @@ def make_gpt_moe_pp_train_step(
     compression_params: Optional[Dict[str, Any]] = None,
     partition_bytes: Optional[int] = None,
     remat: bool = False,
+    zero_1: bool = False,
 ):
     """Pipelined MoE GPT over a (pp, dp[, ep][, tp][, sp]) mesh — the full
     composition: GPipe microbatch pipelining whose stages hold MoE blocks
@@ -542,7 +584,7 @@ def make_gpt_moe_pp_train_step(
     ep, tp, sp = _axis(mesh, "ep"), _axis(mesh, "tp"), _axis(mesh, "sp")
     if pp is None:
         raise ValueError("mesh has no pp axis — use make_gpt_moe_train_step")
-    use_vma = compression_params is None
+    use_vma = compression_params is None and not zero_1
     _check_compression_mesh(use_vma, tp, sp)
     nstages = mesh.shape[pp]
     ep_size = mesh.shape[ep] if ep is not None else 1
@@ -564,16 +606,13 @@ def make_gpt_moe_pp_train_step(
         "wte": P(), "wpe": P(), "lnf_g": P(), "lnf_b": P(),
         "blocks": stacked_specs(moe_block_specs(ep, tp), pp),
     }
-    state_axes = _state_axes(mesh, pspecs, dp)
-    tx_kw = dict(
-        per_device_numel=_per_device_numel(params, pspecs, mesh),
-        state_leading=tuple(mesh.shape[a] for a in state_axes),
-    )
+    state_axes, tx_kw, zero_numel = _dist_state_setup(
+        mesh, params, pspecs, dp, zero_1)
     params, opt_state, ospecs = _shard_params_state(
         mesh,
         _make_tx(mesh, base_tx, compression_params, partition_bytes, dp,
                  **tx_kw),
-        params, pspecs, dp, state_axes=state_axes,
+        params, pspecs, dp, state_axes=state_axes, zero_numel=zero_numel,
     )
     batch_spec = P((dp, ep) if dp and ep else (dp or ep), sp)
     loss_fn = functools.partial(
@@ -592,7 +631,7 @@ def make_gpt_moe_pp_train_step(
         )
 
     return (
-        _finalize_step(build_jit, partition_bytes, dp),
+        _finalize_step(build_jit, partition_bytes, dp, tunable=not zero_1),
         params, opt_state, NamedSharding(mesh, batch_spec),
     )
 
