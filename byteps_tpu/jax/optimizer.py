@@ -268,6 +268,13 @@ def DistributedOptimizer(
     chunking does not apply (chunk boundaries would bake into the inner
     state layout, breaking the tuner's retrace-without-reinit contract).
 
+    ZeRO restriction: the inner ``tx`` must be ELEMENTWISE in the
+    gradient (sgd / momentum / adam / adamw / scale chains) — it sees
+    only this worker's 1/n segment, so cross-element transforms compute
+    from partial data (clip_by_global_norm would clip by the segment
+    norm; adafactor's factoring collapses on the flat 1-D layout). Use
+    ``zero=False`` for those.
+
     When the step composes other model-parallel axes (pp stages, ep expert
     groups) each device's gradient pytree is a *shard* of the params:
     pass ``per_device_numel`` (that shard's element count) and

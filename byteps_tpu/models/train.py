@@ -70,6 +70,11 @@ def _dist_state_setup(mesh, params, pspecs, dp, zero_1):
     """The per-factory distributed-state bookkeeping: which mesh axes give
     each device its own worker state, the per-device grads numel, and the
     kwargs both _make_tx and _shard_params_state need."""
+    if zero_1 and dp is None:
+        raise ValueError(
+            "zero_1=True requires a dp mesh axis — ZeRO-1 shards the "
+            "optimizer state over dp and there is nothing to shard over "
+            "on this mesh")
     state_axes = _state_axes(mesh, pspecs, dp)
     pd_numel = _per_device_numel(params, pspecs, mesh)
     tx_kw = dict(
@@ -104,6 +109,74 @@ def _per_device_numel(params, pspecs, mesh) -> int:
     counts = jax.tree.map(leaf_numel, params, pspecs,
                           is_leaf=lambda x: x is None)
     return sum(jax.tree.leaves(counts))
+
+
+def _accumulating_value_and_grad(loss_fn, accum_steps, weight_fn=None):
+    """Gradient accumulation: ``accum_steps`` sequential microbatches per
+    step, activations for only one microbatch live at a time (lax.scan).
+
+    Reference analog: ``backward_passes_per_step`` in the torch adapter
+    (byteps/torch DistributedOptimizer) — there, N backward passes skip
+    the push_pull on all but the Nth; here the N grad computations fuse
+    into one jitted scan and the aggregation sees their weighted mean.
+
+    ``weight_fn(*microbatch) -> scalar`` gives each microbatch's weight in
+    that mean. Losses that normalize per-call by a data-dependent count
+    (BERT's masked mean) need it: mean-of-means mis-weights microbatches
+    with unequal counts, while count-weighted averaging reproduces the
+    full-batch mean exactly. Default (None) = equal weights, exact for
+    fixed-size means (GPT's every-token loss).
+    """
+    vag = jax.value_and_grad(loss_fn)
+    if accum_steps <= 1:
+        return vag
+
+    def accum(params, *batch):
+        B = batch[0].shape[0]
+        if B % accum_steps != 0:
+            raise ValueError(
+                f"per-device batch {B} not divisible by "
+                f"accum_steps={accum_steps}")
+        mbs = tuple(
+            b.reshape((accum_steps, B // accum_steps) + b.shape[1:])
+            for b in batch
+        )
+
+        # the scan carry must be a type fixed point under check_vma=True,
+        # but per-leaf grad vma can differ from the params' (auto-psums
+        # narrow replicated leaves, conservative inference widens others)
+        # and differ per microbatch path — widen everything to the union
+        # of the params' varying axes (semantically free; resym collapses
+        # the excess after the scan)
+        pvma = set()
+        for leaf in jax.tree.leaves(params):
+            pvma |= set(getattr(jax.typeof(leaf), "vma", ()) or ())
+
+        def widen(x):
+            need = tuple(sorted(
+                pvma - set(getattr(jax.typeof(x), "vma", ()) or ())))
+            return jax.lax.pcast(x, need, to="varying") if need else x
+
+        def body(carry, mb):
+            loss_sum, grad_sum, w_sum = carry
+            loss, grads = vag(params, *mb)
+            w = (weight_fn(*mb).astype(jnp.float32) if weight_fn is not None
+                 else jnp.float32(1.0))
+            return (loss_sum + widen(loss * w),
+                    jax.tree.map(lambda a, g: a + widen(g * w),
+                                 grad_sum, grads),
+                    w_sum + widen(w)), None
+
+        zeros = jax.tree.map(lambda l: widen(jnp.zeros_like(l)), params)
+        zf = widen(jnp.zeros((), jnp.float32))
+        (loss_sum, grad_sum, w_sum), _ = jax.lax.scan(
+            body, (zf, zeros, zf), mbs
+        )
+        w_safe = jnp.where(w_sum > 0.0, w_sum, 1.0)
+        return (loss_sum / w_safe,
+                jax.tree.map(lambda g: g / w_safe, grad_sum))
+
+    return accum
 
 
 def _manual_axis_sums(grads, pspecs, axes):
@@ -294,6 +367,7 @@ def make_gpt_train_step(
     partition_bytes: Optional[int] = None,
     remat: bool = False,
     zero_1: bool = False,
+    accum_steps: int = 1,
 ):
     """Returns ``(step, params, opt_state, batch_sharding)``.
 
@@ -304,7 +378,12 @@ def make_gpt_train_step(
     long-context lever; numerics unchanged). ``zero_1=True`` shards the
     inner optimizer state over dp (ZeRO-1: psum_scatter'd grads, segment
     update, all_gathered updates — 1/n_dp the optimizer HBM; composes
-    with compression_params, whose EF residuals stay per-worker).
+    with compression_params, whose EF residuals stay per-worker;
+    requires an ELEMENTWISE base_tx — see DistributedOptimizer's
+    ZeRO note).
+    ``accum_steps>1`` accumulates gradients over that many sequential
+    microbatches before the (single) aggregation+update — the torch
+    adapter's ``backward_passes_per_step``, fused into the jitted step.
     """
     dp, tp, sp = _axis(mesh, "dp"), _axis(mesh, "tp"), _axis(mesh, "sp")
     use_vma = compression_params is None and not zero_1
@@ -334,18 +413,18 @@ def make_gpt_train_step(
     def build_jit(pb):
         tx = _make_tx(mesh, base_tx, compression_params, pb, dp, **tx_kw)
 
+        vag = _accumulating_value_and_grad(loss_fn, accum_steps)
+
         def per_device_step(params, opt_state, tokens, targets):
             grad_params = _pcast_dp(params, dp, mesh, use_vma)
-            loss, grads = jax.value_and_grad(loss_fn)(
-                grad_params, tokens, targets
-            )
+            loss, grads = vag(grad_params, tokens, targets)
             if use_vma:
                 grads = resym(grads)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             if dp is not None:
                 loss = jax.lax.pmean(loss, dp)  # report the global mean loss
-            return loss, params, opt_state
+            return _collapse_vma(loss), params, opt_state
 
         sharded = jax.shard_map(
             per_device_step,
@@ -643,17 +722,24 @@ def make_bert_train_step(
     compression_params: Optional[Dict[str, Any]] = None,
     partition_bytes: Optional[int] = None,
     remat: bool = False,
+    zero_1: bool = False,
+    accum_steps: int = 1,
 ):
     """``step(params, opt_state, tokens, targets, mask)`` — MLM pretraining
-    step (BASELINE config 3 shape), same sharding story as GPT."""
+    step (BASELINE config 3 shape), same sharding story as GPT (zero_1 /
+    accum_steps semantics included)."""
     dp, tp, sp = _axis(mesh, "dp"), _axis(mesh, "tp"), _axis(mesh, "sp")
-    use_vma = compression_params is None
+    use_vma = compression_params is None and not zero_1
     _check_compression_mesh(use_vma, tp, sp)
     pspecs = bert_param_specs(cfg, tp)
     params = bert_init(jax.random.PRNGKey(0), cfg)
+    state_axes, tx_kw, zero_numel = _dist_state_setup(
+        mesh, params, pspecs, dp, zero_1)
     params, opt_state, ospecs = _shard_params_state(
-        mesh, _make_tx(mesh, base_tx, compression_params, partition_bytes, dp),
-        params, pspecs, dp,
+        mesh,
+        _make_tx(mesh, base_tx, compression_params, partition_bytes, dp,
+                 **tx_kw),
+        params, pspecs, dp, state_axes=state_axes, zero_numel=zero_numel,
     )
     batch_spec = P(dp, sp)
     resym = _make_resymmetrize(pspecs, dp)
@@ -663,20 +749,23 @@ def make_bert_train_step(
     )
 
     def build_jit(pb):
-        tx = _make_tx(mesh, base_tx, compression_params, pb, dp)
+        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, **tx_kw)
+        # masked-mean loss: weight each microbatch by its mask count so
+        # the accumulated gradient equals the full-batch masked mean
+        vag = _accumulating_value_and_grad(
+            loss_fn, accum_steps,
+            weight_fn=lambda tokens, targets, mask: mask.sum())
 
         def per_device_step(params, opt_state, tokens, targets, mask):
             grad_params = _pcast_dp(params, dp, mesh, use_vma)
-            loss, grads = jax.value_and_grad(loss_fn)(
-                grad_params, tokens, targets, mask
-            )
+            loss, grads = vag(grad_params, tokens, targets, mask)
             if use_vma:
                 grads = resym(grads)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             if dp is not None:
                 loss = jax.lax.pmean(loss, dp)
-            return loss, params, opt_state
+            return _collapse_vma(loss), params, opt_state
 
         sharded = jax.shard_map(
             per_device_step,
@@ -688,7 +777,7 @@ def make_bert_train_step(
         return jax.jit(sharded, donate_argnums=(0, 1))
 
     return (
-        _finalize_step(build_jit, partition_bytes, dp),
+        _finalize_step(build_jit, partition_bytes, dp, tunable=not zero_1),
         params, opt_state, NamedSharding(mesh, batch_spec),
     )
 
@@ -699,6 +788,7 @@ def make_resnet_train_step(
     base_tx: optax.GradientTransformation,
     compression_params: Optional[Dict[str, Any]] = None,
     partition_bytes: Optional[int] = None,
+    zero_1: bool = False,
 ):
     """``step(params, opt_state, bn_state, images, labels) ->
     (loss, params, opt_state, bn_state)`` — dp-only conv family
@@ -706,12 +796,16 @@ def make_resnet_train_step(
     replicated bn_state stays identical everywhere.
     """
     dp = _axis(mesh, "dp")
-    use_vma = compression_params is None
+    use_vma = compression_params is None and not zero_1
     params, bn_state = resnet_init(jax.random.PRNGKey(0), cfg)
     pspecs = resnet_param_specs(cfg, params)
+    state_axes, tx_kw, zero_numel = _dist_state_setup(
+        mesh, params, pspecs, dp, zero_1)
     params, opt_state, ospecs = _shard_params_state(
-        mesh, _make_tx(mesh, base_tx, compression_params, partition_bytes, dp),
-        params, pspecs, dp,
+        mesh,
+        _make_tx(mesh, base_tx, compression_params, partition_bytes, dp,
+                 **tx_kw),
+        params, pspecs, dp, state_axes=state_axes, zero_numel=zero_numel,
     )
     sspecs = jax.tree.map(lambda _: P(), bn_state)
     bn_state = jax.device_put(
@@ -725,7 +819,7 @@ def make_resnet_train_step(
                            dp_axis=dp, train=True)
 
     def build_jit(pb):
-        tx = _make_tx(mesh, base_tx, compression_params, pb, dp)
+        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, **tx_kw)
 
         def per_device_step(params, opt_state, bn_state, images, labels):
             grad_params = _pcast_dp(params, dp, mesh, use_vma)
@@ -753,7 +847,7 @@ def make_resnet_train_step(
         return jax.jit(sharded, donate_argnums=(0, 1, 2))
 
     return (
-        _finalize_step(build_jit, partition_bytes, dp),
+        _finalize_step(build_jit, partition_bytes, dp, tunable=not zero_1),
         params, opt_state, bn_state, NamedSharding(mesh, batch_spec),
     )
 

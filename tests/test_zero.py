@@ -92,3 +92,104 @@ def test_zero1_topk_identity_matches_uncompressed_zero():
         compression_params={"compressor": "topk", "k": 1.0}),
         tokens, targets)
     np.testing.assert_allclose(comp, base, rtol=2e-4, atol=2e-4)
+
+
+def test_accum_steps_matches_full_batch():
+    """accum_steps=2 over a batch ≡ the full-batch step (mean-of-means
+    with equal microbatches; adam sees identical grads)."""
+    tokens, targets = synthetic_batch(jax.random.PRNGKey(4), CFG, 8, 32)
+    mesh = make_mesh(MeshAxes(dp=2), devices=jax.devices()[:2])
+    tx = optax.adam(1e-2)
+    base, _ = _run(make_gpt_train_step(CFG, mesh, tx), tokens, targets)
+    acc, _ = _run(make_gpt_train_step(CFG, mesh, tx, accum_steps=2),
+                  tokens, targets)
+    np.testing.assert_allclose(acc, base, rtol=2e-4, atol=2e-4)
+
+
+def test_accum_steps_with_zero_and_compression():
+    tokens, targets = synthetic_batch(jax.random.PRNGKey(5), CFG, 8, 32)
+    mesh = make_mesh(MeshAxes(dp=4), devices=jax.devices()[:4])
+    step, params, opt_state, bsh = make_gpt_train_step(
+        CFG, mesh, optax.adam(1e-2), zero_1=True, accum_steps=2,
+        compression_params={"compressor": "onebit", "ef": "vanilla"},
+    )
+    losses, _ = _run((step, params, opt_state, bsh), tokens, targets,
+                     steps=10)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_bert_zero1_matches_replicated():
+    from byteps_tpu.models import BertConfig
+    from byteps_tpu.models.train import (
+        make_bert_train_step,
+        synthetic_mlm_batch,
+    )
+
+    bcfg = BertConfig.tiny()
+    tokens, targets, mask = synthetic_mlm_batch(
+        jax.random.PRNGKey(6), bcfg, 8, 32)
+    mesh = make_mesh(MeshAxes(dp=4), devices=jax.devices()[:4])
+    tx = optax.adamw(1e-2, weight_decay=1e-2)
+
+    def run(made):
+        step, params, opt_state, bsh = made
+        tok = jax.device_put(tokens, bsh)
+        tgt = jax.device_put(targets, bsh)
+        m = jax.device_put(mask, bsh)
+        losses = []
+        for _ in range(6):
+            loss, params, opt_state = step(params, opt_state, tok, tgt, m)
+            losses.append(float(loss))
+        return losses
+
+    base = run(make_bert_train_step(bcfg, mesh, tx))
+    zero = run(make_bert_train_step(bcfg, mesh, tx, zero_1=True))
+    np.testing.assert_allclose(zero, base, rtol=2e-4, atol=2e-4)
+
+
+def test_accum_steps_on_tp_mesh_matches_full_batch():
+    """accum composes with the VMA (tp) path — carry widening + the
+    post-scan resym/collapse keep grads and loss exact."""
+    tokens, targets = synthetic_batch(jax.random.PRNGKey(7), CFG, 8, 32)
+    mesh = make_mesh(MeshAxes(dp=2, tp=2), devices=jax.devices()[:4])
+    tx = optax.adam(1e-2)
+    base, _ = _run(make_gpt_train_step(CFG, mesh, tx), tokens, targets)
+    acc, _ = _run(make_gpt_train_step(CFG, mesh, tx, accum_steps=2),
+                  tokens, targets)
+    np.testing.assert_allclose(acc, base, rtol=2e-4, atol=2e-4)
+
+
+def test_bert_accum_weighted_matches_full_batch():
+    """Masked-mean loss: microbatch mask counts differ, so the
+    accumulation must weight by count to reproduce the full-batch step."""
+    from byteps_tpu.models import BertConfig
+    from byteps_tpu.models.train import (
+        make_bert_train_step,
+        synthetic_mlm_batch,
+    )
+
+    bcfg = BertConfig.tiny()
+    tokens, targets, mask = synthetic_mlm_batch(
+        jax.random.PRNGKey(8), bcfg, 8, 32)
+    mesh = make_mesh(MeshAxes(dp=2), devices=jax.devices()[:2])
+    tx = optax.adam(1e-2)
+
+    def run(made):
+        step, params, opt_state, bsh = made
+        args = [jax.device_put(a, bsh) for a in (tokens, targets, mask)]
+        losses = []
+        for _ in range(6):
+            loss, params, opt_state = step(params, opt_state, *args)
+            losses.append(float(loss))
+        return losses
+
+    base = run(make_bert_train_step(bcfg, mesh, tx))
+    acc = run(make_bert_train_step(bcfg, mesh, tx, accum_steps=2))
+    np.testing.assert_allclose(acc, base, rtol=2e-4, atol=2e-4)
+
+
+def test_zero1_without_dp_axis_raises():
+    mesh = Mesh(np.array(jax.devices()[:2]), ("pp",))
+    with pytest.raises(ValueError, match="dp mesh axis"):
+        make_gpt_pp_train_step(CFG, mesh, optax.adam(1e-2), zero_1=True)
