@@ -10,6 +10,9 @@ the flagship for the driver's compile checks.
 from byteps_tpu.models.gpt import (GPTConfig, gpt_init, gpt_forward,
                                    gpt_loss, gpt_pp_loss)
 from byteps_tpu.models.gpt import gpt_param_specs
+from byteps_tpu.models.generate import (
+    KVCache, gpt_apply_cached, init_cache, make_generate_fn,
+)
 from byteps_tpu.models.bert import (
     BertConfig, bert_init, bert_forward, bert_mlm_loss, bert_param_specs,
 )
@@ -25,6 +28,7 @@ from byteps_tpu.models.resnet import (
 __all__ = [
     "GPTConfig", "gpt_init", "gpt_forward", "gpt_loss", "gpt_pp_loss",
     "gpt_param_specs",
+    "KVCache", "gpt_apply_cached", "init_cache", "make_generate_fn",
     "BertConfig", "bert_init", "bert_forward", "bert_mlm_loss",
     "bert_param_specs",
     "MoEGPTConfig", "moe_gpt_init", "moe_gpt_loss", "moe_gpt_param_specs",

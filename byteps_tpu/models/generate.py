@@ -1,0 +1,183 @@
+"""Autoregressive generation with a KV cache for the GPT family.
+
+The reference is a training system (its inference story is "export to the
+host framework"); a standalone framework needs the decode path too. The
+TPU-idiomatic form: a static-shape KV cache ``(n_layers, B, max_seq, H,
+D)`` updated in place with ``dynamic_update_slice`` inside a
+``lax.scan`` over positions — one traced XLA program for the whole
+generation, no per-token retrace, MXU-friendly (the decode matmuls are
+(B·H, 1, D) × (D, S) batched GEMVs that XLA tiles together).
+
+Weights are exactly the training params (`gpt.py`) — layernorms, Megatron
+col/row-parallel projections (tp composes: q/k/v/cache shard over heads,
+``row_parallel_matmul`` psums the output), weight-tied fp32 readout.
+Causality is positional masking against the cache fill level, so prefill
+and decode share one cached-attention implementation whose numerics are
+pinned to ``gpt_forward`` in ``tests/test_generate.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from byteps_tpu.models.gpt import (
+    GPTConfig,
+    _layernorm,
+    _readout,
+)
+from byteps_tpu.parallel.tp import col_parallel_matmul, row_parallel_matmul
+
+_NEG = -1e30
+
+
+class KVCache(NamedTuple):
+    """Static-shape per-layer key/value cache.
+
+    k/v: (n_layers, B, max_seq, h_loc, head_dim); ``length`` is the fill
+    level (tokens already written). Under tp, h_loc is this shard's head
+    count — the cache is a per-device value inside shard_map.
+    """
+    k: jnp.ndarray
+    v: jnp.ndarray
+    length: jnp.ndarray        # () int32
+
+
+def init_cache(cfg: GPTConfig, batch: int, h_loc: Optional[int] = None,
+               max_seq: Optional[int] = None) -> KVCache:
+    h = h_loc if h_loc is not None else cfg.n_heads
+    S = max_seq if max_seq is not None else cfg.max_seq
+    shape = (cfg.n_layers, batch, S, h, cfg.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, cfg.dtype),
+        v=jnp.zeros(shape, cfg.dtype),
+        length=jnp.zeros((), jnp.int32),
+    )
+
+
+def _cached_attention(q, k_cache, v_cache, q_pos0, n_new):
+    """q: (B, T, H, D) new queries at positions q_pos0..q_pos0+T-1;
+    k/v_cache: (B, S_max, H, D) with the new keys already written.
+    Causal-masks against global positions, so entries past the fill level
+    (zeros) are masked out by construction."""
+    B, T, H, D = q.shape
+    S = k_cache.shape[1]
+    scale = 1.0 / (D ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k_cache.astype(jnp.float32)) * scale
+    rows = q_pos0 + jnp.arange(T)[:, None]          # global query positions
+    cols = jnp.arange(S)[None, :]
+    s = jnp.where((rows >= cols)[None, None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v_cache.astype(jnp.float32))
+    return o.astype(q.dtype)
+
+
+def _block_step(x, p, cache_k, cache_v, pos0, head_dim, tp_axis):
+    """One transformer block over T new tokens with cache append.
+
+    x: (B, T, d); cache_k/v: (B, S_max, h_loc, D) this layer's cache.
+    Returns (x_out, new_cache_k, new_cache_v).
+    """
+    B, T = x.shape[:2]
+    h = _layernorm(x, p["ln1_g"], p["ln1_b"])
+    q = col_parallel_matmul(h, p["wq"].astype(x.dtype), p["bq"].astype(x.dtype))
+    k = col_parallel_matmul(h, p["wk"].astype(x.dtype), p["bk"].astype(x.dtype))
+    v = col_parallel_matmul(h, p["wv"].astype(x.dtype), p["bv"].astype(x.dtype))
+    h_loc = q.shape[-1] // head_dim
+    q = q.reshape(B, T, h_loc, head_dim)
+    k = k.reshape(B, T, h_loc, head_dim)
+    v = v.reshape(B, T, h_loc, head_dim)
+    cache_k = jax.lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype),
+                                           (0, pos0, 0, 0))
+    cache_v = jax.lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype),
+                                           (0, pos0, 0, 0))
+    o = _cached_attention(q, cache_k, cache_v, pos0, T)
+    o = o.reshape(B, T, h_loc * head_dim)
+    x = x + row_parallel_matmul(o, p["wo"].astype(x.dtype), tp_axis,
+                                p["bo"].astype(x.dtype))
+    h = _layernorm(x, p["ln2_g"], p["ln2_b"])
+    ff = col_parallel_matmul(h, p["w1"].astype(x.dtype), p["b1"].astype(x.dtype))
+    ff = jax.nn.gelu(ff)
+    x = x + row_parallel_matmul(ff, p["w2"].astype(x.dtype), tp_axis,
+                                p["b2"].astype(x.dtype))
+    return x, cache_k, cache_v
+
+
+def gpt_apply_cached(params, tokens: jnp.ndarray, cache: KVCache,
+                     cfg: GPTConfig, tp_axis: Optional[str] = None
+                     ) -> Tuple[jnp.ndarray, KVCache]:
+    """Run T new tokens through the model, appending to the cache.
+
+    tokens: (B, T) continuing at position ``cache.length``. Returns
+    (logits (B, T, vocab) f32, updated cache). T=prompt length is the
+    prefill; T=1 is one decode step — same code, pinned to
+    ``gpt_forward`` numerics either way.
+    """
+    B, T = tokens.shape
+    pos0 = cache.length
+    pos = pos0 + jnp.arange(T)
+    x = (params["wte"][tokens]
+         + jnp.take(params["wpe"], pos, axis=0)).astype(cfg.dtype)
+
+    new_k, new_v = [], []
+    for li, p in enumerate(params["blocks"]):
+        x, ck, cv = _block_step(
+            x, p, cache.k[li], cache.v[li], pos0, cfg.head_dim, tp_axis)
+        new_k.append(ck)
+        new_v.append(cv)
+    logits = _readout(params, x)
+    return logits, KVCache(
+        k=jnp.stack(new_k), v=jnp.stack(new_v), length=pos0 + T
+    )
+
+
+def make_generate_fn(cfg: GPTConfig, max_new: int,
+                     tp_axis: Optional[str] = None):
+    """Build a jitted sampler: ``gen(params, prompt, rng, temperature)``.
+
+    prompt: (B, T0) int32; returns (B, T0 + max_new) tokens. Greedy when
+    ``temperature == 0`` (exact argmax — the equivalence-vs-gpt_forward
+    test drives this), categorical sampling otherwise. One XLA program:
+    cached prefill + ``lax.scan`` over max_new decode steps.
+    """
+
+    @functools.partial(jax.jit, static_argnames=())
+    def gen(params, prompt, rng, temperature=0.0):
+        B, T0 = prompt.shape
+        if T0 + max_new > cfg.max_seq:
+            # static shapes: past max_seq the cache write offset would
+            # clamp (overwriting the last slot) and wpe positions clip —
+            # fail at trace time instead of generating garbage
+            raise ValueError(
+                f"prompt ({T0}) + max_new ({max_new}) exceeds "
+                f"cfg.max_seq ({cfg.max_seq})")
+        # under tp (inside shard_map) the projections are head-sharded —
+        # size the cache from this device's wq shard
+        h_loc = params["blocks"][0]["wq"].shape[-1] // cfg.head_dim
+        cache = init_cache(cfg, B, h_loc=h_loc)
+        logits, cache = gpt_apply_cached(params, prompt, cache, cfg, tp_axis)
+        last = logits[:, -1]
+
+        def pick(logits_t, key):
+            greedy = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
+            temp = jnp.maximum(temperature, 1e-6)
+            sampled = jax.random.categorical(key, logits_t / temp, axis=-1)
+            return jnp.where(temperature > 0.0, sampled.astype(jnp.int32),
+                             greedy)
+
+        def step(carry, key):
+            cache, last_logits = carry
+            tok = pick(last_logits, key)                      # (B,)
+            logits, cache = gpt_apply_cached(
+                params, tok[:, None], cache, cfg, tp_axis)
+            return (cache, logits[:, 0]), tok
+
+        keys = jax.random.split(rng, max_new)
+        (_, _), toks = jax.lax.scan(step, (cache, last), keys)
+        return jnp.concatenate([prompt, toks.T], axis=1)
+
+    return gen

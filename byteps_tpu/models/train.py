@@ -751,10 +751,16 @@ def make_bert_train_step(
     def build_jit(pb):
         tx = _make_tx(mesh, base_tx, compression_params, pb, dp, **tx_kw)
         # masked-mean loss: weight each microbatch by its mask count so
-        # the accumulated gradient equals the full-batch masked mean
-        vag = _accumulating_value_and_grad(
-            loss_fn, accum_steps,
-            weight_fn=lambda tokens, targets, mask: mask.sum())
+        # the accumulated gradient equals the full-batch masked mean; the
+        # count must be the sp-GLOBAL one (the loss normalizes by it after
+        # its sp psum) or the weights would be sp-varying while the grads
+        # are sp-replicated
+        def _mask_count(tokens, targets, mask):
+            w = mask.sum()
+            return jax.lax.psum(w, sp) if sp is not None else w
+
+        vag = _accumulating_value_and_grad(loss_fn, accum_steps,
+                                           weight_fn=_mask_count)
 
         def per_device_step(params, opt_state, tokens, targets, mask):
             grad_params = _pcast_dp(params, dp, mesh, use_vma)

@@ -193,3 +193,57 @@ def test_zero1_without_dp_axis_raises():
     mesh = Mesh(np.array(jax.devices()[:2]), ("pp",))
     with pytest.raises(ValueError, match="dp mesh axis"):
         make_gpt_pp_train_step(CFG, mesh, optax.adam(1e-2), zero_1=True)
+
+
+def test_resnet_zero1_matches_replicated():
+    from byteps_tpu.models import ResNetConfig
+    from byteps_tpu.models.train import make_resnet_train_step
+
+    rcfg = ResNetConfig.tiny()
+    mesh = make_mesh(MeshAxes(dp=4), devices=jax.devices()[:4])
+    tx = optax.adamw(1e-2, weight_decay=1e-2)
+    imgs = jax.random.normal(jax.random.PRNGKey(9), (8, 16, 16, 3))
+    labels = jax.random.randint(jax.random.PRNGKey(10), (8,), 0,
+                                rcfg.num_classes)
+
+    def run(made):
+        step, params, opt_state, bn, bsh = made
+        im = jax.device_put(imgs, bsh)
+        lb = jax.device_put(labels, bsh)
+        losses = []
+        for _ in range(6):
+            loss, params, opt_state, bn = step(params, opt_state, bn, im, lb)
+            losses.append(float(loss))
+        return losses
+
+    base = run(make_resnet_train_step(rcfg, mesh, tx))
+    zero = run(make_resnet_train_step(rcfg, mesh, tx, zero_1=True))
+    np.testing.assert_allclose(zero, base, rtol=2e-4, atol=2e-4)
+
+
+def test_bert_accum_on_sp_mesh_matches_full_batch():
+    """sp-sharded masks: accumulation weights must be the sp-global count."""
+    from byteps_tpu.models import BertConfig
+    from byteps_tpu.models.train import (
+        make_bert_train_step,
+        synthetic_mlm_batch,
+    )
+
+    bcfg = BertConfig.tiny()
+    tokens, targets, mask = synthetic_mlm_batch(
+        jax.random.PRNGKey(11), bcfg, 8, 32)
+    mesh = make_mesh(MeshAxes(dp=2, sp=2), devices=jax.devices()[:4])
+    tx = optax.adam(1e-2)
+
+    def run(made):
+        step, params, opt_state, bsh = made
+        args = [jax.device_put(a, bsh) for a in (tokens, targets, mask)]
+        losses = []
+        for _ in range(6):
+            loss, params, opt_state = step(params, opt_state, *args)
+            losses.append(float(loss))
+        return losses
+
+    base = run(make_bert_train_step(bcfg, mesh, tx))
+    acc = run(make_bert_train_step(bcfg, mesh, tx, accum_steps=2))
+    np.testing.assert_allclose(acc, base, rtol=2e-4, atol=2e-4)
