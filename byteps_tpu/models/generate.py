@@ -76,8 +76,8 @@ def _cached_attention(q, k_cache, v_cache, q_pos0, n_new):
     return o.astype(q.dtype)
 
 
-def _block_step(x, p, cache_k, cache_v, pos0, head_dim, tp_axis):
-    """One transformer block over T new tokens with cache append.
+def _attn_cached_half(x, p, cache_k, cache_v, pos0, head_dim, tp_axis):
+    """The attention residual branch over T new tokens with cache append.
 
     x: (B, T, d); cache_k/v: (B, S_max, h_loc, D) this layer's cache.
     Returns (x_out, new_cache_k, new_cache_v).
@@ -99,23 +99,46 @@ def _block_step(x, p, cache_k, cache_v, pos0, head_dim, tp_axis):
     o = o.reshape(B, T, h_loc * head_dim)
     x = x + row_parallel_matmul(o, p["wo"].astype(x.dtype), tp_axis,
                                 p["bo"].astype(x.dtype))
+    return x, cache_k, cache_v
+
+
+def _block_step(x, p, cache_k, cache_v, pos0, cfg, tp_axis, ep_axis):
+    """One transformer block (dense-MLP or MoE, by param structure) over
+    T new tokens with cache append."""
+    x, cache_k, cache_v = _attn_cached_half(
+        x, p, cache_k, cache_v, pos0, cfg.head_dim, tp_axis)
     h = _layernorm(x, p["ln2_g"], p["ln2_b"])
-    ff = col_parallel_matmul(h, p["w1"].astype(x.dtype), p["b1"].astype(x.dtype))
-    ff = jax.nn.gelu(ff)
-    x = x + row_parallel_matmul(ff, p["w2"].astype(x.dtype), tp_axis,
-                                p["b2"].astype(x.dtype))
+    if "moe" in p:
+        from byteps_tpu.parallel.moe import moe_ffn
+
+        # inference uses no-drop capacity: the training capacity_factor
+        # is a throughput/static-shape lever, and a dropped token at
+        # decode time silently corrupts the sample
+        m, _aux = moe_ffn(
+            h, p["moe"], ep_axis=ep_axis, router_topk=cfg.router_topk,
+            tp_axis=tp_axis, no_drop=True)
+        x = x + m
+    else:
+        ff = col_parallel_matmul(h, p["w1"].astype(x.dtype),
+                                 p["b1"].astype(x.dtype))
+        ff = jax.nn.gelu(ff)
+        x = x + row_parallel_matmul(ff, p["w2"].astype(x.dtype), tp_axis,
+                                    p["b2"].astype(x.dtype))
     return x, cache_k, cache_v
 
 
 def gpt_apply_cached(params, tokens: jnp.ndarray, cache: KVCache,
-                     cfg: GPTConfig, tp_axis: Optional[str] = None
+                     cfg: GPTConfig, tp_axis: Optional[str] = None,
+                     ep_axis: Optional[str] = None
                      ) -> Tuple[jnp.ndarray, KVCache]:
     """Run T new tokens through the model, appending to the cache.
 
     tokens: (B, T) continuing at position ``cache.length``. Returns
     (logits (B, T, vocab) f32, updated cache). T=prompt length is the
     prefill; T=1 is one decode step — same code, pinned to
-    ``gpt_forward`` numerics either way.
+    ``gpt_forward`` numerics either way. Serves both the dense and the
+    MoE GPT families (block type detected from the params; ``ep_axis``
+    shards the experts inside shard_map).
     """
     B, T = tokens.shape
     pos0 = cache.length
@@ -126,7 +149,7 @@ def gpt_apply_cached(params, tokens: jnp.ndarray, cache: KVCache,
     new_k, new_v = [], []
     for li, p in enumerate(params["blocks"]):
         x, ck, cv = _block_step(
-            x, p, cache.k[li], cache.v[li], pos0, cfg.head_dim, tp_axis)
+            x, p, cache.k[li], cache.v[li], pos0, cfg, tp_axis, ep_axis)
         new_k.append(ck)
         new_v.append(cv)
     logits = _readout(params, x)
@@ -136,7 +159,8 @@ def gpt_apply_cached(params, tokens: jnp.ndarray, cache: KVCache,
 
 
 def make_generate_fn(cfg: GPTConfig, max_new: int,
-                     tp_axis: Optional[str] = None):
+                     tp_axis: Optional[str] = None,
+                     ep_axis: Optional[str] = None):
     """Build a jitted sampler: ``gen(params, prompt, rng, temperature)``.
 
     prompt: (B, T0) int32; returns (B, T0 + max_new) tokens. Greedy when
@@ -159,7 +183,8 @@ def make_generate_fn(cfg: GPTConfig, max_new: int,
         # size the cache from this device's wq shard
         h_loc = params["blocks"][0]["wq"].shape[-1] // cfg.head_dim
         cache = init_cache(cfg, B, h_loc=h_loc)
-        logits, cache = gpt_apply_cached(params, prompt, cache, cfg, tp_axis)
+        logits, cache = gpt_apply_cached(params, prompt, cache, cfg, tp_axis,
+                                         ep_axis)
         last = logits[:, -1]
 
         def pick(logits_t, key):
@@ -173,7 +198,7 @@ def make_generate_fn(cfg: GPTConfig, max_new: int,
             cache, last_logits = carry
             tok = pick(last_logits, key)                      # (B,)
             logits, cache = gpt_apply_cached(
-                params, tok[:, None], cache, cfg, tp_axis)
+                params, tok[:, None], cache, cfg, tp_axis, ep_axis)
             return (cache, logits[:, 0]), tok
 
         keys = jax.random.split(rng, max_new)

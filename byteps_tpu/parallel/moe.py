@@ -92,6 +92,7 @@ def moe_ffn(
     activation=jax.nn.gelu,
     router_topk: int = 1,
     tp_axis: Optional[str] = None,
+    no_drop: bool = False,
 ):
     """MoE feed-forward over the trailing feature dim of ``x (..., d)``.
 
@@ -105,7 +106,9 @@ def moe_ffn(
 
     Returns ``(y, aux_loss)`` with ``y`` shaped like ``x``. Dropped
     (over-capacity) tokens produce zero — add the residual outside, as the
-    transformer block does.
+    transformer block does. ``no_drop=True`` sets capacity so NO token can
+    be dropped (``topk · T`` slots per expert, the worst-case load) —
+    decode-time routing, where a drop silently corrupts the sample.
     """
     ep = jax.lax.axis_size(ep_axis) if ep_axis is not None else 1
     e_loc = params["w1"].shape[0]
@@ -120,7 +123,8 @@ def moe_ffn(
     # matmuls and the all_to_all payload run in x.dtype like the dense
     # family's _mlp — bf16 configs keep full MXU rate and half ICI bytes
     gate_logits = xt.astype(jnp.float32) @ params["wg"].astype(jnp.float32)
-    cap = max(1, int(capacity_factor * router_topk * T / E))
+    cap = (router_topk * T if no_drop
+           else max(1, int(capacity_factor * router_topk * T / E)))
     dispatch, combine, aux = topk_dispatch(gate_logits, cap, k=router_topk)
     slots = jnp.einsum(
         "tec,td->ecd", dispatch.astype(x.dtype), xt
