@@ -22,7 +22,11 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from byteps_tpu.parallel.remat import maybe_remat
-from byteps_tpu.parallel.ring_attention import ring_attention
+from byteps_tpu.parallel.ring_attention import (
+    ring_attention,
+    zigzag_local_positions,
+    zigzag_ring_attention,
+)
 from byteps_tpu.parallel.tp import col_parallel_matmul, row_parallel_matmul
 
 
@@ -95,7 +99,8 @@ def _layernorm(x: jnp.ndarray, g: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return ((xf - mu) * jax.lax.rsqrt(var + 1e-5) * g + b).astype(x.dtype)
 
 
-def _attention(x, p, head_dim: int, tp_axis, sp_axis, causal: bool = True):
+def _attention(x, p, head_dim: int, tp_axis, sp_axis, causal: bool = True,
+               seq_layout: str = "contiguous"):
     B, S = x.shape[:2]
     q = col_parallel_matmul(x, p["wq"].astype(x.dtype), p["bq"].astype(x.dtype))
     k = col_parallel_matmul(x, p["wk"].astype(x.dtype), p["bk"].astype(x.dtype))
@@ -104,7 +109,13 @@ def _attention(x, p, head_dim: int, tp_axis, sp_axis, causal: bool = True):
     q = q.reshape(B, S, h_loc, head_dim)
     k = k.reshape(B, S, h_loc, head_dim)
     v = v.reshape(B, S, h_loc, head_dim)
-    o = ring_attention(q, k, v, sp_axis, causal=causal)
+    if seq_layout == "zigzag":
+        o = zigzag_ring_attention(q, k, v, sp_axis, causal=causal)
+    elif seq_layout == "contiguous":
+        o = ring_attention(q, k, v, sp_axis, causal=causal)
+    else:
+        raise ValueError(f"unknown seq_layout {seq_layout!r} — expected "
+                         "'contiguous' or 'zigzag'")
     o = o.reshape(B, S, h_loc * head_dim)
     return row_parallel_matmul(o, p["wo"].astype(x.dtype), tp_axis,
                                p["bo"].astype(x.dtype))
@@ -118,11 +129,13 @@ def _mlp(x, p, tp_axis):
 
 
 def transformer_block(x, p, head_dim: int, tp_axis=None, sp_axis=None,
-                      causal: bool = True):
+                      causal: bool = True, seq_layout: str = "contiguous"):
     """Pre-LN block shared by the GPT (causal) and BERT (bidirectional)
-    families: attention + MLP, tp col/row-parallel, optional sp ring."""
+    families: attention + MLP, tp col/row-parallel, optional sp ring
+    (contiguous or zigzag sequence layout)."""
     x = x + _attention(_layernorm(x, p["ln1_g"], p["ln1_b"]), p, head_dim,
-                       tp_axis, sp_axis, causal=causal)
+                       tp_axis, sp_axis, causal=causal,
+                       seq_layout=seq_layout)
     return x + _mlp(_layernorm(x, p["ln2_g"], p["ln2_b"]), p, tp_axis)
 
 
@@ -166,14 +179,20 @@ def block_specs(tp_axis):
 
 
 def _embed(params, tokens: jnp.ndarray, cfg: GPTConfig,
-           sp_axis) -> jnp.ndarray:
+           sp_axis, seq_layout: str = "contiguous") -> jnp.ndarray:
     """Token + position embeddings with the sequence-shard offset, shared
-    by the dense and pipelined paths."""
+    by the dense and pipelined paths. Under the zigzag layout the local
+    tokens are this device's (early, late) chunk pair and the positions
+    follow (`zigzag_local_positions`)."""
     S_loc = tokens.shape[1]
-    off = (jax.lax.axis_index(sp_axis) * S_loc if sp_axis is not None
-           else 0)
-    pos = off + jnp.arange(S_loc)
-    return (params["wte"][tokens] + params["wpe"][pos]).astype(cfg.dtype)
+    if seq_layout == "zigzag" and sp_axis is not None:
+        pos = zigzag_local_positions(S_loc, sp_axis)
+    else:
+        off = (jax.lax.axis_index(sp_axis) * S_loc if sp_axis is not None
+               else 0)
+        pos = off + jnp.arange(S_loc)
+    return (params["wte"][tokens]
+            + jnp.take(params["wpe"], pos, axis=0)).astype(cfg.dtype)
 
 
 def _readout(params, h: jnp.ndarray) -> jnp.ndarray:
@@ -195,7 +214,8 @@ def _readout_nll(params, h: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
 def gpt_forward(params, tokens: jnp.ndarray, cfg: GPTConfig,
                 tp_axis: Optional[str] = None,
                 sp_axis: Optional[str] = None,
-                remat: bool = False) -> jnp.ndarray:
+                remat: bool = False,
+                seq_layout: str = "contiguous") -> jnp.ndarray:
     """Per-device forward: tokens (B_local, S_local) → logits (f32).
 
     Single chip: all axes None, tokens are the whole batch/sequence.
@@ -203,11 +223,11 @@ def gpt_forward(params, tokens: jnp.ndarray, cfg: GPTConfig,
     weights its tp shard; output logits stay tp/dp/sp-local (replicated
     over tp by construction).
     """
-    x = _embed(params, tokens, cfg, sp_axis)
+    x = _embed(params, tokens, cfg, sp_axis, seq_layout)
 
     def apply_block(x, p):
         return transformer_block(x, p, cfg.head_dim, tp_axis, sp_axis,
-                                 causal=True)
+                                 causal=True, seq_layout=seq_layout)
 
     # rematerialize per block: activations recomputed in backward — HBM
     # for FLOPs, the long-context lever (see maybe_remat for the tp/sp
@@ -273,7 +293,8 @@ def gpt_loss(params, tokens, targets, cfg: GPTConfig,
              dp_axis: Optional[str] = None,
              tp_axis: Optional[str] = None,
              sp_axis: Optional[str] = None,
-             remat: bool = False) -> jnp.ndarray:
+             remat: bool = False,
+             seq_layout: str = "contiguous") -> jnp.ndarray:
     """Mean next-token cross-entropy, identical (replicated) on every device.
 
     The replication is what makes per-device ``jax.grad`` correct under
@@ -282,7 +303,7 @@ def gpt_loss(params, tokens, targets, cfg: GPTConfig,
     aggregation `DistributedOptimizer` / `sync_grads` provide.
     """
     logits = gpt_forward(params, tokens, cfg, tp_axis, sp_axis,
-                         remat=remat)
+                         remat=remat, seq_layout=seq_layout)
     loss = _nll(logits, targets).mean()
     axes = tuple(a for a in (dp_axis, sp_axis) if a is not None)
     if axes:

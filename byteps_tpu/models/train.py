@@ -368,6 +368,7 @@ def make_gpt_train_step(
     remat: bool = False,
     zero_1: bool = False,
     accum_steps: int = 1,
+    seq_layout: str = "contiguous",
 ):
     """Returns ``(step, params, opt_state, batch_sharding)``.
 
@@ -384,8 +385,15 @@ def make_gpt_train_step(
     ``accum_steps>1`` accumulates gradients over that many sequential
     microbatches before the (single) aggregation+update — the torch
     adapter's ``backward_passes_per_step``, fused into the jitted step.
+    ``seq_layout="zigzag"`` runs the load-balanced causal ring over sp
+    (feed tokens/targets pre-permuted with ``zigzag_permutation``;
+    positions and attention follow the layout — ~2x sp utilization for
+    causal attention at scale).
     """
     dp, tp, sp = _axis(mesh, "dp"), _axis(mesh, "tp"), _axis(mesh, "sp")
+    if seq_layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown seq_layout {seq_layout!r} — expected "
+                         "'contiguous' or 'zigzag'")
     use_vma = compression_params is None and not zero_1
     _check_compression_mesh(use_vma, tp, sp)
     pspecs = gpt_param_specs(cfg, tp)
@@ -407,7 +415,7 @@ def make_gpt_train_step(
     # pmean inside the loss would double-apply the 1/n_dp.
     loss_fn = functools.partial(
         gpt_loss, cfg=cfg, dp_axis=None, tp_axis=tp, sp_axis=sp,
-        remat=remat,
+        remat=remat, seq_layout=seq_layout,
     )
 
     def build_jit(pb):

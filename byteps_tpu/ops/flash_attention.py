@@ -482,6 +482,48 @@ def flash_attention_lse(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return o, lse
 
 
+def attention_lse_jnp(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      q_offset, k_offset, causal: bool = True
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """jnp twin of :func:`flash_attention_lse` — same (o, lse) contract,
+    same global-offset causal masking and −1e30 ≡ no-live-keys signal, any
+    shape. The golden for the kernel and the fallback for ring schedules
+    off-TPU."""
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    scale = 1.0 / (D ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        rows = q_offset + jnp.arange(Sq)[:, None]
+        cols = k_offset + jnp.arange(Sk)[None, :]
+        s = jnp.where((rows >= cols)[None, None], s, _NEG)
+    m = s.max(axis=-1)                                   # (B, H, Sq)
+    live = m > _NEG / 2
+    m_safe = jnp.where(live, m, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    if causal:
+        p = jnp.where(s > _NEG / 2, p, 0.0)
+    l = p.sum(axis=-1)
+    l_safe = jnp.where(l > 0.0, l, 1.0)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p / l_safe[..., None],
+                   v.astype(jnp.float32))
+    o = jnp.where(live.transpose(0, 2, 1)[..., None], o, 0.0)
+    lse = jnp.where(live, m_safe + jnp.log(l_safe), _NEG)
+    return o.astype(q.dtype), lse.transpose(0, 2, 1)     # (B, Sq, H)
+
+
+def attention_lse(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                  q_offset, k_offset, causal: bool = True
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Backend-dispatching (o, lse) attention with global offsets — the
+    building block ring schedules merge with :func:`merge_attention`."""
+    if use_pallas() and supported(q.shape[1], k.shape[1], q.shape[-1]):
+        return flash_attention_lse(q, k, v, q_offset, k_offset,
+                                   causal=causal)
+    return attention_lse_jnp(q, k, v, q_offset, k_offset, causal=causal)
+
+
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True) -> jnp.ndarray:
     """Softmax attention, (B, S, H, D) layout, flash kernel when possible.

@@ -20,7 +20,14 @@ from byteps_tpu.parallel.pipeline import (
     stack_blocks,
     stacked_specs,
 )
-from byteps_tpu.parallel.ring_attention import ring_attention, plain_attention
+from byteps_tpu.parallel.ring_attention import (
+    plain_attention,
+    ring_attention,
+    zigzag_inverse,
+    zigzag_local_positions,
+    zigzag_permutation,
+    zigzag_ring_attention,
+)
 from byteps_tpu.parallel.tp import (
     col_parallel_matmul,
     row_parallel_matmul,
@@ -42,6 +49,8 @@ __all__ = [
     "last_stage_value",
     "ring_attention",
     "plain_attention",
+    "zigzag_ring_attention", "zigzag_permutation", "zigzag_inverse",
+    "zigzag_local_positions",
     "col_parallel_matmul",
     "row_parallel_matmul",
     "maybe_psum",

@@ -107,8 +107,9 @@ def moe_ffn(
     Returns ``(y, aux_loss)`` with ``y`` shaped like ``x``. Dropped
     (over-capacity) tokens produce zero — add the residual outside, as the
     transformer block does. ``no_drop=True`` sets capacity so NO token can
-    be dropped (``topk · T`` slots per expert, the worst-case load) —
-    decode-time routing, where a drop silently corrupts the sample.
+    be dropped (``T`` slots per expert — the worst-case load, since a
+    token's k choices are distinct experts) — decode-time routing, where
+    a drop silently corrupts the sample.
     """
     ep = jax.lax.axis_size(ep_axis) if ep_axis is not None else 1
     e_loc = params["w1"].shape[0]
@@ -123,7 +124,9 @@ def moe_ffn(
     # matmuls and the all_to_all payload run in x.dtype like the dense
     # family's _mlp — bf16 configs keep full MXU rate and half ICI bytes
     gate_logits = xt.astype(jnp.float32) @ params["wg"].astype(jnp.float32)
-    cap = (router_topk * T if no_drop
+    # no-drop worst case is T (a token's k choices are DISTINCT experts,
+    # so any one expert receives at most T assignments)
+    cap = (T if no_drop
            else max(1, int(capacity_factor * router_topk * T / E)))
     dispatch, combine, aux = topk_dispatch(gate_logits, cap, k=router_topk)
     slots = jnp.einsum(

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from byteps_tpu.ops.flash_attention import (
+    attention_lse as _attention_lse,
     flash_attention as _flash_attention,
     flash_attention_lse as _flash_attention_lse,
     merge_attention as _merge_attention,
@@ -113,6 +114,107 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             k_blk = jax.lax.ppermute(k_blk, sp_axis, perm)
             v_blk = jax.lax.ppermute(v_blk, sp_axis, perm)
     out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def zigzag_permutation(S: int, n: int) -> jnp.ndarray:
+    """Global index map for the zigzag sequence layout.
+
+    The sequence splits into 2n chunks; device d owns chunks
+    ``(d, 2n−1−d)`` — pairing an early chunk with a late one so every
+    device carries the same causal-attention load (the contiguous layout
+    gives device d work ∝ d+1; zigzag is the standard rebalancing).
+    ``perm[i]`` = the global position stored at layout slot i; shard the
+    permuted array ``P('sp')`` and slot order lines up with the ring's
+    per-device (chunk_d, chunk_{2n−1−d}) convention.
+    """
+    if S % (2 * n) != 0:
+        raise ValueError(f"zigzag needs S ({S}) divisible by 2·sp ({2 * n})")
+    c = S // (2 * n)
+    chunks = []
+    for d in range(n):
+        chunks.append(jnp.arange(d * c, (d + 1) * c))
+        e = 2 * n - 1 - d
+        chunks.append(jnp.arange(e * c, (e + 1) * c))
+    return jnp.concatenate(chunks)
+
+
+def zigzag_inverse(S: int, n: int) -> jnp.ndarray:
+    """Inverse map: ``x_layout[zigzag_inverse(S, n)] == x_original``."""
+    perm = zigzag_permutation(S, n)
+    inv = jnp.zeros((S,), jnp.int32)
+    return inv.at[perm].set(jnp.arange(S, dtype=jnp.int32))
+
+
+def zigzag_local_positions(S_loc: int, sp_axis: str) -> jnp.ndarray:
+    """This device's global positions under the zigzag layout (S_loc
+    local tokens = two chunks of S_loc/2). Call inside shard_map —
+    feeds position embeddings and loss masking."""
+    n = jax.lax.axis_size(sp_axis)
+    idx = jax.lax.axis_index(sp_axis)
+    c = S_loc // 2
+    a = idx * c + jnp.arange(c)
+    b = (2 * n - 1 - idx) * c + jnp.arange(c)
+    return jnp.concatenate([a, b])
+
+
+def zigzag_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                          sp_axis: Optional[str],
+                          causal: bool = True) -> jnp.ndarray:
+    """Load-balanced causal ring: inputs/outputs in the zigzag layout.
+
+    Each device's S_loc tokens are its (chunk_d, chunk_{2n−1−d}) pair
+    (see :func:`zigzag_permutation`); K/V pairs rotate around the ring
+    and every (q-half, k-half) combination runs flash attention with its
+    own global offsets, merged by logsumexp. Per ring step each device's
+    live work is ~equal (one early + one late chunk), vs the contiguous
+    ring where device d computes on only d+1 of n steps — ~2× utilization
+    for causal attention at large n. Differentiable end-to-end (ppermute
+    transpose + the flash/jnp lse VJPs).
+    """
+    if sp_axis is None:
+        return plain_attention(q, k, v, causal=causal)
+    n = jax.lax.axis_size(sp_axis)
+    if n == 1:
+        return plain_attention(q, k, v, causal=causal)
+    B, S_loc, H, D = q.shape
+    if S_loc % 2 != 0:
+        raise ValueError(f"zigzag layout needs even local length; got "
+                         f"{S_loc}")
+    c = S_loc // 2
+    idx = jax.lax.axis_index(sp_axis)
+    my_offs = (idx * c, (2 * n - 1 - idx) * c)
+    q_halves = (q[:, :c], q[:, c:])
+
+    state = [
+        (jnp.zeros((B, c, H, D), jnp.float32),
+         jnp.full((B, c, H), _NEG, jnp.float32))
+        for _ in range(2)
+    ]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    k_blk, v_blk = k, v
+    for step in range(n):
+        src = (idx - step) % n
+        k_offs = (src * c, (2 * n - 1 - src) * c)
+        for ki in range(2):
+            kh = k_blk[:, ki * c:(ki + 1) * c]
+            vh = v_blk[:, ki * c:(ki + 1) * c]
+            for qi in range(2):
+                if causal and qi == 0 and ki == 1:
+                    # early q chunk (id < n) vs late k chunk (id >= n):
+                    # fully masked at EVERY step — skip the dead quarter
+                    continue
+                # late q (id >= n) vs early k (id < n) is fully live at
+                # every step — run it unmasked (no per-tile mask math)
+                combo_causal = causal and not (qi == 1 and ki == 0)
+                o_s, lse_s = _attention_lse(
+                    q_halves[qi], kh, vh, my_offs[qi], k_offs[ki],
+                    causal=combo_causal)
+                state[qi] = _merge_attention(*state[qi], o_s, lse_s)
+        if step + 1 < n:
+            k_blk = jax.lax.ppermute(k_blk, sp_axis, perm)
+            v_blk = jax.lax.ppermute(v_blk, sp_axis, perm)
+    out = jnp.concatenate([state[0][0], state[1][0]], axis=1)
     return out.astype(q.dtype)
 
 
