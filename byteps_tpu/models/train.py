@@ -10,10 +10,11 @@ VMA notes (apply to every factory): per-device AD is exact under
 ``check_vma=True`` — replicated params' cotangents get their sp/tp psums
 auto-inserted, and marking params dp-varying (``pcast``) keeps grads
 per-replica LOCAL so dp aggregation stays in DistributedOptimizer. The
-compressed collective defeats the VMA analysis (comm/ici.py), so
-compression runs with ``check_vma=False`` and is restricted to dp-only
-meshes, where the forward has no collectives and per-device AD is
-trivially exact.
+compressed collective (comm/ici.py) and the ZeRO-1 all_gather defeat the
+VMA replication analysis, so those modes run ``check_vma=False``: tp/sp
+axes are excluded (their in-forward collectives need VMA typing), while
+pp and ep compose — each leaf's stage-partial grads are psum'd
+explicitly over the axes its spec doesn't shard (``_manual_axis_sums``).
 """
 
 from __future__ import annotations
