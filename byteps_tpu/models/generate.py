@@ -62,18 +62,13 @@ def _cached_attention(q, k_cache, v_cache, q_pos0, n_new):
     """q: (B, T, H, D) new queries at positions q_pos0..q_pos0+T-1;
     k/v_cache: (B, S_max, H, D) with the new keys already written.
     Causal-masks against global positions, so entries past the fill level
-    (zeros) are masked out by construction."""
-    B, T, H, D = q.shape
-    S = k_cache.shape[1]
-    scale = 1.0 / (D ** 0.5)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k_cache.astype(jnp.float32)) * scale
-    rows = q_pos0 + jnp.arange(T)[:, None]          # global query positions
-    cols = jnp.arange(S)[None, :]
-    s = jnp.where((rows >= cols)[None, None], s, _NEG)
-    p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bhqk,bkhd->bqhd", p, v_cache.astype(jnp.float32))
-    return o.astype(q.dtype)
+    (zeros) are masked out by construction. Long prefills (tileable T)
+    ride the flash kernel — same global-offset masking; single-token
+    decode (T=1) stays on the fused-GEMV jnp path automatically."""
+    from byteps_tpu.ops.flash_attention import attention_lse
+
+    o, _ = attention_lse(q, k_cache, v_cache, q_pos0, 0, causal=True)
+    return o
 
 
 def _attn_cached_half(x, p, cache_k, cache_v, pos0, head_dim, tp_axis):
@@ -160,14 +155,46 @@ def gpt_apply_cached(params, tokens: jnp.ndarray, cache: KVCache,
 
 def make_generate_fn(cfg: GPTConfig, max_new: int,
                      tp_axis: Optional[str] = None,
-                     ep_axis: Optional[str] = None):
+                     ep_axis: Optional[str] = None,
+                     top_k: Optional[int] = None,
+                     top_p: Optional[float] = None):
     """Build a jitted sampler: ``gen(params, prompt, rng, temperature)``.
 
     prompt: (B, T0) int32; returns (B, T0 + max_new) tokens. Greedy when
     ``temperature == 0`` (exact argmax — the equivalence-vs-gpt_forward
-    test drives this), categorical sampling otherwise. One XLA program:
-    cached prefill + ``lax.scan`` over max_new decode steps.
+    test drives this), categorical sampling otherwise, optionally
+    truncated to the ``top_k`` highest-probability tokens and/or the
+    ``top_p`` nucleus (smallest set with cumulative probability ≥ top_p,
+    computed at temperature 1 then resampled at ``temperature``). One XLA
+    program: cached prefill + ``lax.scan`` over max_new decode steps.
     """
+    if top_k is not None and not 1 <= top_k <= cfg.vocab_size:
+        raise ValueError(f"top_k must be in [1, vocab]; got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1]; got {top_p}")
+
+    def _truncate(logits_t):
+        """Mask logits outside the top-k set / the top-p nucleus (both
+        computed on the raw distribution; with both set, a token must
+        pass both filters). One descending sort serves both — this runs
+        per decode step inside the scan."""
+        if top_k is None and top_p is None:
+            return logits_t
+        sorted_desc = jnp.sort(logits_t, axis=-1)[:, ::-1]
+        thresh = jnp.full_like(logits_t[:, :1], -jnp.inf)
+        if top_k is not None:
+            thresh = jnp.maximum(thresh, sorted_desc[:, top_k - 1:top_k])
+        if top_p is not None:
+            probs = jax.nn.softmax(sorted_desc, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # keep every token whose PRECEDING cumulative mass < top_p
+            # (the nucleus always includes the argmax)
+            keep = jnp.concatenate(
+                [jnp.zeros_like(cum[:, :1]), cum[:, :-1]], axis=-1) < top_p
+            thresh = jnp.maximum(thresh, jnp.min(
+                jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
+                keepdims=True))
+        return jnp.where(logits_t >= thresh, logits_t, -jnp.inf)
 
     @functools.partial(jax.jit, static_argnames=())
     def gen(params, prompt, rng, temperature=0.0):
@@ -189,8 +216,9 @@ def make_generate_fn(cfg: GPTConfig, max_new: int,
 
         def pick(logits_t, key):
             greedy = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
+            trunc = _truncate(logits_t)
             temp = jnp.maximum(temperature, 1e-6)
-            sampled = jax.random.categorical(key, logits_t / temp, axis=-1)
+            sampled = jax.random.categorical(key, trunc / temp, axis=-1)
             return jnp.where(temperature > 0.0, sampled.astype(jnp.int32),
                              greedy)
 

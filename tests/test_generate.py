@@ -208,3 +208,52 @@ def test_moe_generate_under_ep_and_tp():
         )
     )(params, prompt, jax.random.PRNGKey(28))
     np.testing.assert_array_equal(np.asarray(sharded), np.asarray(single))
+
+
+def test_top_k_one_equals_greedy(setup):
+    params, prompt = setup
+    greedy = make_generate_fn(CFG, max_new=6)(
+        params, prompt, jax.random.PRNGKey(7), 0.0)
+    k1 = make_generate_fn(CFG, max_new=6, top_k=1)(
+        params, prompt, jax.random.PRNGKey(8), 1.0)
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))
+
+
+def test_tiny_nucleus_equals_greedy(setup):
+    params, prompt = setup
+    greedy = make_generate_fn(CFG, max_new=6)(
+        params, prompt, jax.random.PRNGKey(9), 0.0)
+    p0 = make_generate_fn(CFG, max_new=6, top_p=1e-9)(
+        params, prompt, jax.random.PRNGKey(10), 1.0)
+    np.testing.assert_array_equal(np.asarray(p0), np.asarray(greedy))
+
+
+def test_top_p_full_equals_unrestricted(setup):
+    params, prompt = setup
+    a = make_generate_fn(CFG, max_new=6)(
+        params, prompt, jax.random.PRNGKey(11), 1.0)
+    b = make_generate_fn(CFG, max_new=6, top_p=1.0)(
+        params, prompt, jax.random.PRNGKey(11), 1.0)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sampler_arg_validation(setup):
+    with pytest.raises(ValueError, match="top_k"):
+        make_generate_fn(CFG, 4, top_k=0)
+    with pytest.raises(ValueError, match="top_p"):
+        make_generate_fn(CFG, 4, top_p=0.0)
+
+
+def test_prefill_flash_backend_matches_forward(setup, monkeypatch):
+    """Forced-pallas (interpret) prefill rides the flash kernel against
+    the full cache and must still match gpt_forward."""
+    monkeypatch.setenv("BYTEPS_KERNEL_BACKEND", "pallas")
+    params, prompt = setup
+    B = prompt.shape[0]
+    # pad prompt to a tileable length so the flash path engages
+    prompt16 = prompt[:, :8]
+    logits_ref = gpt_forward(params, prompt16, CFG)
+    cache = init_cache(CFG, B)
+    logits, _ = gpt_apply_cached(params, prompt16, cache, CFG)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(logits_ref),
+                               rtol=2e-5, atol=2e-5)
