@@ -28,10 +28,10 @@ from byteps_tpu.models.gpt import (
     GPTConfig,
     _layernorm,
     _readout,
+    rope_rotate,
 )
 from byteps_tpu.parallel.tp import col_parallel_matmul, row_parallel_matmul
 
-_NEG = -1e30
 
 
 class KVCache(NamedTuple):
@@ -58,7 +58,7 @@ def init_cache(cfg: GPTConfig, batch: int, h_loc: Optional[int] = None,
     )
 
 
-def _cached_attention(q, k_cache, v_cache, q_pos0, n_new):
+def _cached_attention(q, k_cache, v_cache, q_pos0):
     """q: (B, T, H, D) new queries at positions q_pos0..q_pos0+T-1;
     k/v_cache: (B, S_max, H, D) with the new keys already written.
     Causal-masks against global positions, so entries past the fill level
@@ -71,12 +71,15 @@ def _cached_attention(q, k_cache, v_cache, q_pos0, n_new):
     return o
 
 
-def _attn_cached_half(x, p, cache_k, cache_v, pos0, head_dim, tp_axis):
+def _attn_cached_half(x, p, cache_k, cache_v, pos0, cfg, tp_axis):
     """The attention residual branch over T new tokens with cache append.
 
     x: (B, T, d); cache_k/v: (B, S_max, h_loc, D) this layer's cache.
-    Returns (x_out, new_cache_k, new_cache_v).
+    Returns (x_out, new_cache_k, new_cache_v). Under RoPE the new q/k
+    rotate by their global positions before the cache write, so cached
+    keys are stored post-rotation (the standard decode convention).
     """
+    head_dim = cfg.head_dim
     B, T = x.shape[:2]
     h = _layernorm(x, p["ln1_g"], p["ln1_b"])
     q = col_parallel_matmul(h, p["wq"].astype(x.dtype), p["bq"].astype(x.dtype))
@@ -86,11 +89,15 @@ def _attn_cached_half(x, p, cache_k, cache_v, pos0, head_dim, tp_axis):
     q = q.reshape(B, T, h_loc, head_dim)
     k = k.reshape(B, T, h_loc, head_dim)
     v = v.reshape(B, T, h_loc, head_dim)
+    if cfg.pos_embedding == "rope":
+        pos = pos0 + jnp.arange(T)
+        q = rope_rotate(q, pos, cfg.rope_base)
+        k = rope_rotate(k, pos, cfg.rope_base)
     cache_k = jax.lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype),
                                            (0, pos0, 0, 0))
     cache_v = jax.lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype),
                                            (0, pos0, 0, 0))
-    o = _cached_attention(q, cache_k, cache_v, pos0, T)
+    o = _cached_attention(q, cache_k, cache_v, pos0)
     o = o.reshape(B, T, h_loc * head_dim)
     x = x + row_parallel_matmul(o, p["wo"].astype(x.dtype), tp_axis,
                                 p["bo"].astype(x.dtype))
@@ -101,7 +108,7 @@ def _block_step(x, p, cache_k, cache_v, pos0, cfg, tp_axis, ep_axis):
     """One transformer block (dense-MLP or MoE, by param structure) over
     T new tokens with cache append."""
     x, cache_k, cache_v = _attn_cached_half(
-        x, p, cache_k, cache_v, pos0, cfg.head_dim, tp_axis)
+        x, p, cache_k, cache_v, pos0, cfg, tp_axis)
     h = _layernorm(x, p["ln2_g"], p["ln2_b"])
     if "moe" in p:
         from byteps_tpu.parallel.moe import moe_ffn
@@ -137,9 +144,12 @@ def gpt_apply_cached(params, tokens: jnp.ndarray, cache: KVCache,
     """
     B, T = tokens.shape
     pos0 = cache.length
-    pos = pos0 + jnp.arange(T)
-    x = (params["wte"][tokens]
-         + jnp.take(params["wpe"], pos, axis=0)).astype(cfg.dtype)
+    if cfg.pos_embedding == "rope":
+        x = params["wte"][tokens].astype(cfg.dtype)
+    else:
+        pos = pos0 + jnp.arange(T)
+        x = (params["wte"][tokens]
+             + jnp.take(params["wpe"], pos, axis=0)).astype(cfg.dtype)
 
     new_k, new_v = [], []
     for li, p in enumerate(params["blocks"]):
@@ -180,8 +190,12 @@ def make_generate_fn(cfg: GPTConfig, max_new: int,
         per decode step inside the scan."""
         if top_k is None and top_p is None:
             return logits_t
-        sorted_desc = jnp.sort(logits_t, axis=-1)[:, ::-1]
         thresh = jnp.full_like(logits_t[:, :1], -jnp.inf)
+        if top_p is None:
+            # top_k only: a partial top-k beats the full vocab sort
+            vals = jax.lax.top_k(logits_t, top_k)[0]
+            return jnp.where(logits_t >= vals[:, -1:], logits_t, -jnp.inf)
+        sorted_desc = jnp.sort(logits_t, axis=-1)[:, ::-1]
         if top_k is not None:
             thresh = jnp.maximum(thresh, sorted_desc[:, top_k - 1:top_k])
         if top_p is not None:

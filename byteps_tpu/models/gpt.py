@@ -39,6 +39,11 @@ class GPTConfig:
     n_layers: int = 12
     d_ff: int = 3072
     dtype: Any = jnp.float32
+    # "learned" = GPT-2 wpe table; "rope" = rotary position embeddings
+    # applied to q/k per head (wpe stays in the param tree, unused — the
+    # tree structure is position-scheme independent)
+    pos_embedding: str = "learned"
+    rope_base: float = 10000.0
 
     @property
     def head_dim(self) -> int:
@@ -92,6 +97,48 @@ def gpt_param_specs(cfg: GPTConfig, tp_axis: Optional[str]) -> Dict[str, Any]:
     }
 
 
+def resolve_rope(cfg: GPTConfig) -> float:
+    """Validate the position scheme and return the rope base to thread to
+    the blocks (0.0 = learned/wpe — no rotation)."""
+    if cfg.pos_embedding not in ("learned", "rope"):
+        raise ValueError(f"unknown pos_embedding {cfg.pos_embedding!r} — "
+                         "expected 'learned' or 'rope'")
+    if cfg.pos_embedding == "rope":
+        if not cfg.rope_base > 0.0:
+            raise ValueError(f"rope_base must be > 0; got {cfg.rope_base}")
+        return cfg.rope_base
+    return 0.0
+
+
+def _positions(S_loc: int, sp_axis, seq_layout: str) -> jnp.ndarray:
+    """This device's global sequence positions (layout-aware) — feeds both
+    the learned wpe gather and the RoPE rotations."""
+    if seq_layout == "zigzag" and sp_axis is not None:
+        return zigzag_local_positions(S_loc, sp_axis)
+    off = (jax.lax.axis_index(sp_axis) * S_loc if sp_axis is not None
+           else 0)
+    return off + jnp.arange(S_loc)
+
+
+def rope_rotate(x: jnp.ndarray, pos: jnp.ndarray,
+                base: float = 10000.0) -> jnp.ndarray:
+    """Rotary position embedding (half-split convention), (B, S, H, D)
+    with per-row global positions ``pos (S,)``. Pure elementwise rotation
+    — composes with the flash kernel, ring/zigzag schedules (positions
+    are layout-aware), and the KV cache (keys cached post-rotation)."""
+    D = x.shape[-1]
+    half = D // 2
+    inv_freq = 1.0 / (base ** (jnp.arange(half, dtype=jnp.float32) / half))
+    ang = pos.astype(jnp.float32)[:, None] * inv_freq[None, :]   # (S, half)
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
 def _layernorm(x: jnp.ndarray, g: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     xf = x.astype(jnp.float32)
     mu = xf.mean(-1, keepdims=True)
@@ -100,7 +147,7 @@ def _layernorm(x: jnp.ndarray, g: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def _attention(x, p, head_dim: int, tp_axis, sp_axis, causal: bool = True,
-               seq_layout: str = "contiguous"):
+               seq_layout: str = "contiguous", rope_base: float = 0.0):
     B, S = x.shape[:2]
     q = col_parallel_matmul(x, p["wq"].astype(x.dtype), p["bq"].astype(x.dtype))
     k = col_parallel_matmul(x, p["wk"].astype(x.dtype), p["bk"].astype(x.dtype))
@@ -109,6 +156,10 @@ def _attention(x, p, head_dim: int, tp_axis, sp_axis, causal: bool = True,
     q = q.reshape(B, S, h_loc, head_dim)
     k = k.reshape(B, S, h_loc, head_dim)
     v = v.reshape(B, S, h_loc, head_dim)
+    if rope_base > 0.0:
+        pos = _positions(S, sp_axis, seq_layout)
+        q = rope_rotate(q, pos, rope_base)
+        k = rope_rotate(k, pos, rope_base)
     if seq_layout == "zigzag":
         o = zigzag_ring_attention(q, k, v, sp_axis, causal=causal)
     elif seq_layout == "contiguous":
@@ -129,13 +180,15 @@ def _mlp(x, p, tp_axis):
 
 
 def transformer_block(x, p, head_dim: int, tp_axis=None, sp_axis=None,
-                      causal: bool = True, seq_layout: str = "contiguous"):
+                      causal: bool = True, seq_layout: str = "contiguous",
+                      rope_base: float = 0.0):
     """Pre-LN block shared by the GPT (causal) and BERT (bidirectional)
     families: attention + MLP, tp col/row-parallel, optional sp ring
-    (contiguous or zigzag sequence layout)."""
+    (contiguous or zigzag sequence layout), optional RoPE
+    (``rope_base > 0``)."""
     x = x + _attention(_layernorm(x, p["ln1_g"], p["ln1_b"]), p, head_dim,
                        tp_axis, sp_axis, causal=causal,
-                       seq_layout=seq_layout)
+                       seq_layout=seq_layout, rope_base=rope_base)
     return x + _mlp(_layernorm(x, p["ln2_g"], p["ln2_b"]), p, tp_axis)
 
 
@@ -185,12 +238,10 @@ def _embed(params, tokens: jnp.ndarray, cfg: GPTConfig,
     tokens are this device's (early, late) chunk pair and the positions
     follow (`zigzag_local_positions`)."""
     S_loc = tokens.shape[1]
-    if seq_layout == "zigzag" and sp_axis is not None:
-        pos = zigzag_local_positions(S_loc, sp_axis)
-    else:
-        off = (jax.lax.axis_index(sp_axis) * S_loc if sp_axis is not None
-               else 0)
-        pos = off + jnp.arange(S_loc)
+    if cfg.pos_embedding == "rope":
+        # positions enter through the per-layer q/k rotations instead
+        return params["wte"][tokens].astype(cfg.dtype)
+    pos = _positions(S_loc, sp_axis, seq_layout)
     return (params["wte"][tokens]
             + jnp.take(params["wpe"], pos, axis=0)).astype(cfg.dtype)
 
@@ -223,11 +274,13 @@ def gpt_forward(params, tokens: jnp.ndarray, cfg: GPTConfig,
     weights its tp shard; output logits stay tp/dp/sp-local (replicated
     over tp by construction).
     """
+    rope_base = resolve_rope(cfg)
     x = _embed(params, tokens, cfg, sp_axis, seq_layout)
 
     def apply_block(x, p):
         return transformer_block(x, p, cfg.head_dim, tp_axis, sp_axis,
-                                 causal=True, seq_layout=seq_layout)
+                                 causal=True, seq_layout=seq_layout,
+                                 rope_base=rope_base)
 
     # rematerialize per block: activations recomputed in backward — HBM
     # for FLOPs, the long-context lever (see maybe_remat for the tp/sp
@@ -269,9 +322,12 @@ def gpt_pp_loss(params, tokens, targets, cfg: GPTConfig,
     x = _embed(params, tokens, cfg, sp_axis)
     x_mb = x.reshape(n_micro, B // n_micro, S_loc, x.shape[-1])
 
+    rope_base = resolve_rope(cfg)
+
     def blk(h, p):
-        return transformer_block(h, p, cfg.head_dim, tp_axis, sp_axis,
-                                 causal=True)
+        return transformer_block(
+            h, p, cfg.head_dim, tp_axis, sp_axis, causal=True,
+            rope_base=rope_base)
 
     y_mb = pipeline_apply(x_mb, params["blocks"], blk, pp_axis,
                           remat=remat, vma_axes=vma_axes)

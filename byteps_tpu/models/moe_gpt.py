@@ -26,6 +26,7 @@ from byteps_tpu.models.gpt import (
     _readout_nll,
     block_init,
     block_specs,
+    resolve_rope,
 )
 from byteps_tpu.parallel.moe import moe_ffn, moe_init, moe_specs
 from byteps_tpu.parallel.remat import maybe_remat
@@ -96,7 +97,8 @@ def moe_transformer_block(x, p, cfg: MoEGPTConfig,
                           sp_axis: Optional[str] = None):
     """Pre-LN attention + MoE FFN; returns (x, aux_loss)."""
     x = x + _attention(_layernorm(x, p["ln1_g"], p["ln1_b"]), p,
-                       cfg.head_dim, tp_axis, sp_axis, causal=True)
+                       cfg.head_dim, tp_axis, sp_axis, causal=True,
+                       rope_base=resolve_rope(cfg))
     m, aux = moe_ffn(_layernorm(x, p["ln2_g"], p["ln2_b"]), p["moe"],
                      cfg.capacity_factor, ep_axis,
                      router_topk=cfg.router_topk, tp_axis=tp_axis)

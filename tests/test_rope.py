@@ -1,0 +1,163 @@
+"""RoPE position scheme: training and decode equivalences.
+
+Same pinning style as the other families: the rotated paths must agree
+with each other across every execution strategy — dense vs sp ring vs
+zigzag, full forward vs cached decode — because positions enter through
+one shared layout-aware helper.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from byteps_tpu.models import GPTConfig, gpt_forward, gpt_init
+from byteps_tpu.models.generate import make_generate_fn
+from byteps_tpu.parallel import MeshAxes, make_mesh, zigzag_permutation
+
+CFG = dataclasses.replace(GPTConfig.tiny(), pos_embedding="rope")
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = gpt_init(jax.random.PRNGKey(0), CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                                CFG.vocab_size)
+    return params, tokens
+
+
+def test_rope_changes_logits_vs_learned(setup):
+    params, tokens = setup
+    rope = gpt_forward(params, tokens, CFG)
+    learned = gpt_forward(params, tokens,
+                          dataclasses.replace(CFG, pos_embedding="learned"))
+    assert not np.allclose(np.asarray(rope), np.asarray(learned))
+
+
+def test_rope_is_position_dependent(setup):
+    """Same token at different positions must produce different logits
+    (the point of RoPE without wpe)."""
+    params, _ = setup
+    tok = jnp.full((1, 16), 7, jnp.int32)
+    logits = gpt_forward(params, tok, CFG)
+    assert not np.allclose(np.asarray(logits[0, 0]),
+                           np.asarray(logits[0, -1]))
+
+
+def test_rope_sp_ring_matches_dense(setup):
+    params, tokens = setup
+    want = gpt_forward(params, tokens, CFG)
+    mesh = make_mesh(MeshAxes(sp=4), devices=jax.devices()[:4])
+    got = jax.jit(
+        jax.shard_map(
+            lambda p, t: gpt_forward(p, t, CFG, sp_axis="sp"),
+            mesh=mesh,
+            in_specs=(P(), P(None, "sp")),
+            out_specs=P(None, "sp"),
+            check_vma=False,
+        )
+    )(params, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_rope_zigzag_matches_dense(setup):
+    params, tokens = setup
+    n = 4
+    perm = np.asarray(zigzag_permutation(32, n))
+    want = np.asarray(gpt_forward(params, tokens, CFG))[:, perm]
+    mesh = make_mesh(MeshAxes(sp=4), devices=jax.devices()[:4])
+    got = jax.jit(
+        jax.shard_map(
+            lambda p, t: gpt_forward(p, t, CFG, sp_axis="sp",
+                                     seq_layout="zigzag"),
+            mesh=mesh,
+            in_specs=(P(), P(None, "sp")),
+            out_specs=P(None, "sp"),
+            check_vma=False,
+        )
+    )(params, tokens[:, perm])
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+
+
+def test_rope_generate_matches_naive_loop(setup):
+    params, _ = setup
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 10), 0,
+                                CFG.vocab_size)
+    gen = make_generate_fn(CFG, max_new=6)
+    out = gen(params, prompt, jax.random.PRNGKey(3), 0.0)
+    seq = prompt
+    for _ in range(6):
+        logits = gpt_forward(params, seq, CFG)
+        tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, tok[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+
+def test_rope_train_step_converges():
+    import optax
+
+    from byteps_tpu.models.train import make_gpt_train_step, synthetic_batch
+
+    tokens, targets = synthetic_batch(jax.random.PRNGKey(4), CFG, 8, 32)
+    mesh = make_mesh(MeshAxes(dp=2), devices=jax.devices()[:2])
+    step, params, opt_state, bsh = make_gpt_train_step(
+        CFG, mesh, optax.adam(1e-2))
+    tok = jax.device_put(tokens, bsh)
+    tgt = jax.device_put(targets, bsh)
+    losses = []
+    for _ in range(8):
+        loss, params, opt_state = step(params, opt_state, tok, tgt)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+
+
+def test_unknown_pos_embedding_raises(setup):
+    params, tokens = setup
+    bad = dataclasses.replace(CFG, pos_embedding="alibi")
+    with pytest.raises(ValueError, match="pos_embedding"):
+        gpt_forward(params, tokens, bad)
+
+
+def test_moe_rope_train_decode_agree():
+    """MoE + RoPE: the training forward and the cached decode must use
+    the same rotations (regression: the MoE block once skipped them)."""
+    from byteps_tpu.models import MoEGPTConfig, moe_gpt_init
+    from byteps_tpu.models.gpt import _embed, _readout
+    from byteps_tpu.models.moe_gpt import moe_transformer_block
+
+    cfg = dataclasses.replace(MoEGPTConfig.tiny(), pos_embedding="rope")
+    params = moe_gpt_init(jax.random.PRNGKey(5), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(6), (2, 10), 0,
+                                cfg.vocab_size)
+
+    def moe_forward(params, tokens):
+        x = _embed(params, tokens, cfg, None)
+        for p in params["blocks"]:
+            x, _ = moe_transformer_block(x, p, cfg, None, None, None)
+        return _readout(params, x)
+
+    # position dependence: same token stream, shifted logits must differ
+    same = jnp.full((1, 10), 5, jnp.int32)
+    logits = moe_forward(params, same)
+    assert not np.allclose(np.asarray(logits[0, 0]),
+                           np.asarray(logits[0, -1]))
+
+    out = make_generate_fn(cfg, max_new=5)(
+        params, prompt, jax.random.PRNGKey(7), 0.0)
+    seq = prompt
+    for _ in range(5):
+        logits = moe_forward(params, seq)
+        tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, tok[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+
+def test_bad_rope_base_raises(setup):
+    params, tokens = setup
+    bad = dataclasses.replace(CFG, rope_base=0.0)
+    with pytest.raises(ValueError, match="rope_base"):
+        gpt_forward(params, tokens, bad)
