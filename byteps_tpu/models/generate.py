@@ -58,6 +58,12 @@ def init_cache(cfg: GPTConfig, batch: int, h_loc: Optional[int] = None,
     )
 
 
+def _wants_flash(T, S, D):
+    from byteps_tpu.ops.flash_attention import supported, use_pallas
+
+    return use_pallas() and supported(T, S, D)
+
+
 def _cached_attention(q, k_cache, v_cache, q_pos0):
     """q: (B, T, H, D) new queries at positions q_pos0..q_pos0+T-1;
     k/v_cache: (B, S_max, H, D) with the new keys already written.
@@ -86,9 +92,10 @@ def _attn_cached_half(x, p, cache_k, cache_v, pos0, cfg, tp_axis):
     k = col_parallel_matmul(h, p["wk"].astype(x.dtype), p["bk"].astype(x.dtype))
     v = col_parallel_matmul(h, p["wv"].astype(x.dtype), p["bv"].astype(x.dtype))
     h_loc = q.shape[-1] // head_dim
+    kv_loc = k.shape[-1] // head_dim    # GQA: the cache stores kv heads only
     q = q.reshape(B, T, h_loc, head_dim)
-    k = k.reshape(B, T, h_loc, head_dim)
-    v = v.reshape(B, T, h_loc, head_dim)
+    k = k.reshape(B, T, kv_loc, head_dim)
+    v = v.reshape(B, T, kv_loc, head_dim)
     if cfg.pos_embedding == "rope":
         pos = pos0 + jnp.arange(T)
         q = rope_rotate(q, pos, cfg.rope_base)
@@ -97,7 +104,15 @@ def _attn_cached_half(x, p, cache_k, cache_v, pos0, cfg, tp_axis):
                                            (0, pos0, 0, 0))
     cache_v = jax.lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype),
                                            (0, pos0, 0, 0))
-    o = _cached_attention(q, cache_k, cache_v, pos0)
+    if kv_loc != h_loc and _wants_flash(T, cache_k.shape[1], head_dim):
+        # flash prefill needs equal heads — repeat once for the long
+        # prompt pass; decode (T=1, jnp path) attends grouped against
+        # the narrow cache with no materialized repeat
+        rep = h_loc // kv_loc
+        o = _cached_attention(q, jnp.repeat(cache_k, rep, axis=2),
+                              jnp.repeat(cache_v, rep, axis=2), pos0)
+    else:
+        o = _cached_attention(q, cache_k, cache_v, pos0)
     o = o.reshape(B, T, h_loc * head_dim)
     x = x + row_parallel_matmul(o, p["wo"].astype(x.dtype), tp_axis,
                                 p["bo"].astype(x.dtype))
@@ -142,6 +157,9 @@ def gpt_apply_cached(params, tokens: jnp.ndarray, cache: KVCache,
     MoE GPT families (block type detected from the params; ``ep_axis``
     shards the experts inside shard_map).
     """
+    from byteps_tpu.models.gpt import resolve_rope
+
+    resolve_rope(cfg)   # validate the position scheme decode-side too
     B, T = tokens.shape
     pos0 = cache.length
     if cfg.pos_embedding == "rope":
@@ -186,15 +204,16 @@ def make_generate_fn(cfg: GPTConfig, max_new: int,
     def _truncate(logits_t):
         """Mask logits outside the top-k set / the top-p nucleus (both
         computed on the raw distribution; with both set, a token must
-        pass both filters). One descending sort serves both — this runs
-        per decode step inside the scan."""
+        pass both filters). Runs per decode step inside the scan:
+        top_k-only takes a partial lax.top_k; any top_p pays one
+        descending sort that also serves the top_k threshold."""
         if top_k is None and top_p is None:
             return logits_t
-        thresh = jnp.full_like(logits_t[:, :1], -jnp.inf)
         if top_p is None:
             # top_k only: a partial top-k beats the full vocab sort
             vals = jax.lax.top_k(logits_t, top_k)[0]
             return jnp.where(logits_t >= vals[:, -1:], logits_t, -jnp.inf)
+        thresh = jnp.full_like(logits_t[:, :1], -jnp.inf)
         sorted_desc = jnp.sort(logits_t, axis=-1)[:, ::-1]
         if top_k is not None:
             thresh = jnp.maximum(thresh, sorted_desc[:, top_k - 1:top_k])
@@ -221,9 +240,10 @@ def make_generate_fn(cfg: GPTConfig, max_new: int,
                 f"prompt ({T0}) + max_new ({max_new}) exceeds "
                 f"cfg.max_seq ({cfg.max_seq})")
         # under tp (inside shard_map) the projections are head-sharded —
-        # size the cache from this device's wq shard
-        h_loc = params["blocks"][0]["wq"].shape[-1] // cfg.head_dim
-        cache = init_cache(cfg, B, h_loc=h_loc)
+        # size the cache from this device's wk shard (GQA: kv heads only,
+        # the cache-memory lever)
+        kv_loc = params["blocks"][0]["wk"].shape[-1] // cfg.head_dim
+        cache = init_cache(cfg, B, h_loc=kv_loc)
         logits, cache = gpt_apply_cached(params, prompt, cache, cfg, tp_axis,
                                          ep_axis)
         last = logits[:, -1]

@@ -44,10 +44,23 @@ class GPTConfig:
     # tree structure is position-scheme independent)
     pos_embedding: str = "learned"
     rope_base: float = 10000.0
+    # grouped-query attention: k/v carry n_kv_heads heads (None = n_heads,
+    # plain MHA); queries repeat each kv head n_heads/n_kv_heads times.
+    # The KV cache stores only the kv heads — the decode memory lever.
+    n_kv_heads: Any = None
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        kv = self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
+        if self.n_heads % kv != 0:
+            raise ValueError(
+                f"n_heads ({self.n_heads}) must be a multiple of "
+                f"n_kv_heads ({kv})")
+        return kv
 
     @classmethod
     def tiny(cls) -> "GPTConfig":
@@ -64,6 +77,7 @@ class GPTConfig:
 def gpt_init(rng: jnp.ndarray, cfg: GPTConfig) -> Dict[str, Any]:
     """Initialize full (unsharded) parameters; shard via device_put after."""
     d, ff, hd = cfg.d_model, cfg.d_ff, cfg.n_heads * cfg.head_dim
+    kv_hd = cfg.kv_heads * cfg.head_dim
     std = 0.02
 
     def dense(key, shape):
@@ -76,7 +90,7 @@ def gpt_init(rng: jnp.ndarray, cfg: GPTConfig) -> Dict[str, Any]:
         "lnf_g": jnp.ones((d,), jnp.float32),
         "lnf_b": jnp.zeros((d,), jnp.float32),
         "blocks": [
-            block_init(keys[2 + li], d, ff, hd, cfg.n_layers)
+            block_init(keys[2 + li], d, ff, hd, cfg.n_layers, kv_hd=kv_hd)
             for li in range(cfg.n_layers)
         ],
     }
@@ -152,14 +166,24 @@ def _attention(x, p, head_dim: int, tp_axis, sp_axis, causal: bool = True,
     q = col_parallel_matmul(x, p["wq"].astype(x.dtype), p["bq"].astype(x.dtype))
     k = col_parallel_matmul(x, p["wk"].astype(x.dtype), p["bk"].astype(x.dtype))
     v = col_parallel_matmul(x, p["wv"].astype(x.dtype), p["bv"].astype(x.dtype))
-    h_loc = q.shape[-1] // head_dim   # heads this tp shard owns
+    h_loc = q.shape[-1] // head_dim     # query heads this tp shard owns
+    kv_loc = k.shape[-1] // head_dim    # kv heads (GQA: fewer)
+    if kv_loc == 0 or h_loc % kv_loc != 0:
+        raise ValueError(
+            f"per-shard head split is invalid: {h_loc} query heads vs "
+            f"{kv_loc} kv heads — with GQA under tensor parallelism, "
+            "n_kv_heads must be divisible by the tp axis size")
     q = q.reshape(B, S, h_loc, head_dim)
-    k = k.reshape(B, S, h_loc, head_dim)
-    v = v.reshape(B, S, h_loc, head_dim)
+    k = k.reshape(B, S, kv_loc, head_dim)
+    v = v.reshape(B, S, kv_loc, head_dim)
     if rope_base > 0.0:
         pos = _positions(S, sp_axis, seq_layout)
         q = rope_rotate(q, pos, rope_base)
         k = rope_rotate(k, pos, rope_base)
+    if kv_loc != h_loc:
+        # GQA: repeat each kv head over its query group
+        k = jnp.repeat(k, h_loc // kv_loc, axis=2)
+        v = jnp.repeat(v, h_loc // kv_loc, axis=2)
     if seq_layout == "zigzag":
         o = zigzag_ring_attention(q, k, v, sp_axis, causal=causal)
     elif seq_layout == "contiguous":
@@ -192,9 +216,13 @@ def transformer_block(x, p, head_dim: int, tp_axis=None, sp_axis=None,
     return x + _mlp(_layernorm(x, p["ln2_g"], p["ln2_b"]), p, tp_axis)
 
 
-def block_init(rng, d: int, ff: int, hd: int, n_layers: int):
-    """One transformer block's params (shape shared across families)."""
+def block_init(rng, d: int, ff: int, hd: int, n_layers: int,
+               kv_hd: int = None):
+    """One transformer block's params (shape shared across families).
+    ``kv_hd`` (default ``hd``) narrows the k/v projections for GQA."""
     std = 0.02
+    if kv_hd is None:
+        kv_hd = hd
     bk = jax.random.split(rng, 6)
 
     def dense(key, shape):
@@ -204,8 +232,10 @@ def block_init(rng, d: int, ff: int, hd: int, n_layers: int):
         "ln1_g": jnp.ones((d,), jnp.float32),
         "ln1_b": jnp.zeros((d,), jnp.float32),
         "wq": dense(bk[0], (d, hd)), "bq": jnp.zeros((hd,), jnp.float32),
-        "wk": dense(bk[1], (d, hd)), "bk": jnp.zeros((hd,), jnp.float32),
-        "wv": dense(bk[2], (d, hd)), "bv": jnp.zeros((hd,), jnp.float32),
+        "wk": dense(bk[1], (d, kv_hd)),
+        "bk": jnp.zeros((kv_hd,), jnp.float32),
+        "wv": dense(bk[2], (d, kv_hd)),
+        "bv": jnp.zeros((kv_hd,), jnp.float32),
         "wo": dense(bk[3], (hd, d)) / (2 * n_layers) ** 0.5,
         "bo": jnp.zeros((d,), jnp.float32),
         "ln2_g": jnp.ones((d,), jnp.float32),

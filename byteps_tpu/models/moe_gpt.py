@@ -49,7 +49,8 @@ class MoEGPTConfig(GPTConfig):
 def moe_block_init(rng, cfg: MoEGPTConfig):
     """Attention half of a dense block + expert-stacked MoE FFN."""
     b = block_init(rng, cfg.d_model, cfg.d_ff,
-                   cfg.n_heads * cfg.head_dim, cfg.n_layers)
+                   cfg.n_heads * cfg.head_dim, cfg.n_layers,
+                   kv_hd=cfg.kv_heads * cfg.head_dim)
     for k in ("w1", "b1", "w2", "b2"):
         del b[k]
     b["moe"] = moe_init(jax.random.fold_in(rng, 99), cfg.d_model,

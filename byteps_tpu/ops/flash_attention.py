@@ -488,12 +488,24 @@ def attention_lse_jnp(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """jnp twin of :func:`flash_attention_lse` — same (o, lse) contract,
     same global-offset causal masking and −1e30 ≡ no-live-keys signal, any
     shape. The golden for the kernel and the fallback for ring schedules
-    off-TPU."""
+    off-TPU. Grouped-query attention is native: when q carries G× the
+    k/v head count, each kv head serves its group through the einsum —
+    no materialized head repeat (the GQA decode hot path)."""
     B, Sq, H, D = q.shape
-    Sk = k.shape[1]
+    Sk, Hkv = k.shape[1], k.shape[2]
     scale = 1.0 / (D ** 0.5)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
+    if Hkv != H:
+        if H % Hkv != 0:
+            raise ValueError(f"q heads ({H}) not a multiple of kv heads "
+                             f"({Hkv})")
+        g = H // Hkv
+        qg = q.reshape(B, Sq, Hkv, g, D)
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
+        s = s.reshape(B, H, Sq, Sk)
+    else:
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
     if causal:
         rows = q_offset + jnp.arange(Sq)[:, None]
         cols = k_offset + jnp.arange(Sk)[None, :]
@@ -506,8 +518,13 @@ def attention_lse_jnp(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         p = jnp.where(s > _NEG / 2, p, 0.0)
     l = p.sum(axis=-1)
     l_safe = jnp.where(l > 0.0, l, 1.0)
-    o = jnp.einsum("bhqk,bkhd->bqhd", p / l_safe[..., None],
-                   v.astype(jnp.float32))
+    pn = p / l_safe[..., None]
+    if Hkv != H:
+        pn = pn.reshape(B, Hkv, H // Hkv, Sq, Sk)
+        o = jnp.einsum("bhgqk,bkhd->bqhgd", pn, v.astype(jnp.float32))
+        o = o.reshape(B, Sq, H, D)
+    else:
+        o = jnp.einsum("bhqk,bkhd->bqhd", pn, v.astype(jnp.float32))
     o = jnp.where(live.transpose(0, 2, 1)[..., None], o, 0.0)
     lse = jnp.where(live, m_safe + jnp.log(l_safe), _NEG)
     return o.astype(q.dtype), lse.transpose(0, 2, 1)     # (B, Sq, H)
@@ -517,8 +534,11 @@ def attention_lse(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                   q_offset, k_offset, causal: bool = True
                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Backend-dispatching (o, lse) attention with global offsets — the
-    building block ring schedules merge with :func:`merge_attention`."""
-    if use_pallas() and supported(q.shape[1], k.shape[1], q.shape[-1]):
+    building block ring schedules merge with :func:`merge_attention`.
+    Mismatched head counts (GQA) route to the grouped jnp path (the
+    flash kernel needs equal heads — repeat k/v first to use it)."""
+    if (q.shape[2] == k.shape[2] and use_pallas()
+            and supported(q.shape[1], k.shape[1], q.shape[-1])):
         return flash_attention_lse(q, k, v, q_offset, k_offset,
                                    causal=causal)
     return attention_lse_jnp(q, k, v, q_offset, k_offset, causal=causal)
