@@ -25,13 +25,26 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=32)
     ap.add_argument("--temperature", type=float, default=0.8)
     ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--top-k", type=int, default=None)
+    ap.add_argument("--top-p", type=float, default=None)
+    ap.add_argument("--rope", action="store_true",
+                    help="rotary position embeddings instead of wpe")
+    ap.add_argument("--kv-heads", type=int, default=None,
+                    help="GQA: kv heads in the cache (default = all)")
     args = ap.parse_args()
 
+    import dataclasses
+
     cfg = GPTConfig.tiny()
+    if args.rope:
+        cfg = dataclasses.replace(cfg, pos_embedding="rope")
+    if args.kv_heads is not None:
+        cfg = dataclasses.replace(cfg, n_kv_heads=args.kv_heads)
     params = gpt_init(jax.random.PRNGKey(0), cfg)
     prompt = jax.random.randint(jax.random.PRNGKey(1), (args.batch, 8), 0,
                                 cfg.vocab_size)
-    gen = make_generate_fn(cfg, max_new=args.steps)
+    gen = make_generate_fn(cfg, max_new=args.steps, top_k=args.top_k,
+                           top_p=args.top_p)
 
     t0 = time.perf_counter()
     out = gen(params, prompt, jax.random.PRNGKey(2), args.temperature)
