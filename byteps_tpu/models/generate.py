@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from byteps_tpu.models.gpt import (
     GPTConfig,
     _layernorm,
+    _mlp,
     _readout,
     rope_rotate,
 )
@@ -136,11 +137,7 @@ def _block_step(x, p, cache_k, cache_v, pos0, cfg, tp_axis, ep_axis):
             tp_axis=tp_axis, no_drop=True)
         x = x + m
     else:
-        ff = col_parallel_matmul(h, p["w1"].astype(x.dtype),
-                                 p["b1"].astype(x.dtype))
-        ff = jax.nn.gelu(ff)
-        x = x + row_parallel_matmul(ff, p["w2"].astype(x.dtype), tp_axis,
-                                    p["b2"].astype(x.dtype))
+        x = x + _mlp(h, p, tp_axis)
     return x, cache_k, cache_v
 
 

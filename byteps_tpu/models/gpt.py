@@ -48,6 +48,9 @@ class GPTConfig:
     # plain MHA); queries repeat each kv head n_heads/n_kv_heads times.
     # The KV cache stores only the kv heads — the decode memory lever.
     n_kv_heads: Any = None
+    # "gelu" = GPT-2 2-matrix MLP; "swiglu" = gated 3-matrix llama-style
+    # FFN (silu(x·w1) ∘ (x·w3)) · w2 — same d_ff hidden width
+    mlp: str = "gelu"
 
     @property
     def head_dim(self) -> int:
@@ -90,7 +93,8 @@ def gpt_init(rng: jnp.ndarray, cfg: GPTConfig) -> Dict[str, Any]:
         "lnf_g": jnp.ones((d,), jnp.float32),
         "lnf_b": jnp.zeros((d,), jnp.float32),
         "blocks": [
-            block_init(keys[2 + li], d, ff, hd, cfg.n_layers, kv_hd=kv_hd)
+            block_init(keys[2 + li], d, ff, hd, cfg.n_layers, kv_hd=kv_hd,
+                       mlp=cfg.mlp)
             for li in range(cfg.n_layers)
         ],
     }
@@ -107,7 +111,8 @@ def gpt_param_specs(cfg: GPTConfig, tp_axis: Optional[str]) -> Dict[str, Any]:
     """
     return {
         "wte": P(), "wpe": P(), "lnf_g": P(), "lnf_b": P(),
-        "blocks": [block_specs(tp_axis) for _ in range(cfg.n_layers)],
+        "blocks": [block_specs(tp_axis, cfg.mlp)
+                   for _ in range(cfg.n_layers)],
     }
 
 
@@ -198,7 +203,14 @@ def _attention(x, p, head_dim: int, tp_axis, sp_axis, causal: bool = True,
 
 def _mlp(x, p, tp_axis):
     h = col_parallel_matmul(x, p["w1"].astype(x.dtype), p["b1"].astype(x.dtype))
-    h = jax.nn.gelu(h)
+    if "w3" in p:
+        # SwiGLU: silu-gated hidden (w1 value path ∘ w3 gate path); w1/w3
+        # col-parallel over tp, w2 row-parallel as in the gelu MLP
+        g = col_parallel_matmul(x, p["w3"].astype(x.dtype),
+                                p["b3"].astype(x.dtype))
+        h = jax.nn.silu(h) * g
+    else:
+        h = jax.nn.gelu(h)
     return row_parallel_matmul(h, p["w2"].astype(x.dtype), tp_axis,
                                p["b2"].astype(x.dtype))
 
@@ -217,13 +229,17 @@ def transformer_block(x, p, head_dim: int, tp_axis=None, sp_axis=None,
 
 
 def block_init(rng, d: int, ff: int, hd: int, n_layers: int,
-               kv_hd: int = None):
+               kv_hd: int = None, mlp: str = "gelu"):
     """One transformer block's params (shape shared across families).
-    ``kv_hd`` (default ``hd``) narrows the k/v projections for GQA."""
+    ``kv_hd`` (default ``hd``) narrows the k/v projections for GQA;
+    ``mlp="swiglu"`` adds the gate matrix ``w3``."""
+    if mlp not in ("gelu", "swiglu"):
+        raise ValueError(f"unknown mlp {mlp!r} — expected 'gelu' or "
+                         "'swiglu'")
     std = 0.02
     if kv_hd is None:
         kv_hd = hd
-    bk = jax.random.split(rng, 6)
+    bk = jax.random.split(rng, 7)
 
     def dense(key, shape):
         return jax.random.normal(key, shape, jnp.float32) * std
@@ -243,10 +259,13 @@ def block_init(rng, d: int, ff: int, hd: int, n_layers: int,
         "w1": dense(bk[4], (d, ff)), "b1": jnp.zeros((ff,), jnp.float32),
         "w2": dense(bk[5], (ff, d)) / (2 * n_layers) ** 0.5,
         "b2": jnp.zeros((d,), jnp.float32),
+        **({"w3": dense(bk[6], (d, ff)),
+            "b3": jnp.zeros((ff,), jnp.float32)} if mlp == "swiglu"
+           else {}),
     }
 
 
-def block_specs(tp_axis):
+def block_specs(tp_axis, mlp: str = "gelu"):
     """PartitionSpec dict for one transformer block (see gpt_param_specs)."""
     t = tp_axis
     return {
@@ -258,6 +277,7 @@ def block_specs(tp_axis):
         "ln2_g": P(), "ln2_b": P(),
         "w1": P(None, t), "b1": P(t),
         "w2": P(t, None), "b2": P(),
+        **({"w3": P(None, t), "b3": P(t)} if mlp == "swiglu" else {}),
     }
 
 

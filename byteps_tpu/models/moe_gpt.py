@@ -48,6 +48,11 @@ class MoEGPTConfig(GPTConfig):
 
 def moe_block_init(rng, cfg: MoEGPTConfig):
     """Attention half of a dense block + expert-stacked MoE FFN."""
+    if cfg.mlp != "gelu":
+        raise NotImplementedError(
+            f"mlp={cfg.mlp!r} does not apply to the MoE family — the "
+            "dense MLP is replaced by the expert FFN (gelu experts); "
+            "gated experts are future work")
     b = block_init(rng, cfg.d_model, cfg.d_ff,
                    cfg.n_heads * cfg.head_dim, cfg.n_layers,
                    kv_hd=cfg.kv_heads * cfg.head_dim)

@@ -504,7 +504,7 @@ def make_gpt_pp_train_step(
     }
     pspecs = {
         "wte": P(), "wpe": P(), "lnf_g": P(), "lnf_b": P(),
-        "blocks": stacked_specs(block_specs(tp), pp),
+        "blocks": stacked_specs(block_specs(tp, cfg.mlp), pp),
     }
     state_axes, tx_kw, zero_numel = _dist_state_setup(
         mesh, params, pspecs, dp, zero_1)
