@@ -885,3 +885,41 @@ def synthetic_mlm_batch(rng: jnp.ndarray, cfg: BertConfig, batch: int,
     mask_id = cfg.vocab_size - 1  # last id doubles as [MASK] in synthetic data
     tokens = jnp.where(mask, mask_id, targets)
     return tokens, targets, mask.astype(jnp.int32)
+
+def make_eval_step(cfg: GPTConfig, mesh: Mesh, seq_layout: str = "contiguous"):
+    """Jitted eval step: ``eval_step(params, tokens, targets) -> mean nll``
+    over the (dp, sp)-sharded batch — exp() of the running mean is the
+    perplexity. Shares gpt_loss (and therefore every config option:
+    rope/GQA/SwiGLU, zigzag layout) with the train factories; no
+    optimizer, no grads, safe to call on training params at any step.
+    """
+    dp, tp, sp = _axis(mesh, "dp"), _axis(mesh, "tp"), _axis(mesh, "sp")
+    batch_spec = P(dp, sp)
+    pspecs = gpt_param_specs(cfg, tp)
+
+    def per_device(params, tokens, targets):
+        loss = gpt_loss(params, tokens, targets, cfg, dp_axis=dp,
+                        tp_axis=tp, sp_axis=sp, seq_layout=seq_layout)
+        return _collapse_vma(loss)
+
+    sharded = jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(pspecs, batch_spec, batch_spec),
+        out_specs=P(),
+        check_vma=True,
+    )
+    return jax.jit(sharded), NamedSharding(mesh, batch_spec)
+
+
+def evaluate_perplexity(eval_step, params, batches, batch_sharding) -> float:
+    """Mean perplexity over an iterable of (tokens, targets) host batches."""
+    total, n = 0.0, 0
+    for tokens, targets in batches:
+        tok = jax.device_put(tokens, batch_sharding)
+        tgt = jax.device_put(targets, batch_sharding)
+        total += float(eval_step(params, tok, tgt))
+        n += 1
+    if n == 0:
+        raise ValueError("evaluate_perplexity: no batches")
+    return float(np.exp(total / n))

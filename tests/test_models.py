@@ -162,3 +162,26 @@ def test_zigzag_train_step_matches_dense_loss():
         loss, params, opt_state = step(params, opt_state, tok, tgt)
         zz_losses.append(float(loss))
     np.testing.assert_allclose(zz_losses, base_losses, rtol=2e-4, atol=2e-4)
+
+
+def test_eval_step_and_perplexity():
+    from byteps_tpu.models.train import evaluate_perplexity, make_eval_step
+
+    cfg = GPTConfig.tiny()
+    mesh = make_mesh(MeshAxes(dp=2, tp=2), devices=jax.devices()[:4])
+    step, params, opt_state, bsh = make_gpt_train_step(
+        cfg, mesh, optax.adam(1e-2))
+    eval_step, ebsh = make_eval_step(cfg, mesh)
+    batches = [synthetic_batch(jax.random.PRNGKey(i), cfg, 4, 32)
+               for i in range(2)]
+    ppl0 = evaluate_perplexity(eval_step, params, batches, ebsh)
+    # train on the first batch, eval again — perplexity must drop
+    tok = jax.device_put(batches[0][0], bsh)
+    tgt = jax.device_put(batches[0][1], bsh)
+    for _ in range(6):
+        _, params, opt_state = step(params, opt_state, tok, tgt)
+    ppl1 = evaluate_perplexity(eval_step, params, batches, ebsh)
+    assert np.isfinite(ppl0) and np.isfinite(ppl1)
+    assert ppl1 < ppl0
+    # untrained tiny model ≈ uniform over the vocab
+    assert ppl0 < cfg.vocab_size * 2
