@@ -27,12 +27,27 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
+
+# The environment's sitecustomize re-exports JAX_PLATFORMS=axon (the TPU
+# tunnel) at interpreter startup, overriding a caller's JAX_PLATFORMS=cpu.
+# Mirror __graft_entry__: the virtual-host-device flag is the unambiguous
+# signal this run wants CPU devices (and config.update after import is
+# what actually sticks).
+_FORCE_CPU = (
+    "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", "")
+)
+if _FORCE_CPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+if _FORCE_CPU:
+    jax.config.update("jax_platforms", "cpu")
 
 
 def _log(msg: str) -> None:
