@@ -347,7 +347,8 @@ def gpt_pp_loss(params, tokens, targets, cfg: GPTConfig,
                 tp_axis: Optional[str] = None,
                 sp_axis: Optional[str] = None,
                 remat: bool = False,
-                vma_axes: tuple = ()) -> jnp.ndarray:
+                vma_axes: tuple = (),
+                seq_layout: str = "contiguous") -> jnp.ndarray:
     """Pipeline-parallel next-token loss (inside shard_map over pp).
 
     ``params["blocks"]`` is THIS stage's stacked layer slab
@@ -369,7 +370,7 @@ def gpt_pp_loss(params, tokens, targets, cfg: GPTConfig,
     if B % n_micro != 0:
         raise ValueError(f"local batch {B} not divisible by {n_micro} "
                          "microbatches")
-    x = _embed(params, tokens, cfg, sp_axis)
+    x = _embed(params, tokens, cfg, sp_axis, seq_layout)
     x_mb = x.reshape(n_micro, B // n_micro, S_loc, x.shape[-1])
 
     rope_base = resolve_rope(cfg)
@@ -377,7 +378,7 @@ def gpt_pp_loss(params, tokens, targets, cfg: GPTConfig,
     def blk(h, p):
         return transformer_block(
             h, p, cfg.head_dim, tp_axis, sp_axis, causal=True,
-            rope_base=rope_base)
+            seq_layout=seq_layout, rope_base=rope_base)
 
     y_mb = pipeline_apply(x_mb, params["blocks"], blk, pp_axis,
                           remat=remat, vma_axes=vma_axes)

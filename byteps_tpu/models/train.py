@@ -56,6 +56,12 @@ def _axis(mesh: Mesh, name: str) -> Optional[str]:
     return name if name in mesh.axis_names else None
 
 
+def _check_seq_layout(seq_layout):
+    if seq_layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown seq_layout {seq_layout!r} — expected "
+                         "'contiguous' or 'zigzag'")
+
+
 def _check_compression_mesh(use_vma, tp, sp):
     if not use_vma and (tp is not None or sp is not None):
         raise NotImplementedError(
@@ -392,9 +398,7 @@ def make_gpt_train_step(
     causal attention at scale).
     """
     dp, tp, sp = _axis(mesh, "dp"), _axis(mesh, "tp"), _axis(mesh, "sp")
-    if seq_layout not in ("contiguous", "zigzag"):
-        raise ValueError(f"unknown seq_layout {seq_layout!r} — expected "
-                         "'contiguous' or 'zigzag'")
+    _check_seq_layout(seq_layout)
     use_vma = compression_params is None and not zero_1
     _check_compression_mesh(use_vma, tp, sp)
     pspecs = gpt_param_specs(cfg, tp)
@@ -461,6 +465,7 @@ def make_gpt_pp_train_step(
     partition_bytes: Optional[int] = None,
     remat: bool = False,
     zero_1: bool = False,
+    seq_layout: str = "contiguous",
 ):
     """Pipeline-parallel GPT train step over a (pp, dp[, tp][, sp]) mesh.
 
@@ -480,6 +485,10 @@ def make_gpt_pp_train_step(
     each stage compresses its own slab + replicated-leaf grads over dp,
     with per-(stage, worker) EF/momentum state.
 
+    ``seq_layout="zigzag"`` runs the load-balanced causal ring over sp
+    inside the stages — feed tokens/targets pre-permuted with
+    ``zigzag_permutation`` exactly as for the dense factory.
+
     Returns ``(step, params, opt_state, batch_sharding)`` like
     :func:`make_gpt_train_step`; ``params["blocks"]`` is the stacked slab.
     """
@@ -489,6 +498,7 @@ def make_gpt_pp_train_step(
     tp, sp = _axis(mesh, "tp"), _axis(mesh, "sp")
     if pp is None:
         raise ValueError("mesh has no pp axis — use make_gpt_train_step")
+    _check_seq_layout(seq_layout)
     use_vma = compression_params is None and not zero_1
     _check_compression_mesh(use_vma, tp, sp)
     nstages = mesh.shape[pp]
@@ -519,6 +529,7 @@ def make_gpt_pp_train_step(
         gpt_pp_loss, cfg=cfg, pp_axis=pp, n_micro=n_micro, tp_axis=tp,
         sp_axis=sp, remat=remat,
         vma_axes=tuple(mesh.axis_names) if use_vma else (),
+        seq_layout=seq_layout,
     )
 
     def build_jit(pb):
