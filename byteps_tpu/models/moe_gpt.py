@@ -100,11 +100,12 @@ def moe_gpt_param_specs(cfg: MoEGPTConfig, ep_axis: Optional[str],
 def moe_transformer_block(x, p, cfg: MoEGPTConfig,
                           ep_axis: Optional[str],
                           tp_axis: Optional[str] = None,
-                          sp_axis: Optional[str] = None):
+                          sp_axis: Optional[str] = None,
+                          seq_layout: str = "contiguous"):
     """Pre-LN attention + MoE FFN; returns (x, aux_loss)."""
     x = x + _attention(_layernorm(x, p["ln1_g"], p["ln1_b"]), p,
                        cfg.head_dim, tp_axis, sp_axis, causal=True,
-                       rope_base=resolve_rope(cfg))
+                       seq_layout=seq_layout, rope_base=resolve_rope(cfg))
     m, aux = moe_ffn(_layernorm(x, p["ln2_g"], p["ln2_b"]), p["moe"],
                      cfg.capacity_factor, ep_axis,
                      router_topk=cfg.router_topk, tp_axis=tp_axis)
@@ -115,15 +116,17 @@ def moe_gpt_loss(params, tokens, targets, cfg: MoEGPTConfig,
                  ep_axis: Optional[str] = None,
                  tp_axis: Optional[str] = None,
                  sp_axis: Optional[str] = None,
-                 remat: bool = False) -> jnp.ndarray:
+                 remat: bool = False,
+                 seq_layout: str = "contiguous") -> jnp.ndarray:
     """Per-device next-token loss + Switch aux loss (local mean over this
     device's tokens, pmean'd over sequence shards — dp/ep averaging is
     the train step's job)."""
-    x = _embed(params, tokens, cfg, sp_axis)
+    x = _embed(params, tokens, cfg, sp_axis, seq_layout)
     aux_total = jnp.zeros((), jnp.float32)
 
     def apply_block(x, p):
-        return moe_transformer_block(x, p, cfg, ep_axis, tp_axis, sp_axis)
+        return moe_transformer_block(x, p, cfg, ep_axis, tp_axis, sp_axis,
+                                     seq_layout)
 
     apply_block = maybe_remat(apply_block, remat)
     for p in params["blocks"]:
@@ -142,7 +145,8 @@ def moe_gpt_pp_loss(params, tokens, targets, cfg: MoEGPTConfig,
                     tp_axis: Optional[str] = None,
                     sp_axis: Optional[str] = None,
                     remat: bool = False,
-                    vma_axes: tuple = ()) -> jnp.ndarray:
+                    vma_axes: tuple = (),
+                    seq_layout: str = "contiguous") -> jnp.ndarray:
     """Pipelined MoE loss (inside shard_map over pp): ``params["blocks"]``
     is THIS stage's stacked MoE-block slab. Same conventions as
     ``gpt_pp_loss`` — the returned scalar is per-device (masked nll on the
@@ -154,11 +158,12 @@ def moe_gpt_pp_loss(params, tokens, targets, cfg: MoEGPTConfig,
     if B % n_micro != 0:
         raise ValueError(f"local batch {B} not divisible by {n_micro} "
                          "microbatches")
-    x = _embed(params, tokens, cfg, sp_axis)
+    x = _embed(params, tokens, cfg, sp_axis, seq_layout)
     x_mb = x.reshape(n_micro, B // n_micro, S_loc, x.shape[-1])
 
     def blk(h, p):
-        return moe_transformer_block(h, p, cfg, ep_axis, tp_axis, sp_axis)
+        return moe_transformer_block(h, p, cfg, ep_axis, tp_axis, sp_axis,
+                                     seq_layout)
 
     y_mb, aux_total = pipeline_apply(
         x_mb, params["blocks"], blk, pp_axis,

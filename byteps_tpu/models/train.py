@@ -553,6 +553,7 @@ def make_gpt_moe_train_step(
     partition_bytes: Optional[int] = None,
     remat: bool = False,
     zero_1: bool = False,
+    seq_layout: str = "contiguous",
 ):
     """Expert-parallel MoE GPT train step over a (dp, ep[, tp][, sp]) mesh.
 
@@ -570,6 +571,12 @@ def make_gpt_moe_train_step(
     ep-invariant leaves run explicitly, then each (ep group, dp worker)
     compresses its grads over dp with its own EF/momentum state.
 
+    ``seq_layout="zigzag"`` runs the load-balanced causal ring over sp —
+    feed tokens/targets pre-permuted with ``zigzag_permutation``, as for
+    the dense factory. (The load-balancing aux term is a function of
+    per-device router statistics, so its VALUE legitimately depends on
+    how tokens shard; the nll is exact across layouts.)
+
     Returns ``(step, params, opt_state, batch_sharding)``.
     """
     from byteps_tpu.models.moe_gpt import (
@@ -585,6 +592,7 @@ def make_gpt_moe_train_step(
             "mesh has a pp axis — use make_gpt_moe_pp_train_step for "
             "pipelined MoE"
         )
+    _check_seq_layout(seq_layout)
     use_vma = compression_params is None and not zero_1
     _check_compression_mesh(use_vma, tp, sp)
     ep_size = mesh.shape[ep] if ep is not None else 1
@@ -605,7 +613,8 @@ def make_gpt_moe_train_step(
     batch_spec = P((dp, ep) if dp and ep else (dp or ep), sp)
     resym = _make_resymmetrize(pspecs, dp)
     loss_fn = functools.partial(moe_gpt_loss, cfg=cfg, ep_axis=ep,
-                                tp_axis=tp, sp_axis=sp, remat=remat)
+                                tp_axis=tp, sp_axis=sp, remat=remat,
+                                seq_layout=seq_layout)
 
     def build_jit(pb):
         tx = _make_tx(mesh, base_tx, compression_params, pb, dp, **tx_kw)
@@ -658,6 +667,7 @@ def make_gpt_moe_pp_train_step(
     partition_bytes: Optional[int] = None,
     remat: bool = False,
     zero_1: bool = False,
+    seq_layout: str = "contiguous",
 ):
     """Pipelined MoE GPT over a (pp, dp[, ep][, tp][, sp]) mesh — the full
     composition: GPipe microbatch pipelining whose stages hold MoE blocks
@@ -668,6 +678,10 @@ def make_gpt_moe_pp_train_step(
     pp-replicated leaves psum over pp, then everything divides by ep
     (mean of per-device local means); dp aggregation stays in
     DistributedOptimizer.
+
+    ``seq_layout="zigzag"`` follows the same pre-permuted-input contract
+    as every other factory (see :func:`make_gpt_moe_train_step`'s note on
+    the aux term).
 
     Returns ``(step, params, opt_state, batch_sharding)``;
     ``params["blocks"]`` is the stacked MoE-block slab.
@@ -683,6 +697,7 @@ def make_gpt_moe_pp_train_step(
     ep, tp, sp = _axis(mesh, "ep"), _axis(mesh, "tp"), _axis(mesh, "sp")
     if pp is None:
         raise ValueError("mesh has no pp axis — use make_gpt_moe_train_step")
+    _check_seq_layout(seq_layout)
     use_vma = compression_params is None and not zero_1
     _check_compression_mesh(use_vma, tp, sp)
     nstages = mesh.shape[pp]
@@ -718,6 +733,7 @@ def make_gpt_moe_pp_train_step(
         moe_gpt_pp_loss, cfg=cfg, pp_axis=pp, n_micro=n_micro,
         ep_axis=ep, tp_axis=tp, sp_axis=sp, remat=remat,
         vma_axes=tuple(mesh.axis_names) if use_vma else (),
+        seq_layout=seq_layout,
     )
 
     def build_jit(pb):
