@@ -59,12 +59,6 @@ def init_cache(cfg: GPTConfig, batch: int, h_loc: Optional[int] = None,
     )
 
 
-def _wants_flash(T, S, D):
-    from byteps_tpu.ops.flash_attention import supported, use_pallas
-
-    return use_pallas() and supported(T, S, D)
-
-
 def _cached_attention(q, k_cache, v_cache, q_pos0):
     """q: (B, T, H, D) new queries at positions q_pos0..q_pos0+T-1;
     k/v_cache: (B, S_max, H, D) with the new keys already written.
@@ -105,15 +99,9 @@ def _attn_cached_half(x, p, cache_k, cache_v, pos0, cfg, tp_axis):
                                            (0, pos0, 0, 0))
     cache_v = jax.lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype),
                                            (0, pos0, 0, 0))
-    if kv_loc != h_loc and _wants_flash(T, cache_k.shape[1], head_dim):
-        # flash prefill needs equal heads — repeat once for the long
-        # prompt pass; decode (T=1, jnp path) attends grouped against
-        # the narrow cache with no materialized repeat
-        rep = h_loc // kv_loc
-        o = _cached_attention(q, jnp.repeat(cache_k, rep, axis=2),
-                              jnp.repeat(cache_v, rep, axis=2), pos0)
-    else:
-        o = _cached_attention(q, cache_k, cache_v, pos0)
+    # GQA is native in attention_lse on both backends — prefill and
+    # decode read the narrow cache directly, no repeat anywhere
+    o = _cached_attention(q, cache_k, cache_v, pos0)
     o = o.reshape(B, T, h_loc * head_dim)
     x = x + row_parallel_matmul(o, p["wo"].astype(x.dtype), tp_axis,
                                 p["bo"].astype(x.dtype))

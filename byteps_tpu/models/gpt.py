@@ -185,10 +185,10 @@ def _attention(x, p, head_dim: int, tp_axis, sp_axis, causal: bool = True,
         pos = _positions(S, sp_axis, seq_layout)
         q = rope_rotate(q, pos, rope_base)
         k = rope_rotate(k, pos, rope_base)
-    if kv_loc != h_loc:
-        # GQA: repeat each kv head over its query group
-        k = jnp.repeat(k, h_loc // kv_loc, axis=2)
-        v = jnp.repeat(v, h_loc // kv_loc, axis=2)
+    # GQA: k/v stay NARROW (kv_loc heads) — the flash kernels associate
+    # query heads to kv heads by grid-index arithmetic, the jnp lse path
+    # by grouped einsum, and the rings rotate the narrow blocks (G× less
+    # ICI wire); only the legacy jnp contiguous-ring repeats internally
     if seq_layout == "zigzag":
         o = zigzag_ring_attention(q, k, v, sp_axis, causal=causal)
     elif seq_layout == "contiguous":

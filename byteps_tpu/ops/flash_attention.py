@@ -191,14 +191,29 @@ def _fwd_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = jnp.where(l > 0.0, m_scr[:] + jnp.log(l_safe), _NEG)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "interpret"))
-def _fwd(q3, k3, v3, qoff, koff, causal: bool, interpret: bool):
-    """q3/k3/v3: (BH, S, D) → (o (BH, Sq, D), lse (BH, Sq, 1) f32)."""
+def _kv_index(heads: int, kv_heads: int):
+    """Grid-index map from a (batch·H) query row to its (batch·Hkv) kv
+    row — the GQA head-group association done by pure index arithmetic,
+    so grouped attention reads the NARROW k/v (no repeated copies
+    anywhere). Identity when heads == kv_heads."""
+    if heads == kv_heads:
+        return lambda b: b
+    g = heads // kv_heads
+    return lambda b: (b // heads) * kv_heads + (b % heads) // g
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "interpret",
+                                             "heads", "kv_heads"))
+def _fwd(q3, k3, v3, qoff, koff, causal: bool, interpret: bool,
+         heads: int, kv_heads: int):
+    """q3: (B·H, S, D), k3/v3: (B·Hkv, S, D) →
+    (o (B·H, Sq, D), lse (B·H, Sq, 1) f32)."""
     BH, Sq, D = q3.shape
     Sk = k3.shape[1]
     bq, bk = _pick_block(Sq), _pick_block(Sk)
     nq, nk = Sq // bq, Sk // bk
     scale = 1.0 / (D ** 0.5)
+    kv = _kv_index(heads, kv_heads)
     kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                              bq=bq, bk=bk, nk=nk)
     return pl.pallas_call(
@@ -208,8 +223,8 @@ def _fwd(q3, k3, v3, qoff, koff, causal: bool, interpret: bool):
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, bq, D), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, qi, ki: (kv(b), ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, qi, ki: (kv(b), ki, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, qi, ki: (b, qi, 0)),
@@ -284,11 +299,12 @@ def _dq_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 def _dkv_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                 dl_ref, dlse_ref, dk_ref, dv_ref, dk_scr, dv_scr,
-                *, scale, causal, bq, bk, nq):
+                *, scale, causal, bq, bk, nq, group=1):
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    j = pl.program_id(2)            # (group member, q block) flattened
+    qi = j % nq
 
-    @pl.when(qi == 0)
+    @pl.when(j == 0)
     def _init():
         dk_scr[:] = jnp.zeros(dk_scr.shape, jnp.float32)
         dv_scr[:] = jnp.zeros(dv_scr.shape, jnp.float32)
@@ -330,19 +346,22 @@ def _dkv_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     else:
         _tile()
 
-    @pl.when(qi == nq - 1)
+    @pl.when(j == nq * group - 1)
     def _finish():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "interpret"))
+@functools.partial(jax.jit, static_argnames=("causal", "interpret",
+                                             "heads", "kv_heads"))
 def _bwd(q3, k3, v3, o3, lse, qoff, koff, do3, dlse,
-         causal: bool, interpret: bool):
+         causal: bool, interpret: bool, heads: int, kv_heads: int):
     BH, Sq, D = q3.shape
-    Sk = k3.shape[1]
+    BHkv, Sk = k3.shape[0], k3.shape[1]
     bq, bk = _pick_block(Sq), _pick_block(Sk)
     nq, nk = Sq // bq, Sk // bk
+    group = heads // kv_heads
+    kv = _kv_index(heads, kv_heads)
     scale = 1.0 / (D ** 0.5)
     # delta_i = Σ_d dO_id · O_id  (one fused elementwise pass, f32)
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
@@ -358,8 +377,8 @@ def _bwd(q3, k3, v3, o3, lse, qoff, koff, do3, dlse,
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, bq, D), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, qi, ki: (kv(b), ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, qi, ki: (kv(b), ki, 0)),
             pl.BlockSpec((1, bq, D), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, qi, ki: (b, qi, 0)),
@@ -374,29 +393,36 @@ def _bwd(q3, k3, v3, o3, lse, qoff, koff, do3, dlse,
         interpret=interpret,
     )(qoff, koff, q3, k3, v3, do3, lse, delta, dlse)
 
+    # dkv iterates every (group member, q block) for its kv head: the q
+    # row for grid point (b, ki, j) is the (j // nq)-th member of kv row
+    # b's group, q block j % nq — one scratch accumulation covers the
+    # whole group, so dk/dv come out kv-narrow with no reduction pass
+    def qrow(b, j):
+        return (b // kv_heads) * heads + (b % kv_heads) * group + j // nq
+
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq),
-        grid=(BH, nk, nq),
+                          bq=bq, bk=bk, nq=nq, group=group),
+        grid=(BHkv, nk, nq * group),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, bq, D), lambda b, ki, qi: (b, qi, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, ki, qi: (b, ki, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, ki, qi: (b, ki, 0)),
-            pl.BlockSpec((1, bq, D), lambda b, ki, qi: (b, qi, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, ki, qi: (b, qi, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, ki, qi: (b, qi, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, ki, j: (qrow(b, j), j % nq, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, ki, j: (b, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, ki, j: (b, ki, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, ki, j: (qrow(b, j), j % nq, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, ki, j: (qrow(b, j), j % nq, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, ki, j: (qrow(b, j), j % nq, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, ki, j: (qrow(b, j), j % nq, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, D), lambda b, ki, qi: (b, ki, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, ki, j: (b, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, ki, j: (b, ki, 0)),
         ],
         out_shape=[
-            _out_struct((BH, Sk, D), k3.dtype,
+            _out_struct((BHkv, Sk, D), k3.dtype,
                         q3, k3, v3, do3, lse, delta, dlse, qoff, koff),
-            _out_struct((BH, Sk, D), v3.dtype,
+            _out_struct((BHkv, Sk, D), v3.dtype,
                         q3, k3, v3, do3, lse, delta, dlse, qoff, koff),
         ],
         scratch_shapes=[
@@ -417,22 +443,25 @@ def _bwd(q3, k3, v3, o3, lse, qoff, koff, do3, dlse,
 # attention passes axis_index-derived offsets), and float avoids the
 # symbolic-zero cotangent dance custom_vjp requires for int-dtype
 # arguments — their gradient is identically zero.
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
-def _flash_core(q3, k3, v3, qoff, koff, causal: bool, interpret: bool):
-    return _fwd(q3, k3, v3, qoff, koff, causal, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_core(q3, k3, v3, qoff, koff, causal: bool, interpret: bool,
+                heads: int, kv_heads: int):
+    return _fwd(q3, k3, v3, qoff, koff, causal, interpret, heads, kv_heads)
 
 
-def _flash_core_fwd(q3, k3, v3, qoff, koff, causal, interpret):
-    o, lse = _fwd(q3, k3, v3, qoff, koff, causal, interpret)
+def _flash_core_fwd(q3, k3, v3, qoff, koff, causal, interpret, heads,
+                    kv_heads):
+    o, lse = _fwd(q3, k3, v3, qoff, koff, causal, interpret, heads,
+                  kv_heads)
     return (o, lse), (q3, k3, v3, o, lse, qoff, koff)
 
 
-def _flash_core_bwd(causal, interpret, res, cts):
+def _flash_core_bwd(causal, interpret, heads, kv_heads, res, cts):
     q3, k3, v3, o3, lse, qoff, koff = res
     do3, dlse = cts
     dlse = jnp.asarray(dlse, jnp.float32)
     dq, dk, dv = _bwd(q3, k3, v3, o3, lse, qoff, koff, do3, dlse,
-                      causal, interpret)
+                      causal, interpret, heads, kv_heads)
     zero = jnp.zeros((1, 1), jnp.float32)
     return dq, dk, dv, zero, zero
 
@@ -464,6 +493,13 @@ def flash_attention_lse(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     :func:`supported` / :func:`use_pallas` first.
     """
     B, Sq, H, D = q.shape
+    Hkv = k.shape[2]
+    if H % Hkv != 0:
+        raise ValueError(f"q heads ({H}) not a multiple of kv heads "
+                         f"({Hkv})")
+    if v.shape[2] != Hkv:
+        raise ValueError(f"k has {Hkv} heads but v has {v.shape[2]} — "
+                         "GQA narrows k and v together")
     if not supported(Sq, k.shape[1], D):
         raise ValueError(
             f"flash_attention_lse: unsupported shape Sq={Sq} Sk={k.shape[1]} "
@@ -476,7 +512,8 @@ def flash_attention_lse(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     interpret = jax.default_backend() != "tpu"
     q3, k3, v3, qoff, koff = _unify_vma(_to3(q), _to3(k), _to3(v),
                                         qoff, koff)
-    o3, lse3 = _flash_core(q3, k3, v3, qoff, koff, causal, interpret)
+    o3, lse3 = _flash_core(q3, k3, v3, qoff, koff, causal, interpret,
+                           H, Hkv)
     o = _from3(o3, B, H)
     lse = lse3.reshape(B, H, Sq).transpose(0, 2, 1)           # (B, Sq, H)
     return o, lse
@@ -535,10 +572,10 @@ def attention_lse(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Backend-dispatching (o, lse) attention with global offsets — the
     building block ring schedules merge with :func:`merge_attention`.
-    Mismatched head counts (GQA) route to the grouped jnp path (the
-    flash kernel needs equal heads — repeat k/v first to use it)."""
-    if (q.shape[2] == k.shape[2] and use_pallas()
-            and supported(q.shape[1], k.shape[1], q.shape[-1])):
+    Grouped-query attention (q heads a multiple of k/v heads) is native
+    on both backends — the kernel associates each query head with its kv
+    head by grid-index arithmetic, so the narrow k/v is read directly."""
+    if use_pallas() and supported(q.shape[1], k.shape[1], q.shape[-1]):
         return flash_attention_lse(q, k, v, q_offset, k_offset,
                                    causal=causal)
     return attention_lse_jnp(q, k, v, q_offset, k_offset, causal=causal)
@@ -557,6 +594,9 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     if not (use_pallas() and supported(Sq, Sk, D)):
+        if k.shape[2] != H:
+            o, _ = attention_lse_jnp(q, k, v, 0, 0, causal=causal)
+            return o
         return attention_jnp(q, k, v, causal=causal)
     o, _ = flash_attention_lse(q, k, v, 0, 0, causal=causal)
     return o

@@ -90,6 +90,12 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if _use_pallas() and _flash_supported(q.shape[1], k.shape[1],
                                           q.shape[-1]):
         return _ring_flash(q, k, v, sp_axis, n, causal)
+    if k.shape[2] != q.shape[2]:
+        # legacy jnp ring computes equal-headed blocks — widen GQA k/v
+        # here (the flash ring above rotates them narrow)
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     idx = jax.lax.axis_index(sp_axis)
     B, S_loc, H, D = q.shape
     scale = jnp.float32(1.0 / (D ** 0.5))

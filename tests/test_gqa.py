@@ -98,3 +98,31 @@ def test_bad_kv_heads_raises():
     bad = dataclasses.replace(GPTConfig.tiny(), n_kv_heads=3)
     with pytest.raises(ValueError, match="n_kv_heads"):
         gpt_init(jax.random.PRNGKey(0), bad)
+
+
+def test_gqa_flash_ring_rotates_narrow_kv(monkeypatch):
+    """Forced-pallas sp ring with GQA: the ring rotates kv-narrow blocks
+    and every step's kernel reads them via the group index map."""
+    monkeypatch.setenv("BYTEPS_KERNEL_BACKEND", "pallas")
+    from byteps_tpu.ops.flash_attention import attention_lse_jnp
+    from byteps_tpu.parallel import ring_attention
+
+    B, S, H, Hkv, D = 2, 64, 4, 2, 16
+    ks = jax.random.split(jax.random.PRNGKey(40), 3)
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, Hkv, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, Hkv, D), jnp.float32)
+    want, _ = attention_lse_jnp(q, k, v, 0, 0, causal=True)
+
+    mesh = make_mesh(MeshAxes(sp=4), devices=jax.devices()[:4])
+    got = jax.jit(
+        jax.shard_map(
+            lambda a, b, c: ring_attention(a, b, c, "sp"),
+            mesh=mesh,
+            in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(None, "sp"),
+            check_vma=False,
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)

@@ -384,9 +384,9 @@ def test_moe_zigzag_matches_contiguous():
 
 
 def test_moe_pp_zigzag_runs_and_converges():
-    """The full composition with zigzag: pp×dp×ep... sp folded in is more
-    devices than the harness has, so exercise pp×ep×sp — microbatch
-    reshape, per-microbatch routing, stage aux, zigzag positions."""
+    """The full composition with zigzag on a pp×ep×sp mesh — microbatch
+    reshape, ep all_to_all expert routing, stage aux, zigzag positions
+    all interacting in one program."""
     import dataclasses
 
     import optax
@@ -401,7 +401,7 @@ def test_moe_pp_zigzag_runs_and_converges():
     cfg = MoEGPTConfig.tiny()
     tokens, targets = synthetic_batch(jax.random.PRNGKey(61), cfg, 4, 32)
     mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
-                ("pp", "dp", "sp"))
+                ("pp", "ep", "sp"))
     perm = np.asarray(zigzag_permutation(32, 2))
     step, params, opt_state, bsh = make_gpt_moe_pp_train_step(
         cfg, mesh, optax.adam(1e-2), n_micro=2, seq_layout="zigzag")
