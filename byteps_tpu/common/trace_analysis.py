@@ -1,0 +1,358 @@
+"""dPRO-style analysis of byteps_tpu chrome traces (SURVEY §5.1).
+
+The joapolarbear fork exists largely to FEED its per-stage chrome traces to
+dPRO (MLSys'22), which builds a global dataflow DAG from per-worker traces
+and attributes step time to stages / finds the critical path. This module is
+the TPU build's in-tree equivalent of that analysis pass: point it at a
+trace from ``BYTEPS_TRACE_ON=1`` (or a ``merge_traces`` output combining
+worker + server timelines) and it reports
+
+* per-(rank, stage) service-time stats and busy fraction,
+* per-partition lifecycles (REDUCE → … → COPYH2D chained by occurrence),
+  splitting end-to-end latency into service time vs queue wait,
+* per-step makespan with the partition that closed each step (the
+  critical partition — dPRO's critical-path attribution at the
+  granularity this scheduler controls),
+* comm/comm overlap: how much PUSH/PULL wall time is hidden behind the
+  ICI REDUCE stage (the pipelining the priority scheduler exists to buy).
+
+CLI::
+
+    python -m byteps_tpu.common.trace_analysis merged.json [--top 5] [--json]
+
+Device-side compute lives in XLA and is profiled by ``jax.profiler``; this
+pass covers the framework tier (scheduler, codec, DCN transport, server),
+which is the tier the reference's timeline covers too.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# Stage order of the hybrid pipeline (reference QueueType order,
+# byteps/common/common.h) — used to sort lifecycle rows for display;
+# unknown stages sort after, alphabetically.
+_STAGE_ORDER = [
+    "REDUCE", "COPYD2H", "COMPRESS", "PUSH", "PULL",
+    "DECOMPRESS", "COPYH2D", "PUSHPULL",
+    "PUSH_RECV", "SUM", "PULL_RESP", "ROUND",
+]
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    """Read a chrome trace file; accepts {traceEvents: [...]} or a bare list."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        if "traceEvents" not in doc:
+            raise ValueError(
+                f"{path}: not a chrome trace (object without 'traceEvents'; "
+                f"keys: {sorted(doc)[:8]})"
+            )
+        events = doc["traceEvents"]
+    else:
+        events = doc
+    return [e for e in events if isinstance(e, dict)]
+
+
+def _complete_events(events: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    return [
+        e for e in events
+        if e.get("ph") == "X" and "ts" in e and "dur" in e
+    ]
+
+
+def _percentile(sorted_vals: Sequence[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[int(idx)]
+
+
+def _union_intervals(
+    iv: List[Tuple[float, float]],
+) -> List[Tuple[float, float]]:
+    """Merge overlapping [start, end) intervals; returns sorted disjoint set."""
+    if not iv:
+        return []
+    iv = sorted(iv)
+    out = [iv[0]]
+    for s, e in iv[1:]:
+        ls, le = out[-1]
+        if s <= le:
+            out[-1] = (ls, max(le, e))
+        else:
+            out.append((s, e))
+    return out
+
+
+def _overlap_len(a: List[Tuple[float, float]], b: List[Tuple[float, float]]) -> float:
+    """Total overlap between two DISJOINT-sorted interval sets."""
+    i = j = 0
+    total = 0.0
+    while i < len(a) and j < len(b):
+        s = max(a[i][0], b[j][0])
+        e = min(a[i][1], b[j][1])
+        if s < e:
+            total += e - s
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def stage_stats(events: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Per-(pid, stage) service-time stats over complete events.
+
+    ``busy_frac`` is the union of the stage's busy intervals over the whole
+    trace span — >0.5 on PUSH means the wire is the bottleneck; low busy
+    with high total means bursty (queue-limited) traffic.
+    """
+    xs = _complete_events(events)
+    if not xs:
+        return []
+    t0 = min(e["ts"] for e in xs)
+    t1 = max(e["ts"] + e["dur"] for e in xs)
+    span = max(t1 - t0, 1e-9)
+    groups: Dict[Tuple[Any, Any], List[Dict[str, Any]]] = {}
+    for e in xs:
+        groups.setdefault((e.get("pid"), e.get("tid")), []).append(e)
+
+    def stage_key(item):
+        (pid, tid), _ = item
+        try:
+            si = _STAGE_ORDER.index(tid)
+        except ValueError:
+            si = len(_STAGE_ORDER)
+        # numeric ranks first in numeric order, then string pids (servers)
+        pid_key = (0, pid, "") if isinstance(pid, int) else (1, 0, str(pid))
+        return (pid_key, si, str(tid))
+
+    rows = []
+    for (pid, tid), evs in sorted(groups.items(), key=stage_key):
+        durs = sorted(e["dur"] for e in evs)
+        busy = _union_intervals([(e["ts"], e["ts"] + e["dur"]) for e in evs])
+        busy_us = sum(e - s for s, e in busy)
+        rows.append({
+            "pid": pid,
+            "stage": tid,
+            "count": len(durs),
+            "total_us": sum(durs),
+            "mean_us": sum(durs) / len(durs),
+            "p50_us": _percentile(durs, 0.5),
+            "p95_us": _percentile(durs, 0.95),
+            "max_us": durs[-1],
+            "busy_frac": busy_us / span,
+        })
+    return rows
+
+
+def partition_lifecycles(
+    events: Sequence[Dict[str, Any]],
+) -> List[Dict[str, Any]]:
+    """Chain each partition's per-stage events into lifecycles.
+
+    Events for one partition share (pid, name); the i-th occurrence of a
+    partition in EACH stage belongs to round i (stages run in pipeline
+    order, so per-stage occurrence index is the round number — the same
+    reconstruction dPRO does from the reference's traces). A lifecycle's
+    ``latency`` is last-stage end − first-stage start; ``service`` the sum
+    of stage durations; ``queue_wait`` the difference (time spent parked in
+    the priority queues / awaiting the server round).
+    """
+    per_stage_seen: Dict[Tuple[Any, str, Any], int] = {}
+    rounds: Dict[Tuple[Any, str, int], List[Dict[str, Any]]] = {}
+    for e in sorted(_complete_events(events), key=lambda e: e["ts"]):
+        pid, name, tid = e.get("pid"), str(e.get("name")), e.get("tid")
+        if tid in ("PUSH_RECV", "SUM", "PULL_RESP", "ROUND"):
+            continue  # server rows: per-key, not per-partition-occurrence
+        occ = per_stage_seen.get((pid, name, tid), 0)
+        per_stage_seen[(pid, name, tid)] = occ + 1
+        rounds.setdefault((pid, name, occ), []).append(e)
+
+    out = []
+    for (pid, name, occ), evs in rounds.items():
+        evs.sort(key=lambda e: e["ts"])
+        start = evs[0]["ts"]
+        end = max(e["ts"] + e["dur"] for e in evs)
+        service = sum(e["dur"] for e in evs)
+        args = evs[0].get("args", {})
+        out.append({
+            "pid": pid,
+            "name": name,
+            "round": occ,
+            "stages": [e["tid"] for e in evs],
+            "start_us": start,
+            "end_us": end,
+            "latency_us": end - start,
+            "service_us": service,
+            "queue_wait_us": max(0.0, (end - start) - service),
+            "key": args.get("key"),
+            "priority": args.get("priority"),
+            "length": args.get("length"),
+        })
+    out.sort(key=lambda r: (r["round"], r["start_us"]))
+    return out
+
+
+def step_makespans(
+    lifecycles: Sequence[Dict[str, Any]],
+) -> List[Dict[str, Any]]:
+    """Per-round makespan + the critical (last-finishing) partition."""
+    by_round: Dict[int, List[Dict[str, Any]]] = {}
+    for lc in lifecycles:
+        by_round.setdefault(lc["round"], []).append(lc)
+    rows = []
+    for rnd in sorted(by_round):
+        lcs = by_round[rnd]
+        start = min(l["start_us"] for l in lcs)
+        end = max(l["end_us"] for l in lcs)
+        crit = max(lcs, key=lambda l: l["end_us"])
+        rows.append({
+            "round": rnd,
+            "partitions": len(lcs),
+            "makespan_us": end - start,
+            "critical_partition": crit["name"],
+            "critical_pid": crit["pid"],
+            "critical_latency_us": crit["latency_us"],
+            "critical_queue_wait_us": crit["queue_wait_us"],
+        })
+    return rows
+
+
+def comm_overlap(events: Sequence[Dict[str, Any]]) -> Dict[str, float]:
+    """How much DCN wire time (PUSH+PULL) is hidden behind ICI REDUCE.
+
+    The priority scheduler's whole job is to overlap partition N's PUSH
+    with partition N+1's REDUCE (SURVEY §3.2 — "the single most important
+    behavior to preserve"). ``hidden_frac`` == 0 means fully serialized;
+    → 1 means the wire rides entirely under compute-side reduction.
+    Overlap is per-rank (one rank's REDUCE cannot hide another rank's
+    wire time) and summed, so merged multi-rank traces read correctly.
+    """
+    reduce_iv: Dict[Any, List[Tuple[float, float]]] = {}
+    wire_iv: Dict[Any, List[Tuple[float, float]]] = {}
+    for e in _complete_events(events):
+        tid = e.get("tid")
+        iv = (e["ts"], e["ts"] + e["dur"])
+        if tid == "REDUCE":
+            reduce_iv.setdefault(e.get("pid"), []).append(iv)
+        elif tid in ("PUSH", "PULL"):
+            wire_iv.setdefault(e.get("pid"), []).append(iv)
+    reduce_us = wire_us = hidden = 0.0
+    for pid, ivs in wire_iv.items():
+        w = _union_intervals(ivs)
+        wire_us += sum(e - s for s, e in w)
+        hidden += _overlap_len(_union_intervals(reduce_iv.get(pid, [])), w)
+    for ivs in reduce_iv.values():
+        reduce_us += sum(e - s for s, e in _union_intervals(ivs))
+    return {
+        "reduce_busy_us": reduce_us,
+        "wire_busy_us": wire_us,
+        "hidden_us": hidden,
+        "hidden_frac": hidden / wire_us if wire_us else 0.0,
+    }
+
+
+def analyze(events: Sequence[Dict[str, Any]], top: int = 5) -> Dict[str, Any]:
+    """Full report over one trace's events."""
+    lifecycles = partition_lifecycles(events)
+    slowest = sorted(lifecycles, key=lambda l: -l["latency_us"])[:top]
+    xs = _complete_events(events)
+    span = (
+        max(e["ts"] + e["dur"] for e in xs) - min(e["ts"] for e in xs)
+        if xs else 0.0
+    )
+    return {
+        "span_us": span,
+        "events": len(xs),
+        "stages": stage_stats(events),
+        "steps": step_makespans(lifecycles),
+        "slowest_partitions": slowest,
+        "comm_overlap": comm_overlap(events),
+    }
+
+
+def _fmt_us(v: float) -> str:
+    return f"{v / 1e3:.3f}ms" if v >= 1e3 else f"{v:.1f}us"
+
+
+def render(report: Dict[str, Any]) -> str:
+    """Human-readable text report."""
+    out = []
+    out.append(
+        f"trace: {report['events']} complete events over "
+        f"{_fmt_us(report['span_us'])}"
+    )
+    out.append("")
+    out.append(f"{'pid':>6} {'stage':<14} {'n':>5} {'total':>10} "
+               f"{'mean':>9} {'p50':>9} {'p95':>9} {'max':>9} {'busy':>6}")
+    for r in report["stages"]:
+        out.append(
+            f"{str(r['pid']):>6} {str(r['stage']):<14} {r['count']:>5} "
+            f"{_fmt_us(r['total_us']):>10} {_fmt_us(r['mean_us']):>9} "
+            f"{_fmt_us(r['p50_us']):>9} {_fmt_us(r['p95_us']):>9} "
+            f"{_fmt_us(r['max_us']):>9} {r['busy_frac'] * 100:>5.1f}%"
+        )
+    steps = report["steps"]
+    if steps:
+        out.append("")
+        out.append("per-round makespan (critical partition = last to finish):")
+        for s in steps:
+            out.append(
+                f"  round {s['round']:>3}: {_fmt_us(s['makespan_us']):>10} "
+                f"over {s['partitions']} partitions; critical "
+                f"{s['critical_partition']} (pid {s['critical_pid']}, "
+                f"latency {_fmt_us(s['critical_latency_us'])}, "
+                f"queued {_fmt_us(s['critical_queue_wait_us'])})"
+            )
+    if report["slowest_partitions"]:
+        out.append("")
+        out.append("slowest partition lifecycles:")
+        for l in report["slowest_partitions"]:
+            out.append(
+                f"  {l['name']} r{l['round']} pid {l['pid']}: "
+                f"latency {_fmt_us(l['latency_us'])} = service "
+                f"{_fmt_us(l['service_us'])} + queue "
+                f"{_fmt_us(l['queue_wait_us'])} "
+                f"[{' > '.join(map(str, l['stages']))}]"
+            )
+    ov = report["comm_overlap"]
+    if ov["wire_busy_us"]:
+        out.append("")
+        out.append(
+            f"comm overlap: {_fmt_us(ov['hidden_us'])} of "
+            f"{_fmt_us(ov['wire_busy_us'])} PUSH/PULL wall time hidden "
+            f"behind REDUCE ({ov['hidden_frac'] * 100:.1f}%)"
+        )
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m byteps_tpu.common.trace_analysis",
+        description="dPRO-style per-stage analysis of a byteps_tpu "
+                    "chrome trace (see docs/timeline.md)",
+    )
+    ap.add_argument("trace", help="trace json (per-rank dump or merged)")
+    ap.add_argument("--top", type=int, default=5,
+                    help="slowest partitions to list (default 5)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON instead of text")
+    ns = ap.parse_args(argv)
+    report = analyze(load_events(ns.trace), top=ns.top)
+    if ns.json:
+        json.dump(report, sys.stdout, indent=1)
+        print()
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -90,12 +90,10 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if _use_pallas() and _flash_supported(q.shape[1], k.shape[1],
                                           q.shape[-1]):
         return _ring_flash(q, k, v, sp_axis, n, causal)
-    if k.shape[2] != q.shape[2]:
-        # legacy jnp ring computes equal-headed blocks — widen GQA k/v
-        # here (the flash ring above rotates them narrow)
-        rep = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    # GQA: the ring rotates the NARROW (Hkv-head) k/v blocks — G× less
+    # ICI wire per step — and widens only the in-hand block at compute
+    # time (the flash ring's kernels consume narrow blocks directly).
+    rep = q.shape[2] // k.shape[2]
     idx = jax.lax.axis_index(sp_axis)
     B, S_loc, H, D = q.shape
     scale = jnp.float32(1.0 / (D ** 0.5))
@@ -114,7 +112,9 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     for step in range(n):
         src = (idx - step) % n                # owner of the block we hold
         k_pos = src * S_loc + jnp.arange(S_loc)
-        m, l, o = _block_attn(qf, k_blk, v_blk, q_pos, k_pos, scale,
+        k_use = k_blk if rep == 1 else jnp.repeat(k_blk, rep, axis=2)
+        v_use = v_blk if rep == 1 else jnp.repeat(v_blk, rep, axis=2)
+        m, l, o = _block_attn(qf, k_use, v_use, q_pos, k_pos, scale,
                               causal, m, l, o)
         if step + 1 < n:
             k_blk = jax.lax.ppermute(k_blk, sp_axis, perm)

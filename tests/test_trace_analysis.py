@@ -1,0 +1,166 @@
+"""dPRO-style trace analysis (byteps_tpu/common/trace_analysis.py).
+
+Mirrors the reference's trace-consumption story (SURVEY §5.1: the fork's
+traces feed dPRO's per-stage attribution / critical path); here we pin the
+in-tree analyzer on a hand-built two-round hybrid trace with known answers,
+then smoke the CLI on a real recorder dump.
+"""
+
+import json
+import subprocess
+import sys
+
+from byteps_tpu.common.trace_analysis import (
+    analyze,
+    comm_overlap,
+    load_events,
+    partition_lifecycles,
+    render,
+    stage_stats,
+    step_makespans,
+)
+
+
+def _x(name, stage, ts, dur, pid=0, key=0, prio=0, length=4):
+    return {
+        "name": name, "cat": "byteps", "ph": "X", "ts": ts, "dur": dur,
+        "pid": pid, "tid": stage,
+        "args": {"key": key, "priority": prio, "length": length},
+    }
+
+
+def _two_round_trace():
+    """Two partitions x two rounds of REDUCE -> PUSH -> PULL.
+
+    Layout (us):
+      round 0: g.p0 REDUCE [0,10) PUSH [10,30) PULL [40,50)
+               g.p1 REDUCE [10,20) PUSH [30,40) PULL [50,70)
+      round 1: g.p0 REDUCE [100,110) PUSH [110,130) PULL [130,140)
+               g.p1 REDUCE [105,115) PUSH [130,145) PULL [145,150)
+    g.p1 round 0 has a 10us queue gap between REDUCE end (20) and PUSH
+    start (30); its lifecycle spans [10,70) = 60 latency, 40 service.
+    """
+    evs = [
+        _x("g.p0", "REDUCE", 0, 10, key=0), _x("g.p0", "PUSH", 10, 20, key=0),
+        _x("g.p0", "PULL", 40, 10, key=0),
+        _x("g.p1", "REDUCE", 10, 10, key=1), _x("g.p1", "PUSH", 30, 10, key=1),
+        _x("g.p1", "PULL", 50, 20, key=1),
+        _x("g.p0", "REDUCE", 100, 10, key=0), _x("g.p0", "PUSH", 110, 20, key=0),
+        _x("g.p0", "PULL", 130, 10, key=0),
+        _x("g.p1", "REDUCE", 105, 10, key=1), _x("g.p1", "PUSH", 130, 15, key=1),
+        _x("g.p1", "PULL", 145, 5, key=1),
+    ]
+    # a server row must not join partition lifecycles
+    evs.append(_x("k0", "SUM", 32, 3, pid="server0"))
+    return evs
+
+
+def test_stage_stats_groups_and_busy_fraction():
+    rows = stage_stats(_two_round_trace())
+    by = {(r["pid"], r["stage"]): r for r in rows}
+    red = by[(0, "REDUCE")]
+    assert red["count"] == 4
+    assert red["total_us"] == 40
+    assert red["mean_us"] == 10
+    # span is [0, 150); REDUCE busy union = [0,20)+[100,115) = 35us
+    assert abs(red["busy_frac"] - 35 / 150) < 1e-9
+    # stage rows follow pipeline order within a pid
+    stages = [r["stage"] for r in rows if r["pid"] == 0]
+    assert stages == ["REDUCE", "PUSH", "PULL"]
+
+
+def test_lifecycles_split_service_and_queue_wait():
+    lcs = partition_lifecycles(_two_round_trace())
+    assert len(lcs) == 4  # 2 partitions x 2 rounds; server row excluded
+    lc = next(l for l in lcs if l["name"] == "g.p1" and l["round"] == 0)
+    assert lc["stages"] == ["REDUCE", "PUSH", "PULL"]
+    assert lc["latency_us"] == 60
+    assert lc["service_us"] == 40
+    assert lc["queue_wait_us"] == 20
+    assert lc["key"] == 1
+
+
+def test_step_makespans_find_critical_partition():
+    steps = step_makespans(partition_lifecycles(_two_round_trace()))
+    assert [s["round"] for s in steps] == [0, 1]
+    r0 = steps[0]
+    assert r0["partitions"] == 2
+    assert r0["makespan_us"] == 70
+    assert r0["critical_partition"] == "g.p1"
+    r1 = steps[1]
+    assert r1["makespan_us"] == 50
+    assert r1["critical_partition"] == "g.p1"
+
+
+def test_comm_overlap_measures_hidden_wire_time():
+    ov = comm_overlap(_two_round_trace())
+    # wire union: [10,40)+[40,50)... => [10,70) minus gaps: PUSH/PULL cover
+    # [10,30)[30,40)[40,50)[50,70) = [10,70) = 60; round1: [110,130)[130,140)
+    # [130,145)[145,150) = [110,150) = 40 -> 100 total
+    assert ov["wire_busy_us"] == 100
+    # REDUCE [10,20) overlaps wire [10,70): 10us; [105,115) vs [110,150): 5us
+    assert ov["hidden_us"] == 15
+    assert abs(ov["hidden_frac"] - 0.15) < 1e-9
+
+
+def test_comm_overlap_is_per_rank():
+    """One rank's REDUCE must not count as hiding another rank's wire.
+
+    Both ranks fully serialized: rank 0 REDUCE [0,10) PUSH [10,20),
+    rank 1 REDUCE [10,20) PUSH [20,30). A trace-wide union would report
+    hidden_frac=0.5; the true per-rank answer is 0.
+    """
+    evs = [
+        _x("g.p0", "REDUCE", 0, 10, pid=0), _x("g.p0", "PUSH", 10, 10, pid=0),
+        _x("g.p0", "REDUCE", 10, 10, pid=1), _x("g.p0", "PUSH", 20, 10, pid=1),
+    ]
+    ov = comm_overlap(evs)
+    assert ov["wire_busy_us"] == 20
+    assert ov["hidden_us"] == 0
+    assert ov["hidden_frac"] == 0.0
+
+
+def test_render_and_full_report_shape():
+    rep = analyze(_two_round_trace(), top=2)
+    assert rep["events"] == 13
+    assert len(rep["slowest_partitions"]) == 2
+    assert rep["slowest_partitions"][0]["latency_us"] == 60
+    text = render(rep)
+    assert "critical g.p1" in text
+    assert "REDUCE" in text and "SUM" in text
+    assert "hidden behind REDUCE (15.0%)" in text
+
+
+def test_cli_on_recorder_dump(tmp_path):
+    """End-to-end: a TraceRecorder dump is analyzable via the CLI."""
+    from byteps_tpu.common.tracing import TraceRecorder
+
+    rec = TraceRecorder(enabled=True, trace_dir=str(tmp_path),
+                        start_step=1, end_step=10, rank=0)
+    rec.advance_to(1)
+    for ev in _two_round_trace():
+        if ev["pid"] == 0:
+            rec.complete_event(ev["name"], ev["tid"], ev["ts"], ev["dur"],
+                               ev["args"])
+    path = rec.dump()
+    assert path is not None
+    out = subprocess.run(
+        [sys.executable, "-m", "byteps_tpu.common.trace_analysis",
+         path, "--json"],
+        capture_output=True, text=True, check=True,
+    )
+    rep = json.loads(out.stdout)
+    assert rep["events"] == 12
+    assert {r["stage"] for r in rep["stages"]} == {"REDUCE", "PUSH", "PULL"}
+    # text mode too
+    out = subprocess.run(
+        [sys.executable, "-m", "byteps_tpu.common.trace_analysis", path],
+        capture_output=True, text=True, check=True,
+    )
+    assert "slowest partition lifecycles" in out.stdout
+
+
+def test_load_events_accepts_bare_list(tmp_path):
+    p = tmp_path / "bare.json"
+    p.write_text(json.dumps(_two_round_trace()))
+    assert len(load_events(str(p))) == 13
