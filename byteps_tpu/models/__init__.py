@@ -20,6 +20,10 @@ from byteps_tpu.models.moe_gpt import (
     MoEGPTConfig, moe_gpt_init, moe_gpt_loss, moe_gpt_param_specs,
     moe_gpt_pp_loss,
 )
+from byteps_tpu.models.vit import (
+    ViTConfig, vit_init, vit_forward, vit_loss, vit_param_specs,
+    synthetic_vit_batch,
+)
 from byteps_tpu.models.resnet import (
     ResNetConfig, resnet_init, resnet_forward, resnet_loss,
     resnet_param_specs,
@@ -35,4 +39,6 @@ __all__ = [
     "moe_gpt_pp_loss",
     "ResNetConfig", "resnet_init", "resnet_forward", "resnet_loss",
     "resnet_param_specs",
+    "ViTConfig", "vit_init", "vit_forward", "vit_loss",
+    "vit_param_specs", "synthetic_vit_batch",
 ]

@@ -1,0 +1,166 @@
+"""Vision Transformer family — image classification on transformer blocks.
+
+The reference ships no models (SURVEY §1: BytePS sits under a framework;
+its image workloads are torchvision's — example/pytorch/benchmark_byteps.py
+trains ResNet/VGG). This repo's model zoo covers those conv families with
+:mod:`byteps_tpu.models.resnet`; ViT rounds it out with the transformer
+image family, built TPU-first:
+
+* **Patchify is one reshape + one matmul** — no gather, no conv im2col:
+  ``(B, H, W, C) → (B, N, P·P·C) @ W_patch`` keeps the embedding on the
+  MXU as a single large GEMM.
+* **Mean-pool head instead of a [CLS] token** — pooling is a reduction
+  XLA fuses with the final layernorm, and it keeps the patch sequence
+  length a power-of-two-friendly ``(H/P)·(W/P)`` with no ragged +1 token
+  (which would force 197-length sequences off the MXU's preferred tiles).
+* Transformer blocks are shared verbatim with GPT/BERT
+  (:func:`byteps_tpu.models.gpt.transformer_block`, ``causal=False``), so
+  tensor parallelism (col/row-parallel projections) and per-block
+  rematerialization compose exactly as they do for the text families.
+
+Sequence parallelism is intentionally not plumbed: ViT sequences are
+``(image/patch)²`` ≈ 196 tokens — three orders of magnitude below where
+the sp ring pays for its ppermutes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from byteps_tpu.models.gpt import (
+    _layernorm,
+    block_init,
+    block_specs,
+    transformer_block,
+)
+from byteps_tpu.parallel.remat import maybe_remat
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    d_model: int = 768
+    n_heads: int = 12
+    n_layers: int = 12
+    d_ff: int = 3072
+    n_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if self.image_size % self.patch_size != 0:
+            raise ValueError(
+                f"image_size {self.image_size} not divisible by "
+                f"patch_size {self.patch_size}"
+            )
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @classmethod
+    def tiny(cls) -> "ViTConfig":
+        return cls(image_size=32, patch_size=8, channels=3, d_model=64,
+                   n_heads=4, n_layers=2, d_ff=128, n_classes=10)
+
+    @classmethod
+    def base(cls) -> "ViTConfig":
+        """ViT-B/16 shape, bf16 activations for the MXU."""
+        return cls(dtype=jnp.bfloat16)
+
+
+def vit_init(rng: jnp.ndarray, cfg: ViTConfig) -> Dict[str, Any]:
+    d = cfg.d_model
+    std = 0.02
+    keys = jax.random.split(rng, 3 + cfg.n_layers)
+    patch_dim = cfg.patch_size * cfg.patch_size * cfg.channels
+
+    def dense(key, shape):
+        return jax.random.normal(key, shape, jnp.float32) * std
+
+    return {
+        "w_patch": dense(keys[0], (patch_dim, d)),
+        "b_patch": jnp.zeros((d,), jnp.float32),
+        "wpe": dense(keys[1], (cfg.n_patches, d)),
+        "blocks": [
+            block_init(keys[3 + li], d, cfg.d_ff,
+                       cfg.n_heads * cfg.head_dim, cfg.n_layers)
+            for li in range(cfg.n_layers)
+        ],
+        "ln_f_g": jnp.ones((d,), jnp.float32),
+        "ln_f_b": jnp.zeros((d,), jnp.float32),
+        "w_head": dense(keys[2], (d, cfg.n_classes)),
+        "b_head": jnp.zeros((cfg.n_classes,), jnp.float32),
+    }
+
+
+def vit_param_specs(cfg: ViTConfig, tp_axis: Optional[str]) -> Dict[str, Any]:
+    return {
+        "w_patch": P(), "b_patch": P(),
+        "wpe": P(),
+        "blocks": [block_specs(tp_axis) for _ in range(cfg.n_layers)],
+        "ln_f_g": P(), "ln_f_b": P(),
+        "w_head": P(), "b_head": P(),
+    }
+
+
+def patchify(images: jnp.ndarray, patch: int) -> jnp.ndarray:
+    """(B, H, W, C) → (B, N, patch·patch·C) by pure reshape/transpose."""
+    B, H, W, C = images.shape
+    gh, gw = H // patch, W // patch
+    x = images.reshape(B, gh, patch, gw, patch, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)            # (B, gh, gw, p, p, C)
+    return x.reshape(B, gh * gw, patch * patch * C)
+
+
+def vit_forward(params, images: jnp.ndarray, cfg: ViTConfig,
+                tp_axis: Optional[str] = None,
+                remat: bool = False) -> jnp.ndarray:
+    """(B, H, W, C) images → f32 class logits (B, n_classes)."""
+    x = patchify(images.astype(cfg.dtype), cfg.patch_size)
+    x = x @ params["w_patch"].astype(x.dtype) + params["b_patch"].astype(x.dtype)
+    x = x + params["wpe"].astype(x.dtype)
+
+    def apply_block(x, p):
+        return transformer_block(x, p, cfg.head_dim, tp_axis, None,
+                                 causal=False)
+
+    apply_block = maybe_remat(apply_block, remat)
+    for p in params["blocks"]:
+        x = apply_block(x, p)
+    x = _layernorm(x, params["ln_f_g"], params["ln_f_b"])
+    pooled = x.astype(jnp.float32).mean(axis=1)          # (B, d)
+    return pooled @ params["w_head"] + params["b_head"]
+
+
+def vit_loss(params, images, labels, cfg: ViTConfig,
+             dp_axis: Optional[str] = None,
+             tp_axis: Optional[str] = None,
+             remat: bool = False) -> jnp.ndarray:
+    """Mean softmax cross-entropy; dp mean via pmean when sharded."""
+    logits = vit_forward(params, images, cfg, tp_axis=tp_axis, remat=remat)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+    if dp_axis is not None:
+        nll = jax.lax.pmean(nll, dp_axis)
+    return nll
+
+
+def synthetic_vit_batch(rng: jnp.ndarray, cfg: ViTConfig, batch: int):
+    """Random (images, labels) classification batch."""
+    k1, k2 = jax.random.split(rng)
+    images = jax.random.normal(
+        k1, (batch, cfg.image_size, cfg.image_size, cfg.channels),
+        jnp.float32)
+    labels = jax.random.randint(k2, (batch,), 0, cfg.n_classes)
+    return images, labels
