@@ -1,0 +1,6 @@
+from byteps_tpu.data.loader import (
+    PrefetchLoader,
+    shard_batch,
+)
+
+__all__ = ["PrefetchLoader", "shard_batch"]

@@ -1,0 +1,158 @@
+"""Input pipeline: sharded host→device prefetch.
+
+The reference has no data loader of its own (SURVEY §1: models and data
+come from the host framework — its examples use torch DataLoader /
+tf.data). On TPU the host→device hop is the part the framework must own:
+a training step that blocks on `device_put` serializes PCIe/DMA transfer
+with MXU compute, and on a multi-host pod each controller must place its
+process-local rows into one globally-sharded array. This module covers
+both:
+
+* :func:`shard_batch` — put one host batch (a pytree of numpy/jax
+  arrays) onto a `NamedSharding`, using the process-local assembly path
+  (`jax.make_array_from_process_local_data`) whenever the runtime spans
+  several controllers (`BYTEPS_JAX_DISTRIBUTED` global-mesh mode).
+* :class:`PrefetchLoader` — wraps any host-batch iterator and runs
+  `shard_batch` in a background thread, keeping up to ``depth + 1``
+  batches resident on device ahead of the consumer (``depth`` queued
+  plus the one the producer holds while the queue is full), so batch
+  t+1's H2D transfer rides under batch t's compute (the same overlap
+  the reference gets from DataLoader worker processes + pinned-memory
+  `cuda()` copies).
+
+JAX dispatch is asynchronous, but `device_put` of a large host batch
+still costs wall time on the dispatching thread (layout + DMA enqueue);
+moving it off the training thread is what buys the overlap.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterable, Iterator, Optional
+
+import jax
+
+from byteps_tpu.common.logging import get_logger
+
+log = get_logger("data")
+
+
+def _is_multiprocess() -> bool:
+    try:
+        return jax.process_count() > 1
+    except RuntimeError:  # jax.distributed not initialized
+        return False
+
+
+def shard_batch(batch: Any, sharding: Any) -> Any:
+    """Place a host batch (pytree) onto device(s) under ``sharding``.
+
+    ``sharding`` is either one `jax.sharding.Sharding` applied to every
+    leaf or a pytree of shardings matching ``batch``. Single-controller:
+    plain `device_put`. Multi-controller (global-mesh mode): each leaf is
+    this process's LOCAL rows; they are assembled into the global sharded
+    array with `jax.make_array_from_process_local_data` (the data-parallel
+    contract: every host feeds its own slice of the global batch).
+    """
+    one = isinstance(sharding, jax.sharding.Sharding)
+    if _is_multiprocess():
+        def put(x, s):
+            return jax.make_array_from_process_local_data(s, x)
+    else:
+        def put(x, s):
+            return jax.device_put(x, s)
+    if one:
+        return jax.tree.map(lambda x: put(x, sharding), batch)
+    return jax.tree.map(put, batch, sharding)
+
+
+class PrefetchLoader:
+    """Iterate device-resident, sharded batches ``depth`` ahead of use.
+
+    >>> loader = PrefetchLoader(host_batches, batch_sharding, depth=2)
+    >>> for tokens, targets in loader:
+    ...     loss, params, opt_state = step(params, opt_state, tokens, targets)
+
+    The background thread stops at source exhaustion, on `close()`, or
+    when an error occurs (re-raised in the consumer). Always a context
+    manager; iterating twice is not supported (one pass per source
+    iterator, like the reference's DataLoader epochs).
+    """
+
+    _DONE = object()
+
+    def __init__(self, source: Iterable[Any], sharding: Any,
+                 depth: int = 2) -> None:
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._source = iter(source)
+        self._sharding = sharding
+        self._q: "queue.Queue[Any]" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._err: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._run, name="byteps-prefetch", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            for batch in self._source:
+                if self._stop.is_set():
+                    return
+                dev = shard_batch(batch, self._sharding)
+                # blocks when `depth` batches are already waiting — the
+                # backpressure bounds residency at depth + 1 (this `dev`
+                # plus the queue)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(dev, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised in consumer
+            self._err = e
+        finally:
+            while not self._stop.is_set():
+                try:
+                    self._q.put(self._DONE, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self) -> Any:
+        if self._stop.is_set():
+            raise StopIteration
+        item = self._q.get()
+        if item is self._DONE:
+            # terminal: further __next__ calls must keep raising (the
+            # producer is dead and will never put again)
+            self._stop.set()
+            self._thread.join()
+            if self._err is not None:
+                err, self._err = self._err, None
+                raise err
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        """Stop prefetching and release the thread (idempotent)."""
+        self._stop.set()
+        # unblock a producer waiting on a full queue
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "PrefetchLoader":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()

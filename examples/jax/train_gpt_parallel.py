@@ -22,6 +22,7 @@ if os.environ.get("JAX_PLATFORMS"):
 
 import optax
 
+from byteps_tpu.data import PrefetchLoader
 from byteps_tpu.models import GPTConfig, MoEGPTConfig
 from byteps_tpu.models.train import (
     make_gpt_moe_train_step,
@@ -64,15 +65,19 @@ def main():
         )
     print(f"mode={args.mode} mesh={dict(mesh.shape)}", flush=True)
 
-    for i in range(args.steps):
-        tokens, targets = synthetic_batch(
-            jax.random.PRNGKey(i), cfg, args.batch_size, args.seq
-        )
-        tokens = jax.device_put(tokens, bsh)
-        targets = jax.device_put(targets, bsh)
-        loss, params, opt_state = step(params, opt_state, tokens, targets)
-        if i % 5 == 0 or i == args.steps - 1:
-            print(f"step {i}: loss={float(loss):.4f}", flush=True)
+    def host_batches():
+        for i in range(args.steps):
+            yield synthetic_batch(
+                jax.random.PRNGKey(i), cfg, args.batch_size, args.seq
+            )
+
+    # PrefetchLoader device_puts batch t+1 on a background thread while
+    # batch t trains (byteps_tpu/data: the framework's input pipeline)
+    with PrefetchLoader(host_batches(), bsh, depth=2) as loader:
+        for i, (tokens, targets) in enumerate(loader):
+            loss, params, opt_state = step(params, opt_state, tokens, targets)
+            if i % 5 == 0 or i == args.steps - 1:
+                print(f"step {i}: loss={float(loss):.4f}", flush=True)
 
 
 if __name__ == "__main__":
