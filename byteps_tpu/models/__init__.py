@@ -20,6 +20,10 @@ from byteps_tpu.models.moe_gpt import (
     MoEGPTConfig, moe_gpt_init, moe_gpt_loss, moe_gpt_param_specs,
     moe_gpt_pp_loss,
 )
+from byteps_tpu.models.t5 import (
+    T5Config, t5_init, t5_forward, t5_encode, t5_decode, t5_loss,
+    t5_param_specs, synthetic_seq2seq_batch,
+)
 from byteps_tpu.models.vit import (
     ViTConfig, vit_init, vit_forward, vit_loss, vit_param_specs,
     synthetic_vit_batch,
@@ -39,6 +43,8 @@ __all__ = [
     "moe_gpt_pp_loss",
     "ResNetConfig", "resnet_init", "resnet_forward", "resnet_loss",
     "resnet_param_specs",
+    "T5Config", "t5_init", "t5_forward", "t5_encode", "t5_decode",
+    "t5_loss", "t5_param_specs", "synthetic_seq2seq_batch",
     "ViTConfig", "vit_init", "vit_forward", "vit_loss",
     "vit_param_specs", "synthetic_vit_batch",
 ]

@@ -49,6 +49,12 @@ from byteps_tpu.models.resnet import (
     resnet_loss,
     resnet_param_specs,
 )
+from byteps_tpu.models.t5 import (
+    T5Config,
+    t5_init,
+    t5_loss,
+    t5_param_specs,
+)
 from byteps_tpu.models.vit import (
     ViTConfig,
     vit_init,
@@ -807,6 +813,69 @@ def make_bert_train_step(
         def per_device_step(params, opt_state, tokens, targets, mask):
             grad_params = _pcast_dp(params, dp, mesh, use_vma)
             loss, grads = vag(grad_params, tokens, targets, mask)
+            if use_vma:
+                grads = resym(grads)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            if dp is not None:
+                loss = jax.lax.pmean(loss, dp)
+            return _collapse_vma(loss), params, opt_state
+
+        sharded = jax.shard_map(
+            per_device_step,
+            mesh=mesh,
+            in_specs=(pspecs, ospecs, batch_spec, batch_spec, batch_spec),
+            out_specs=(P(), pspecs, ospecs),
+            check_vma=use_vma,
+        )
+        return jax.jit(sharded, donate_argnums=(0, 1))
+
+    return (
+        _finalize_step(build_jit, partition_bytes, dp, tunable=not zero_1),
+        params, opt_state, NamedSharding(mesh, batch_spec),
+    )
+
+
+def make_t5_train_step(
+    cfg: T5Config,
+    mesh: Mesh,
+    base_tx: optax.GradientTransformation,
+    compression_params: Optional[Dict[str, Any]] = None,
+    partition_bytes: Optional[int] = None,
+    remat: bool = False,
+    zero_1: bool = False,
+    accum_steps: int = 1,
+):
+    """``step(params, opt_state, src, tgt_in, tgt_out) -> (loss, params,
+    opt_state)`` — encoder-decoder seq2seq over a (dp, tp) mesh; blocks
+    and tp sharding shared with GPT/BERT, cross-attention added by the
+    decoder blocks (models/t5.py)."""
+    dp, tp = _axis(mesh, "dp"), _axis(mesh, "tp")
+    use_vma = compression_params is None and not zero_1
+    _check_compression_mesh(use_vma, tp, None)
+    pspecs = t5_param_specs(cfg, tp)
+    params = t5_init(jax.random.PRNGKey(0), cfg)
+    state_axes, tx_kw, zero_numel = _dist_state_setup(
+        mesh, params, pspecs, dp, zero_1)
+    params, opt_state, ospecs = _shard_params_state(
+        mesh,
+        _make_tx(mesh, base_tx, compression_params, partition_bytes, dp,
+                 **tx_kw),
+        params, pspecs, dp, state_axes=state_axes, zero_numel=zero_numel,
+    )
+    batch_spec = P(dp)
+    resym = _make_resymmetrize(pspecs, dp)
+    loss_fn = functools.partial(
+        t5_loss, cfg=cfg, dp_axis=None, tp_axis=tp, remat=remat,
+    )
+
+    def build_jit(pb):
+        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, **tx_kw)
+        vag = _accumulating_value_and_grad(loss_fn, accum_steps)
+
+        def per_device_step(params, opt_state, src, tgt_in, tgt_out):
+            grad_params = _pcast_dp(params, dp, mesh, use_vma)
+            loss, grads = vag(grad_params, src, tgt_in, tgt_out)
             if use_vma:
                 grads = resym(grads)
             updates, opt_state = tx.update(grads, opt_state, params)
