@@ -303,6 +303,40 @@ def bench_dcn() -> dict:
     }
 
 
+def _devices_or_die(timeout_s: float) -> int:
+    """Initialize the backend with a watchdog.
+
+    ``jax.devices()`` on the TPU tunnel blocks INDEFINITELY when the
+    device pool has no free grant (observed: the claim leg sleeps
+    forever) — a hung bench is indistinguishable from a slow one to the
+    driver. Probe on a daemon thread; if the backend does not come up in
+    ``BYTEPS_BENCH_DEVICE_TIMEOUT`` (default 600 s), exit 3 with a clear
+    message instead of hanging.
+    """
+    import threading
+
+    out: list = []
+
+    def probe():
+        try:
+            out.append(("ok", len(jax.devices())))
+        except BaseException as e:  # noqa: BLE001 — reported below
+            out.append(("err", e))
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if not out:
+        _log(f"bench: device backend did not initialize within "
+             f"{timeout_s:.0f}s (TPU tunnel unavailable?) — aborting")
+        os._exit(3)
+    kind, val = out[0]
+    if kind == "err":
+        _log(f"bench: device backend failed to initialize: {val!r}")
+        os._exit(4)
+    return val
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", choices=["auto", "dcn"], default="auto")
@@ -310,7 +344,8 @@ def main() -> None:
     if args.mode == "dcn":
         result = bench_dcn()
     else:
-        n = len(jax.devices())
+        n = _devices_or_die(
+            float(os.environ.get("BYTEPS_BENCH_DEVICE_TIMEOUT", "600")))
         _log(f"bench: {n} device(s): {jax.devices()[0].device_kind}")
         result = (bench_allreduce_multichip() if n > 1
                   else bench_gpt_singlechip())
