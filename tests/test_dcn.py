@@ -2,6 +2,7 @@
 integration (reference test pattern: workers push known tensors, assert the
 pulled sum — SURVEY §4)."""
 
+import time
 import threading
 
 import numpy as np
@@ -99,7 +100,14 @@ def test_async_mode_accumulates_without_barrier():
     w.init_key(1, x.nbytes)
     w.push(1, x)
     w.push(1, x)
+    # async contract: pushes are acked on receipt and summed by the engine
+    # thread; a pull may legally observe a stale value (staleness-tolerated
+    # mode, SURVEY §2.7 flavor 3). Poll until both pushes land.
+    deadline = time.monotonic() + 10.0
     out = w.pull(1, 8, version=1)
+    while not np.allclose(out, 2 * x) and time.monotonic() < deadline:
+        time.sleep(0.01)
+        out = w.pull(1, 8, version=1)
     np.testing.assert_allclose(out, 2 * x)
     stop_server()
 
