@@ -23,6 +23,8 @@ from byteps_tpu.models.moe_gpt import (
 from byteps_tpu.models.t5 import (
     T5Config, t5_init, t5_forward, t5_encode, t5_decode, t5_loss,
     t5_param_specs, synthetic_seq2seq_batch,
+    T5DecCache, t5_init_cache, t5_cross_kv, t5_decode_cached,
+    make_t5_generate_fn,
 )
 from byteps_tpu.models.vit import (
     ViTConfig, vit_init, vit_forward, vit_loss, vit_param_specs,
@@ -45,6 +47,8 @@ __all__ = [
     "resnet_param_specs",
     "T5Config", "t5_init", "t5_forward", "t5_encode", "t5_decode",
     "t5_loss", "t5_param_specs", "synthetic_seq2seq_batch",
+    "T5DecCache", "t5_init_cache", "t5_cross_kv", "t5_decode_cached",
+    "make_t5_generate_fn",
     "ViTConfig", "vit_init", "vit_forward", "vit_loss",
     "vit_param_specs", "synthetic_vit_batch",
 ]

@@ -72,15 +72,18 @@ def _cached_attention(q, k_cache, v_cache, q_pos0):
     return o
 
 
-def _attn_cached_half(x, p, cache_k, cache_v, pos0, cfg, tp_axis):
+def _attn_cached_half(x, p, cache_k, cache_v, pos0, head_dim, tp_axis,
+                      rope_base: float = 0.0):
     """The attention residual branch over T new tokens with cache append.
 
     x: (B, T, d); cache_k/v: (B, S_max, h_loc, D) this layer's cache.
-    Returns (x_out, new_cache_k, new_cache_v). Under RoPE the new q/k
-    rotate by their global positions before the cache write, so cached
-    keys are stored post-rotation (the standard decode convention).
+    Returns (x_out, new_cache_k, new_cache_v). With ``rope_base > 0``
+    the new q/k rotate by their global positions before the cache write,
+    so cached keys are stored post-rotation (the standard decode
+    convention). Config-agnostic on purpose: the GPT/MoE block step AND
+    the T5 decoder (models/t5.py t5_decode_cached) share this one
+    cache-append path.
     """
-    head_dim = cfg.head_dim
     B, T = x.shape[:2]
     h = _layernorm(x, p["ln1_g"], p["ln1_b"])
     q = col_parallel_matmul(h, p["wq"].astype(x.dtype), p["bq"].astype(x.dtype))
@@ -91,10 +94,10 @@ def _attn_cached_half(x, p, cache_k, cache_v, pos0, cfg, tp_axis):
     q = q.reshape(B, T, h_loc, head_dim)
     k = k.reshape(B, T, kv_loc, head_dim)
     v = v.reshape(B, T, kv_loc, head_dim)
-    if cfg.pos_embedding == "rope":
+    if rope_base > 0.0:
         pos = pos0 + jnp.arange(T)
-        q = rope_rotate(q, pos, cfg.rope_base)
-        k = rope_rotate(k, pos, cfg.rope_base)
+        q = rope_rotate(q, pos, rope_base)
+        k = rope_rotate(k, pos, rope_base)
     cache_k = jax.lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype),
                                            (0, pos0, 0, 0))
     cache_v = jax.lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype),
@@ -112,7 +115,8 @@ def _block_step(x, p, cache_k, cache_v, pos0, cfg, tp_axis, ep_axis):
     """One transformer block (dense-MLP or MoE, by param structure) over
     T new tokens with cache append."""
     x, cache_k, cache_v = _attn_cached_half(
-        x, p, cache_k, cache_v, pos0, cfg, tp_axis)
+        x, p, cache_k, cache_v, pos0, cfg.head_dim, tp_axis,
+        rope_base=(cfg.rope_base if cfg.pos_embedding == "rope" else 0.0))
     h = _layernorm(x, p["ln2_g"], p["ln2_b"])
     if "moe" in p:
         from byteps_tpu.parallel.moe import moe_ffn

@@ -23,7 +23,7 @@ short-sequence; the sp ring story lives in the GPT family).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional
+from typing import Any, Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -243,3 +243,129 @@ def synthetic_seq2seq_batch(rng: jnp.ndarray, cfg: T5Config, batch: int,
     tgt = jax.random.randint(k2, (batch, tgt_len + 1), 0, cfg.vocab_size)
     tgt = tgt.at[:, 0].set(0)
     return src, tgt[:, :-1], tgt[:, 1:]
+
+
+# ---- cached seq2seq generation ---------------------------------------------
+class T5DecCache(NamedTuple):
+    """Decoder self-attention KV cache (n_dec, B, max_tgt, H, D) plus the
+    fill level. Cross-attention k/v are not cached here — they are a pure
+    function of the encoder memory, precomputed ONCE per sample by
+    :func:`t5_cross_kv` (the structural win of encoder-decoder decode:
+    the source side is encoded and projected exactly once)."""
+    k: jnp.ndarray
+    v: jnp.ndarray
+    length: jnp.ndarray
+
+
+def t5_init_cache(cfg: T5Config, batch: int,
+                  h_loc: Optional[int] = None) -> T5DecCache:
+    h = h_loc if h_loc is not None else cfg.n_heads
+    shape = (cfg.n_dec_layers, batch, cfg.max_tgt, h, cfg.head_dim)
+    return T5DecCache(k=jnp.zeros(shape, cfg.dtype),
+                      v=jnp.zeros(shape, cfg.dtype),
+                      length=jnp.zeros((), jnp.int32))
+
+
+def t5_cross_kv(params, mem: jnp.ndarray, cfg: T5Config):
+    """Precompute each decoder layer's cross-attention k/v from encoder
+    memory: (n_dec, B, S_src, h_loc, D) pair."""
+    ks, vs = [], []
+    B, Sk = mem.shape[:2]
+    for p in params["dec_blocks"]:
+        k = col_parallel_matmul(mem, p["xwk"].astype(mem.dtype),
+                                p["xbk"].astype(mem.dtype))
+        v = col_parallel_matmul(mem, p["xwv"].astype(mem.dtype),
+                                p["xbv"].astype(mem.dtype))
+        h_loc = k.shape[-1] // cfg.head_dim
+        ks.append(k.reshape(B, Sk, h_loc, cfg.head_dim))
+        vs.append(v.reshape(B, Sk, h_loc, cfg.head_dim))
+    return jnp.stack(ks), jnp.stack(vs)
+
+
+def t5_decode_cached(params, tgt_tokens: jnp.ndarray, cache: T5DecCache,
+                     cross_k: jnp.ndarray, cross_v: jnp.ndarray,
+                     cfg: T5Config, tp_axis: Optional[str] = None):
+    """Run T new target tokens through the decoder, appending to the cache.
+
+    tgt_tokens: (B, T) continuing at position ``cache.length``; T =
+    prompt length is the prefill, T = 1 one decode step — pinned to
+    :func:`t5_decode` numerics either way. Returns (logits f32, cache).
+    """
+    from byteps_tpu.models.generate import _attn_cached_half
+
+    B, T = tgt_tokens.shape
+    pos0 = cache.length
+    pos = pos0 + jnp.arange(T)
+    x = (params["wte"][tgt_tokens]
+         + jnp.take(params["wpe_tgt"], pos, axis=0)).astype(cfg.dtype)
+    head_dim = cfg.head_dim
+    new_k, new_v = [], []
+    for li, p in enumerate(params["dec_blocks"]):
+        # causal self-attention over the cache — the one shared
+        # cache-append path (models/generate.py)
+        x, ck, cv = _attn_cached_half(
+            x, p, cache.k[li], cache.v[li], pos0, head_dim, tp_axis)
+        h_loc = ck.shape[-2]    # T5 has no GQA: query heads == kv heads
+        # cross-attention over the precomputed encoder k/v
+        h = _layernorm(x, p["lnx_g"], p["lnx_b"])
+        q = col_parallel_matmul(h, p["xwq"].astype(x.dtype),
+                                p["xbq"].astype(x.dtype))
+        q = q.reshape(B, T, h_loc, head_dim)
+        o = plain_attention(q, cross_k[li].astype(q.dtype),
+                            cross_v[li].astype(q.dtype), causal=False)
+        x = x + row_parallel_matmul(o.reshape(B, T, h_loc * head_dim),
+                                    p["xwo"].astype(x.dtype), tp_axis,
+                                    p["xbo"].astype(x.dtype))
+        x = x + _mlp(_layernorm(x, p["ln2_g"], p["ln2_b"]), p, tp_axis)
+        new_k.append(ck)
+        new_v.append(cv)
+    logits = _readout(params, x)
+    return logits, T5DecCache(k=jnp.stack(new_k), v=jnp.stack(new_v),
+                              length=pos0 + T)
+
+
+def make_t5_generate_fn(cfg: T5Config, max_new: int,
+                        tp_axis: Optional[str] = None):
+    """Build a jitted seq2seq sampler: ``gen(params, src, rng, temperature)``.
+
+    Encodes the source once, precomputes per-layer cross k/v once, then
+    scans ``max_new`` single-token cached decoder steps from BOS (id 0).
+    Greedy at ``temperature == 0``; one XLA program end to end. Returns
+    (B, max_new) generated ids.
+    """
+
+    def gen(params, src, rng, temperature=0.0):
+        B = src.shape[0]
+        if 1 + max_new > cfg.max_tgt:
+            # static shapes: past max_tgt the cache write offset would
+            # clamp (overwriting the last slot) and wpe_tgt positions
+            # clip — fail at trace time instead of generating garbage
+            # (same guard as the GPT sampler, models/generate.py)
+            raise ValueError(
+                f"BOS + max_new ({1 + max_new}) exceeds "
+                f"cfg.max_tgt ({cfg.max_tgt})")
+        mem = t5_encode(params, src, cfg, tp_axis=tp_axis)
+        cross_k, cross_v = t5_cross_kv(params, mem, cfg)
+        h_loc = cross_k.shape[-2]
+        cache = t5_init_cache(cfg, B, h_loc=h_loc)
+        bos = jnp.zeros((B, 1), jnp.int32)
+
+        def pick(logits_t, key):
+            greedy = jnp.argmax(logits_t, axis=-1)
+            t = jnp.maximum(temperature, 1e-6)
+            sampled = jax.random.categorical(key, logits_t / t, axis=-1)
+            return jnp.where(temperature > 0.0, sampled, greedy).astype(
+                jnp.int32)
+
+        def step(carry, key):
+            tok, cache = carry
+            logits, cache = t5_decode_cached(
+                params, tok, cache, cross_k, cross_v, cfg, tp_axis=tp_axis)
+            nxt = pick(logits[:, -1], key)[:, None]
+            return (nxt, cache), nxt[:, 0]
+
+        keys = jax.random.split(rng, max_new)
+        (_, _), toks = jax.lax.scan(step, (bos, cache), keys)
+        return toks.T  # (B, max_new)
+
+    return jax.jit(gen, static_argnames=())
