@@ -170,32 +170,19 @@ def gpt_apply_cached(params, tokens: jnp.ndarray, cache: KVCache,
     )
 
 
-def make_generate_fn(cfg: GPTConfig, max_new: int,
-                     tp_axis: Optional[str] = None,
-                     ep_axis: Optional[str] = None,
-                     top_k: Optional[int] = None,
-                     top_p: Optional[float] = None):
-    """Build a jitted sampler: ``gen(params, prompt, rng, temperature)``.
-
-    prompt: (B, T0) int32; returns (B, T0 + max_new) tokens. Greedy when
-    ``temperature == 0`` (exact argmax — the equivalence-vs-gpt_forward
-    test drives this), categorical sampling otherwise, optionally
-    truncated to the ``top_k`` highest-probability tokens and/or the
-    ``top_p`` nucleus (smallest set with cumulative probability ≥ top_p,
-    computed at temperature 1 then resampled at ``temperature``). One XLA
-    program: cached prefill + ``lax.scan`` over max_new decode steps.
-    """
-    if top_k is not None and not 1 <= top_k <= cfg.vocab_size:
+def make_truncate(top_k: Optional[int], top_p: Optional[float],
+                  vocab_size: int):
+    """Build the per-step logits filter shared by every sampler (GPT/MoE
+    and T5): mask logits outside the top-k set / the top-p nucleus (both
+    computed on the raw distribution; with both set, a token must pass
+    both filters). top_k-only takes a partial lax.top_k; any top_p pays
+    one descending sort that also serves the top_k threshold."""
+    if top_k is not None and not 1 <= top_k <= vocab_size:
         raise ValueError(f"top_k must be in [1, vocab]; got {top_k}")
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1]; got {top_p}")
 
     def _truncate(logits_t):
-        """Mask logits outside the top-k set / the top-p nucleus (both
-        computed on the raw distribution; with both set, a token must
-        pass both filters). Runs per decode step inside the scan:
-        top_k-only takes a partial lax.top_k; any top_p pays one
-        descending sort that also serves the top_k threshold."""
         if top_k is None and top_p is None:
             return logits_t
         if top_p is None:
@@ -218,6 +205,43 @@ def make_generate_fn(cfg: GPTConfig, max_new: int,
                 keepdims=True))
         return jnp.where(logits_t >= thresh, logits_t, -jnp.inf)
 
+    return _truncate
+
+
+def make_pick(truncate):
+    """Per-step token selection shared by every sampler: exact argmax at
+    ``temperature == 0``, otherwise categorical over the truncated
+    logits at ``temperature`` (floored at 1e-6 so the jitted branchless
+    select never divides by zero)."""
+
+    def pick(logits_t, key, temperature):
+        greedy = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
+        temp = jnp.maximum(temperature, 1e-6)
+        sampled = jax.random.categorical(key, truncate(logits_t) / temp,
+                                         axis=-1)
+        return jnp.where(temperature > 0.0, sampled.astype(jnp.int32),
+                         greedy)
+
+    return pick
+
+
+def make_generate_fn(cfg: GPTConfig, max_new: int,
+                     tp_axis: Optional[str] = None,
+                     ep_axis: Optional[str] = None,
+                     top_k: Optional[int] = None,
+                     top_p: Optional[float] = None):
+    """Build a jitted sampler: ``gen(params, prompt, rng, temperature)``.
+
+    prompt: (B, T0) int32; returns (B, T0 + max_new) tokens. Greedy when
+    ``temperature == 0`` (exact argmax — the equivalence-vs-gpt_forward
+    test drives this), categorical sampling otherwise, optionally
+    truncated to the ``top_k`` highest-probability tokens and/or the
+    ``top_p`` nucleus (smallest set with cumulative probability ≥ top_p,
+    computed at temperature 1 then resampled at ``temperature``). One XLA
+    program: cached prefill + ``lax.scan`` over max_new decode steps.
+    """
+    _pick = make_pick(make_truncate(top_k, top_p, cfg.vocab_size))
+
     @functools.partial(jax.jit, static_argnames=())
     def gen(params, prompt, rng, temperature=0.0):
         B, T0 = prompt.shape
@@ -237,17 +261,9 @@ def make_generate_fn(cfg: GPTConfig, max_new: int,
                                          ep_axis)
         last = logits[:, -1]
 
-        def pick(logits_t, key):
-            greedy = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
-            trunc = _truncate(logits_t)
-            temp = jnp.maximum(temperature, 1e-6)
-            sampled = jax.random.categorical(key, trunc / temp, axis=-1)
-            return jnp.where(temperature > 0.0, sampled.astype(jnp.int32),
-                             greedy)
-
         def step(carry, key):
             cache, last_logits = carry
-            tok = pick(last_logits, key)                      # (B,)
+            tok = _pick(last_logits, key, temperature)        # (B,)
             logits, cache = gpt_apply_cached(
                 params, tok[:, None], cache, cfg, tp_axis, ep_axis)
             return (cache, logits[:, 0]), tok

@@ -325,43 +325,43 @@ def t5_decode_cached(params, tgt_tokens: jnp.ndarray, cache: T5DecCache,
 
 
 def make_t5_generate_fn(cfg: T5Config, max_new: int,
-                        tp_axis: Optional[str] = None):
+                        tp_axis: Optional[str] = None,
+                        top_k: Optional[int] = None,
+                        top_p: Optional[float] = None):
     """Build a jitted seq2seq sampler: ``gen(params, src, rng, temperature)``.
 
     Encodes the source once, precomputes per-layer cross k/v once, then
     scans ``max_new`` single-token cached decoder steps from BOS (id 0).
-    Greedy at ``temperature == 0``; one XLA program end to end. Returns
-    (B, max_new) generated ids.
+    Greedy at ``temperature == 0``; ``top_k``/``top_p`` truncate the
+    sampling distribution exactly as in the GPT sampler (shared
+    ``make_truncate``). One XLA program end to end; returns (B, max_new)
+    generated ids.
     """
+    from byteps_tpu.models.generate import make_pick, make_truncate
+
+    if 1 + max_new > cfg.max_tgt:
+        # static shapes: past max_tgt the cache write offset would clamp
+        # (overwriting the last slot) and wpe_tgt positions clip. The
+        # bound depends only on factory args, so fail HERE, not at the
+        # first traced call (the GPT sampler's guard needs the runtime
+        # prompt length; this one doesn't).
+        raise ValueError(f"BOS + max_new ({1 + max_new}) exceeds "
+                         f"cfg.max_tgt ({cfg.max_tgt})")
+    _pick = make_pick(make_truncate(top_k, top_p, cfg.vocab_size))
 
     def gen(params, src, rng, temperature=0.0):
         B = src.shape[0]
-        if 1 + max_new > cfg.max_tgt:
-            # static shapes: past max_tgt the cache write offset would
-            # clamp (overwriting the last slot) and wpe_tgt positions
-            # clip — fail at trace time instead of generating garbage
-            # (same guard as the GPT sampler, models/generate.py)
-            raise ValueError(
-                f"BOS + max_new ({1 + max_new}) exceeds "
-                f"cfg.max_tgt ({cfg.max_tgt})")
         mem = t5_encode(params, src, cfg, tp_axis=tp_axis)
         cross_k, cross_v = t5_cross_kv(params, mem, cfg)
         h_loc = cross_k.shape[-2]
         cache = t5_init_cache(cfg, B, h_loc=h_loc)
         bos = jnp.zeros((B, 1), jnp.int32)
 
-        def pick(logits_t, key):
-            greedy = jnp.argmax(logits_t, axis=-1)
-            t = jnp.maximum(temperature, 1e-6)
-            sampled = jax.random.categorical(key, logits_t / t, axis=-1)
-            return jnp.where(temperature > 0.0, sampled, greedy).astype(
-                jnp.int32)
-
         def step(carry, key):
             tok, cache = carry
             logits, cache = t5_decode_cached(
                 params, tok, cache, cross_k, cross_v, cfg, tp_axis=tp_axis)
-            nxt = pick(logits[:, -1], key)[:, None]
+            nxt = _pick(logits[:, -1], key, temperature)[:, None]
             return (nxt, cache), nxt[:, 0]
 
         keys = jax.random.split(rng, max_new)

@@ -173,6 +173,19 @@ def test_generation_bound_guard():
 
     params = t5_init(jax.random.PRNGKey(0), CFG)
     src, _, _ = synthetic_seq2seq_batch(jax.random.PRNGKey(7), CFG, 1, 8, 4)
-    gen = make_t5_generate_fn(CFG, CFG.max_tgt)  # 1 + max_new > max_tgt
     with pytest.raises(ValueError, match="exceeds"):
-        gen(params, src, jax.random.PRNGKey(0), 0.0)
+        make_t5_generate_fn(CFG, CFG.max_tgt)  # 1 + max_new > max_tgt
+
+
+def test_generation_top_k_restricts_support():
+    """top_k=1 sampling at temperature 1 must equal greedy decoding."""
+    from byteps_tpu.models import make_t5_generate_fn
+
+    params = t5_init(jax.random.PRNGKey(0), CFG)
+    src, _, _ = synthetic_seq2seq_batch(jax.random.PRNGKey(8), CFG, 2, 16, 4)
+    greedy = np.asarray(
+        make_t5_generate_fn(CFG, 5)(params, src, jax.random.PRNGKey(0), 0.0))
+    k1 = np.asarray(
+        make_t5_generate_fn(CFG, 5, top_k=1)(
+            params, src, jax.random.PRNGKey(3), 1.0))
+    np.testing.assert_array_equal(k1, greedy)
