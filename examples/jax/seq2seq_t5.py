@@ -1,0 +1,92 @@
+"""Train a toy T5 on a synthetic copy task, then generate from it.
+
+Usage::
+
+    python examples/jax/seq2seq_t5.py [--steps 2500] [--max-new 8]
+
+End-to-end tour of the encoder-decoder family: `make_t5_train_step`
+(dp-sharded teacher-forced training, batches fed through the
+`PrefetchLoader` input pipeline) followed by `make_t5_generate_fn`
+(encode once, cross-k/v once, scanned cached decode). The synthetic task
+is target = source prefix, so a trained model's greedy decode should
+start echoing the source — a visible sign the cross-attention learned to
+look at the encoder.
+"""
+
+import argparse
+import os
+import time
+
+import jax
+
+# honor an explicit JAX_PLATFORMS choice even when a preloaded PJRT plugin
+# (e.g. a harness sitecustomize) already picked a different default — the
+# env var alone does not win once the plugin registered itself
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from byteps_tpu.data import PrefetchLoader
+from byteps_tpu.models import T5Config, make_t5_generate_fn
+from byteps_tpu.models.train import make_t5_train_step
+from byteps_tpu.parallel import MeshAxes, make_mesh
+
+
+def copy_batch(rng, cfg, batch, src_len, tgt_len):
+    """Target = first tgt_len source tokens (shifted right, BOS=0)."""
+    src = jax.random.randint(rng, (batch, src_len), 1, cfg.vocab_size)
+    tgt = src[:, :tgt_len]
+    tgt_in = jnp.concatenate(
+        [jnp.zeros((batch, 1), jnp.int32), tgt[:, :-1]], axis=1)
+    return np.asarray(src), np.asarray(tgt_in), np.asarray(tgt)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    # ~100 s on an 8-device virtual CPU mesh; loss reaches ~0.005 and
+    # greedy decode copies the source exactly (8/8 tokens)
+    ap.add_argument("--steps", type=int, default=2500)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--src-len", type=int, default=16)
+    ap.add_argument("--tgt-len", type=int, default=8)
+    ap.add_argument("--max-new", type=int, default=8)
+    args = ap.parse_args()
+
+    cfg = T5Config.tiny()
+    n = len(jax.devices())
+    mesh = make_mesh(MeshAxes(dp=n))
+    step, params, opt_state, bsh = make_t5_train_step(
+        cfg, mesh, optax.adamw(3e-3))
+
+    def batches():
+        for i in range(args.steps):
+            yield copy_batch(jax.random.PRNGKey(i), cfg, args.batch,
+                             args.src_len, args.tgt_len)
+
+    t0 = time.time()
+    with PrefetchLoader(batches(), bsh, depth=2) as loader:
+        for i, (src, tgt_in, tgt_out) in enumerate(loader):
+            loss, params, opt_state = step(params, opt_state, src, tgt_in,
+                                           tgt_out)
+            if i % 10 == 0 or i == args.steps - 1:
+                print(f"step {i}: loss={float(loss):.4f}", flush=True)
+    print(f"trained {args.steps} steps in {time.time() - t0:.1f}s")
+
+    gen = make_t5_generate_fn(cfg, args.max_new)
+    src, _, _ = copy_batch(jax.random.PRNGKey(123), cfg, 2, args.src_len,
+                           args.tgt_len)
+    host_params = jax.device_get(params)
+    toks = np.asarray(gen(host_params, jnp.asarray(src),
+                          jax.random.PRNGKey(0), 0.0))
+    m = min(args.max_new, args.tgt_len, args.src_len)
+    for b in range(toks.shape[0]):
+        match = int((toks[b, :m] == src[b, :m]).sum())
+        print(f"src[:{m}]={src[b, :m].tolist()} -> gen={toks[b].tolist()} "
+              f"({match}/{m} copied)")
+
+
+if __name__ == "__main__":
+    main()
