@@ -35,7 +35,12 @@ class OnebitCompressor(Compressor):
     name = "onebit"
     presummable = False  # signs cannot be summed; must decompress first
 
-    def __init__(self, scaling: bool = True, **_ignored):
+    def __init__(self, scaling: Optional[bool] = None, **_ignored):
+        if scaling is None:
+            # kwarg absent: the reference env var supplies the default
+            from byteps_tpu.common.config import _env_bool
+
+            scaling = _env_bool("BYTEPS_COMPRESSOR_ONEBIT_SCALING", True)
         self.scaling = bool(scaling)
 
     def compress(self, x: jnp.ndarray, rng: Optional[jnp.ndarray] = None) -> Payload:

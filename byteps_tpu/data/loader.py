@@ -129,11 +129,24 @@ class PrefetchLoader:
         if self._stop.is_set():
             raise StopIteration
         item = self._q.get()
+        if item is not self._DONE and self._stop.is_set():
+            # close() ran while we were blocked in get(): a producer that
+            # was waiting on a full queue can win the drained slot, so the
+            # item we just got may be a live batch and close()'s injected
+            # _DONE may have been dropped — discard the stale batch and
+            # end iteration instead of delivering data after close()
+            raise StopIteration
         if item is self._DONE:
             # terminal: further __next__ calls must keep raising (the
-            # producer is dead and will never put again)
+            # producer is dead and will never put again). _err was set
+            # BEFORE the producer's _DONE (its finally block), so no join
+            # is needed for error surfacing — and a concurrent close()
+            # injects _DONE while the producer may still be blocked inside
+            # the user's source, where an unbounded join would hang this
+            # consumer; the bounded join is best-effort cleanup only
+            # (close() and the daemon flag handle the rest).
             self._stop.set()
-            self._thread.join()
+            self._thread.join(timeout=1.0)
             if self._err is not None:
                 err, self._err = self._err, None
                 raise err
@@ -148,6 +161,13 @@ class PrefetchLoader:
             while True:
                 self._q.get_nowait()
         except queue.Empty:
+            pass
+        # the drain above may have swallowed the producer's _DONE; put one
+        # back so a consumer concurrently blocked in __next__'s q.get()
+        # always unblocks (it re-checks _stop and raises StopIteration)
+        try:
+            self._q.put_nowait(self._DONE)
+        except queue.Full:
             pass
         self._thread.join(timeout=5.0)
 

@@ -176,7 +176,10 @@ def make_truncate(top_k: Optional[int], top_p: Optional[float],
     and T5): mask logits outside the top-k set / the top-p nucleus (both
     computed on the raw distribution; with both set, a token must pass
     both filters). top_k-only takes a partial lax.top_k; any top_p pays
-    one descending sort that also serves the top_k threshold."""
+    one descending sort that also serves the top_k threshold. Ties at
+    the k-th (or nucleus-edge) logit are ALL kept — standard >=-threshold
+    behavior, so sampling is not strictly limited to k tokens when the
+    boundary value repeats."""
     if top_k is not None and not 1 <= top_k <= vocab_size:
         raise ValueError(f"top_k must be in [1, vocab]; got {top_k}")
     if top_p is not None and not 0.0 < top_p <= 1.0:

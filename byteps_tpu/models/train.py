@@ -68,10 +68,18 @@ def _axis(mesh: Mesh, name: str) -> Optional[str]:
     return name if name in mesh.axis_names else None
 
 
-def _check_seq_layout(seq_layout):
+def _check_seq_layout(seq_layout, sp=None):
     if seq_layout not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown seq_layout {seq_layout!r} — expected "
                          "'contiguous' or 'zigzag'")
+    if seq_layout == "zigzag" and sp is None:
+        # the zigzag contract is "feed zigzag_permutation-permuted tokens";
+        # without an sp axis the ring degenerates to contiguous attention
+        # and that pre-permuted input would train on silently scrambled data
+        raise ValueError(
+            "seq_layout='zigzag' requires a mesh with an sp axis — the "
+            "layout only exists to balance the causal ring over sp; on "
+            "this mesh the permuted inputs would just be scrambled tokens")
 
 
 def _check_compression_mesh(use_vma, tp, sp):
@@ -406,11 +414,12 @@ def make_gpt_train_step(
     adapter's ``backward_passes_per_step``, fused into the jitted step.
     ``seq_layout="zigzag"`` runs the load-balanced causal ring over sp
     (feed tokens/targets pre-permuted with ``zigzag_permutation``;
-    positions and attention follow the layout — ~2x sp utilization for
-    causal attention at scale).
+    positions and attention follow the layout — projected ~2x sp
+    utilization for causal attention at scale, from the load-balance
+    arithmetic; unmeasured, needs real multi-chip sp hardware).
     """
     dp, tp, sp = _axis(mesh, "dp"), _axis(mesh, "tp"), _axis(mesh, "sp")
-    _check_seq_layout(seq_layout)
+    _check_seq_layout(seq_layout, sp)
     use_vma = compression_params is None and not zero_1
     _check_compression_mesh(use_vma, tp, sp)
     pspecs = gpt_param_specs(cfg, tp)
@@ -510,7 +519,7 @@ def make_gpt_pp_train_step(
     tp, sp = _axis(mesh, "tp"), _axis(mesh, "sp")
     if pp is None:
         raise ValueError("mesh has no pp axis — use make_gpt_train_step")
-    _check_seq_layout(seq_layout)
+    _check_seq_layout(seq_layout, sp)
     use_vma = compression_params is None and not zero_1
     _check_compression_mesh(use_vma, tp, sp)
     nstages = mesh.shape[pp]
@@ -604,7 +613,7 @@ def make_gpt_moe_train_step(
             "mesh has a pp axis — use make_gpt_moe_pp_train_step for "
             "pipelined MoE"
         )
-    _check_seq_layout(seq_layout)
+    _check_seq_layout(seq_layout, sp)
     use_vma = compression_params is None and not zero_1
     _check_compression_mesh(use_vma, tp, sp)
     ep_size = mesh.shape[ep] if ep is not None else 1
@@ -709,7 +718,7 @@ def make_gpt_moe_pp_train_step(
     ep, tp, sp = _axis(mesh, "ep"), _axis(mesh, "tp"), _axis(mesh, "sp")
     if pp is None:
         raise ValueError("mesh has no pp axis — use make_gpt_moe_train_step")
-    _check_seq_layout(seq_layout)
+    _check_seq_layout(seq_layout, sp)
     use_vma = compression_params is None and not zero_1
     _check_compression_mesh(use_vma, tp, sp)
     nstages = mesh.shape[pp]

@@ -109,7 +109,11 @@ def moe_ffn(
     transformer block does. ``no_drop=True`` sets capacity so NO token can
     be dropped (``T`` slots per expert — the worst-case load, since a
     token's k choices are distinct experts) — decode-time routing, where
-    a drop silently corrupts the sample.
+    a drop silently corrupts the sample. Memory note: that worst case
+    allocates ``E × T × d`` dispatch slots per layer, so no-drop prefill
+    of a long prompt spikes HBM roughly ``E×`` the dense activation;
+    chunk long prefills (gpt_apply_cached accepts any T) if that
+    pressure shows up in profiles.
     """
     ep = jax.lax.axis_size(ep_axis) if ep_axis is not None else 1
     e_loc = params["w1"].shape[0]
