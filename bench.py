@@ -8,14 +8,33 @@ Default mode is chosen by visible device count:
   vs. the native single fused psum — ``vs_baseline`` is ours/native, the
   BASELINE north star's "≥90% of native all-reduce" criterion.
 
-* **single-chip**: flagship GPT train-step throughput (tokens/s) through the
-  full framework stack (DistributedOptimizer on a 1-device mesh) vs. an
-  identical plain jax+optax train step — ``vs_baseline`` is ours/plain,
-  i.e. the framework-overhead ratio (1.0 = zero overhead), mirroring the
-  reference's synthetic benchmark methodology
-  (example/pytorch/benchmark_byteps.py measures img/s with/without byteps).
-  Three repeated interleaved timing blocks; the JSON carries the ratio
-  spread so a bar-clearing number can be told apart from run variance.
+* **single-chip**: train-step throughput through the full framework stack
+  (DistributedOptimizer on a 1-device mesh) vs. an identical plain
+  jax+optax train step — ``vs_baseline`` is plain/ours (1.0 = zero
+  overhead), mirroring the reference's synthetic benchmark methodology
+  (example/pytorch/benchmark_byteps.py measures img/s with/without
+  byteps). ``--model`` selects the BASELINE-named workloads:
+
+    - ``gpt``      (default) flagship GPT d512/L8 bf16 — BENCH continuity
+    - ``gpt2m``    GPT-2-medium d1024/L24 — BASELINE config 4 shape
+    - ``bert``     BERT-base MLM — BASELINE config 3 shape
+    - ``resnet50`` ResNet-50 224² — BASELINE config 2 shape
+
+  ``--compressor onebit|topk`` routes the dp aggregation through the
+  Pallas compressor path (config 3 = bert+onebit, config 4 = gpt2m+topk).
+
+**Physical accountability** (every single-chip run): an analytic FLOPs
+count per step (6·N_matmul·tokens + 12·L·B·S²·d attention term; XLA
+cost-analysis for conv nets) converts step time to achieved TFLOP/s and
+**MFU against the detected chip's bf16 peak**; a known-FLOPs calibration
+(chained 4096³ bf16 matmuls, timed identically) and a linearity check
+(2× the chain must take ~2× the time) validate the timing path itself.
+``absolute_trusted`` is false — and a loud warning printed — whenever
+implied MFU exceeds 100%, the calibration exceeds peak, or the linearity
+check fails; the interleaved A/B **ratio** remains defensible either way
+(both sides share whatever the backend does). Timing fences are real
+host transfers (``float(sum(leaf sums))``), not ``block_until_ready``,
+so an async backend cannot report completion early.
 
 ``--mode dcn`` instead benchmarks the DCN summation tier on localhost
 (2 workers + 1 server, 4 MB partitions, raw fp32 and onebit wires) and
@@ -26,6 +45,7 @@ docs/performance.md's DCN table.
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import os
 import sys
@@ -84,6 +104,382 @@ def _time_pair(fn_a, fn_b, warmup: int = 2, iters: int = 8):
     return float(np.median(ta)), float(np.median(tb))
 
 
+def _fence(tree) -> float:
+    """Authoritative timing barrier: a REAL device→host transfer of a
+    scalar derived from every leaf. Unlike ``block_until_ready`` (which an
+    experimental PJRT backend could satisfy from a ready-event that fires
+    early), the float cannot exist on the host before every leaf's
+    producing program actually ran."""
+    leaves = jax.tree.leaves(tree)
+    tot = leaves[0].astype(jnp.float32).sum()
+    for l in leaves[1:]:
+        tot = tot + l.astype(jnp.float32).sum()
+    return float(tot)
+
+
+# bf16 dense peak TFLOP/s per *jax device* (v2/v3: one device = one core,
+# half a chip). Substring match, first hit wins — order matters ("v5 lite"
+# before "v5p"/"v5").
+_PEAKS = (
+    ("v5 lite", 197.0), ("v5e", 197.0), ("v5p", 459.0), ("v5", 459.0),
+    ("v6 lite", 918.0), ("v6e", 918.0), ("v6", 918.0),
+    ("v4", 275.0), ("v3", 61.5), ("v2", 22.5),
+)
+
+
+def _detect_peak():
+    kind = jax.devices()[0].device_kind
+    kl = kind.lower()
+    if jax.devices()[0].platform == "cpu":
+        return kind, None
+    for pat, peak in _PEAKS:
+        if pat in kl:
+            return kind, peak
+    return kind, None
+
+
+def _calibrate(peak_tflops, on_cpu: bool):
+    """Known-FLOPs calibration: chained bf16 4096³ matmuls timed with the
+    same fence as the model benches. Returns
+    (achieved_tflops, calibration_mfu_or_None, linearity).
+
+    linearity = t(2k chained matmuls) / t(k): ~2.0 when the timing path
+    actually waits for the device; ≪2 means completion is being reported
+    early and every absolute time in this process is untrustworthy."""
+    M = 1024 if on_cpu else 4096
+    k = 4 if on_cpu else 15
+    k1, k2 = jax.random.split(jax.random.PRNGKey(7))
+    # spectral norm of w ≈ 2 — the chain stays finite in bf16
+    w = (jax.random.normal(k1, (M, M), jnp.float32)
+         / np.sqrt(M)).astype(jnp.bfloat16)
+    y0 = jax.random.normal(k2, (M, M), jnp.bfloat16)
+
+    def mk(depth):
+        @jax.jit
+        def f(y):
+            for _ in range(depth):
+                y = y @ w
+            return y
+        return f
+
+    f_half, f_full = mk(k), mk(2 * k)
+    run_half = lambda: _fence(f_half(y0))  # noqa: E731
+    run_full = lambda: _fence(f_full(y0))  # noqa: E731
+    t_half = _time_it(run_half, warmup=2, iters=5)
+    t_full = _time_it(run_full, warmup=2, iters=5)
+    linearity = t_full / t_half
+    achieved = 2 * k * 2 * M**3 / t_full / 1e12
+    mfu = achieved / peak_tflops if peak_tflops else None
+    _log(f"calibration: {2*k}x{M}^3 bf16 matmul chain {t_full*1e3:.2f}ms "
+         f"-> {achieved:.1f} TFLOP/s"
+         + (f" ({100*mfu:.0f}% of {peak_tflops:.0f} peak)" if mfu else "")
+         + f", linearity {linearity:.2f} (expect ~2.0)")
+    return achieved, mfu, linearity
+
+
+def _transformer_step_flops(d, L, d_ff, vocab, B, S, mlp="gelu"):
+    """Analytic train-step FLOPs: 6·N_matmul·tokens + 12·L·B·S²·d.
+
+    N_matmul counts weight-matrix parameters on the matmul path (qkv +
+    attention proj + MLP per layer, plus the d×vocab logits matmul;
+    embedding lookups move no FLOPs). fwd = 2·N·tokens, train = 3×fwd.
+    The attention term is QKᵀ + AV (4·B·S²·d per layer fwd, ×3 for
+    training) with no causal discount — the kernels compute the full
+    product shape."""
+    mlp_params = 3 * d * d_ff if mlp == "swiglu" else 2 * d * d_ff
+    n_mm = L * (4 * d * d + mlp_params) + d * vocab
+    return 6 * n_mm * B * S + 12 * L * B * S * S * d
+
+
+_COMPRESSORS = {
+    "none": None,
+    # BASELINE config 3: onebit + error feedback (the convergence-safe form
+    # the reference's gradient-compression docs prescribe)
+    "onebit": {"compressor": "onebit", "ef": "vanilla"},
+    # BASELINE config 4: topk (k=1% of elements per partition)
+    "topk": {"compressor": "topk", "k": 0.01, "ef": "vanilla"},
+}
+
+
+def _build_gpt(cfg, batch, seq, compression_params, mesh_devices):
+    import optax
+
+    from byteps_tpu.models import gpt_init, gpt_loss
+    from byteps_tpu.models.train import make_gpt_train_step, synthetic_batch
+    from byteps_tpu.parallel import MeshAxes, make_mesh
+
+    tokens, targets = synthetic_batch(jax.random.PRNGKey(0), cfg, batch, seq)
+    mesh = make_mesh(MeshAxes(dp=1), devices=mesh_devices)
+    step, params, opt_state, bsh = make_gpt_train_step(
+        cfg, mesh, optax.adamw(1e-3), compression_params=compression_params
+    )
+    dev_batch = (jax.device_put(tokens, bsh), jax.device_put(targets, bsh))
+
+    gold_tx = optax.adamw(1e-3)
+    gparams = gpt_init(jax.random.PRNGKey(0), cfg)
+    gstate = gold_tx.init(gparams)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def gold_step(p, s, tok, tgt):
+        loss, g = jax.value_and_grad(
+            lambda p_: gpt_loss(p_, tok, tgt, cfg)
+        )(p)
+        u, s = gold_tx.update(g, s, p)
+        return loss, optax.apply_updates(p, u), s
+
+    flops = _transformer_step_flops(
+        cfg.d_model, cfg.n_layers, cfg.d_ff, cfg.vocab_size, batch, seq,
+        mlp=cfg.mlp)
+    return dict(
+        ours=(step, {"p": params, "o": opt_state}, dev_batch),
+        gold=(gold_step, {"p": gparams, "o": gstate}, (tokens, targets)),
+        flops=flops, unit_per_step=batch * seq, unit="tokens",
+    )
+
+
+def _build_bert(cfg, batch, seq, compression_params, mesh_devices):
+    import optax
+
+    from byteps_tpu.models.bert import bert_init, bert_mlm_loss
+    from byteps_tpu.models.train import (
+        make_bert_train_step,
+        synthetic_mlm_batch,
+    )
+    from byteps_tpu.parallel import MeshAxes, make_mesh
+
+    tokens, targets, mask = synthetic_mlm_batch(
+        jax.random.PRNGKey(0), cfg, batch, seq)
+    mesh = make_mesh(MeshAxes(dp=1), devices=mesh_devices)
+    step, params, opt_state, bsh = make_bert_train_step(
+        cfg, mesh, optax.adamw(1e-3), compression_params=compression_params
+    )
+    dev_batch = tuple(jax.device_put(a, bsh) for a in (tokens, targets, mask))
+
+    gold_tx = optax.adamw(1e-3)
+    gparams = bert_init(jax.random.PRNGKey(0), cfg)
+    gstate = gold_tx.init(gparams)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def gold_step(p, s, tok, tgt, m):
+        loss, g = jax.value_and_grad(
+            lambda p_: bert_mlm_loss(p_, tok, tgt, m, cfg)
+        )(p)
+        u, s = gold_tx.update(g, s, p)
+        return loss, optax.apply_updates(p, u), s
+
+    flops = _transformer_step_flops(
+        cfg.d_model, cfg.n_layers, cfg.d_ff, cfg.vocab_size, batch, seq)
+    return dict(
+        ours=(step, {"p": params, "o": opt_state}, dev_batch),
+        gold=(gold_step, {"p": gparams, "o": gstate}, (tokens, targets, mask)),
+        flops=flops, unit_per_step=batch * seq, unit="tokens",
+    )
+
+
+def _build_resnet(cfg, batch, img, compression_params, mesh_devices):
+    import optax
+
+    from byteps_tpu.models.resnet import resnet_init, resnet_loss
+    from byteps_tpu.models.train import make_resnet_train_step
+    from byteps_tpu.parallel import MeshAxes, make_mesh
+
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.normal(rng, (batch, img, img, 3), cfg.dtype)
+    labels = jax.random.randint(rng, (batch,), 0, cfg.num_classes)
+    mesh = make_mesh(MeshAxes(dp=1), devices=mesh_devices)
+    step, params, opt_state, bn_state, bsh = make_resnet_train_step(
+        cfg, mesh, optax.sgd(0.1, momentum=0.9),
+        compression_params=compression_params,
+    )
+    dev_batch = (jax.device_put(images, bsh), jax.device_put(labels, bsh))
+
+    gold_tx = optax.sgd(0.1, momentum=0.9)
+    gparams, gbn = resnet_init(jax.random.PRNGKey(0), cfg)
+    gstate = gold_tx.init(gparams)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def gold_step(p, s, bn, im, lb):
+        (loss, new_bn), g = jax.value_and_grad(
+            lambda p_: resnet_loss(p_, bn, im, lb, cfg, train=True),
+            has_aux=True,
+        )(p)
+        u, s = gold_tx.update(g, s, p)
+        return loss, optax.apply_updates(p, u), s, new_bn
+
+    # conv FLOPs come from XLA's cost analysis of the gold step (no clean
+    # closed form); reuse the AOT executable for the gold timing path so
+    # the train step is not compiled twice (Lowered.compile() does not
+    # populate the jit dispatch cache). Fallback: the textbook ResNet-50
+    # fwd count ≈ 4.1 GFLOP/224² image, train = 3×fwd.
+    gold_exec = gold_step
+    flops = None
+    try:
+        compiled = gold_step.lower(gparams, gstate, gbn, images,
+                                   labels).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        f = float(ca.get("flops", -1))
+        flops = f if f > 0 else None
+        gold_exec = compiled
+    except Exception as e:  # noqa: BLE001 — cost analysis is best-effort
+        _log(f"cost_analysis unavailable: {e!r}")
+    if flops is None and cfg.depths == (3, 4, 6, 3) and img == 224:
+        flops = 3 * 4.1e9 * batch
+    return dict(
+        ours=(step, {"p": params, "o": opt_state, "bn": bn_state}, dev_batch),
+        gold=(gold_exec, {"p": gparams, "o": gstate, "bn": gbn},
+              (images, labels)),
+        flops=flops, unit_per_step=batch, unit="images",
+    )
+
+
+def _model_setup(model: str, compressor: str, on_cpu: bool):
+    """Returns (display_name, build dict) for the selected workload."""
+    from byteps_tpu.models import GPTConfig
+    from byteps_tpu.models.bert import BertConfig
+    from byteps_tpu.models.resnet import ResNetConfig
+
+    cp = _COMPRESSORS[compressor]
+    dev = jax.devices()[:1]
+    if model == "gpt":
+        cfg = (
+            GPTConfig.tiny() if on_cpu else
+            GPTConfig(vocab_size=32768, max_seq=512, d_model=512, n_heads=8,
+                      n_layers=8, d_ff=2048, dtype=jnp.bfloat16)
+        )
+        b, s = (4, 32) if on_cpu else (8, 512)
+        return f"GPT d{cfg.d_model}/L{cfg.n_layers}", _build_gpt(
+            cfg, b, s, cp, dev)
+    if model == "gpt2m":
+        cfg = (
+            GPTConfig.tiny() if on_cpu else
+            GPTConfig(vocab_size=50304, max_seq=1024, d_model=1024,
+                      n_heads=16, n_layers=24, d_ff=4096,
+                      dtype=jnp.bfloat16)
+        )
+        b, s = (4, 32) if on_cpu else (4, 1024)
+        name = "GPT-2-medium" if not on_cpu else "GPT-2-medium(tiny-sub)"
+        return name, _build_gpt(cfg, b, s, cp, dev)
+    if model == "bert":
+        cfg = (
+            BertConfig.tiny() if on_cpu else
+            BertConfig(dtype=jnp.bfloat16)  # base: d768/L12
+        )
+        b, s = (4, 32) if on_cpu else (8, 512)
+        return f"BERT d{cfg.d_model}/L{cfg.n_layers}", _build_bert(
+            cfg, b, s, cp, dev)
+    if model == "resnet50":
+        cfg = (
+            ResNetConfig.tiny() if on_cpu else
+            ResNetConfig(dtype=jnp.bfloat16)
+        )
+        b, img = (4, 32) if on_cpu else (32, 224)
+        return "ResNet-50" if not on_cpu else "ResNet-tiny", _build_resnet(
+            cfg, b, img, cp, dev)
+    raise ValueError(f"unknown model {model!r}")
+
+
+def bench_model_singlechip(model: str, compressor: str) -> dict:
+    on_cpu = jax.devices()[0].platform == "cpu"
+    kind, peak = _detect_peak()
+    cal_tflops, cal_mfu, linearity = _calibrate(peak, on_cpu)
+
+    name, built = _model_setup(model, compressor, on_cpu)
+    step, state, dev_batch = built["ours"]
+    gold_step, gold, host_batch = built["gold"]
+    flops = built["flops"]
+
+    inner = 4 if on_cpu else (10 if model in ("gpt2m", "resnet50") else 20)
+
+    def run_ours():
+        out = None
+        for _ in range(inner):
+            out = step(*state.values(), *dev_batch)
+            for k, v in zip(state, out[1:]):
+                state[k] = v
+        return _fence(out[1])  # params tree: gates the full update chain
+
+    def run_gold():
+        out = None
+        for _ in range(inner):
+            out = gold_step(*gold.values(), *host_batch)
+            for k, v in zip(gold, out[1:]):
+                gold[k] = v
+        return _fence(out[1])
+
+    # ≥3 repeated interleaved blocks: the device tunnel's latency drifts
+    # between runs, so a single 8-iteration median can swing ±20%; the
+    # reported ratio is the median of block ratios and the JSON carries
+    # the spread for the judge to sanity-check
+    ratios, ours_ms = [], []
+    for rep in range(3):
+        t_ours, t_gold = _time_pair(run_ours, run_gold)
+        t_ours /= inner
+        t_gold /= inner
+        ratios.append(t_gold / t_ours)  # >1 means FASTER than plain jax
+        ours_ms.append(t_ours * 1e3)
+        _log(f"{name}{'+' + compressor if compressor != 'none' else ''} "
+             f"rep{rep}: ours {t_ours*1e3:.2f}ms, plain {t_gold*1e3:.2f}ms, "
+             f"ratio {ratios[-1]:.4f}")
+    t_step = float(np.median(ours_ms)) / 1e3
+
+    # per-step-fenced cross-check: fence EVERY step instead of chaining
+    # `inner` steps per fence — an upper bound including one host round
+    # trip per step; a chained time far below it that also implies
+    # impossible MFU is the async-leak signature
+    def one_step():
+        out = step(*state.values(), *dev_batch)
+        for k, v in zip(state, out[1:]):
+            state[k] = v
+        return _fence(out[0])
+    t_step_fenced = _time_it(one_step, warmup=2, iters=8)
+
+    achieved_tflops = flops / t_step / 1e12 if flops else None
+    mfu = (achieved_tflops / peak
+           if (achieved_tflops is not None and peak) else None)
+    trusted = True
+    if linearity < 1.5:
+        trusted = False
+        _log(f"WARNING: linearity {linearity:.2f} « 2.0 — the timing path "
+             "does not scale with submitted work; absolute times are "
+             "untrustworthy (async completion leak)")
+    if cal_mfu is not None and cal_mfu > 1.05:
+        trusted = False
+        _log(f"WARNING: calibration matmul implies {100*cal_mfu:.0f}% of "
+             f"chip peak — physically impossible; timing or device "
+             "identity is wrong")
+    if mfu is not None and mfu > 1.0:
+        trusted = False
+        _log(f"WARNING: implied MFU {100*mfu:.0f}% > 100% — absolute "
+             "throughput untrusted; the interleaved A/B ratio remains "
+             "valid (both sides share the backend's behavior)")
+
+    ups = built["unit_per_step"]
+    return {
+        "metric": f"{name}"
+                  f"{'+' + compressor if compressor != 'none' else ''}"
+                  " train-step throughput (full framework, 1 chip)",
+        "value": round(ups / t_step, 1),
+        "unit": f"{built['unit']}/s",
+        "vs_baseline": round(float(np.median(ratios)), 4),
+        "ratio_spread": [round(min(ratios), 4), round(max(ratios), 4)],
+        "step_ms": [round(m, 3) for m in ours_ms],
+        "step_ms_fenced_each": round(t_step_fenced * 1e3, 3),
+        "device_kind": kind,
+        "peak_tflops_bf16": peak,
+        "flops_per_step": flops,
+        "achieved_tflops": (round(achieved_tflops, 2)
+                            if achieved_tflops is not None else None),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "calibration_tflops": round(cal_tflops, 2),
+        "calibration_mfu": (round(cal_mfu, 4)
+                            if cal_mfu is not None else None),
+        "linearity": round(linearity, 3),
+        "absolute_trusted": trusted,
+    }
+
+
 def bench_allreduce_multichip() -> dict:
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -123,90 +519,6 @@ def bench_allreduce_multichip() -> dict:
     }
 
 
-def bench_gpt_singlechip() -> dict:
-    import optax
-
-    from byteps_tpu.models import GPTConfig, gpt_init, gpt_loss
-    from byteps_tpu.models.train import make_gpt_train_step, synthetic_batch
-    from byteps_tpu.parallel import MeshAxes, make_mesh
-
-    on_cpu = jax.devices()[0].platform == "cpu"
-    cfg = (
-        GPTConfig.tiny() if on_cpu else
-        GPTConfig(vocab_size=32768, max_seq=512, d_model=512, n_heads=8,
-                  n_layers=8, d_ff=2048, dtype=jnp.bfloat16)
-    )
-    batch, seq = (4, 32) if on_cpu else (8, 512)
-    tokens, targets = synthetic_batch(jax.random.PRNGKey(0), cfg, batch, seq)
-
-    # ours: full framework path on a 1-device mesh
-    mesh = make_mesh(MeshAxes(dp=1), devices=jax.devices()[:1])
-    step, params, opt_state, bsh = make_gpt_train_step(
-        cfg, mesh, optax.adamw(1e-3)
-    )
-    tok_s = jax.device_put(tokens, bsh)
-    tgt_s = jax.device_put(targets, bsh)
-
-    state = {"p": params, "o": opt_state}
-    inner = 4 if on_cpu else 20  # steps per timed sample (async-chained)
-
-    def run_ours():
-        for _ in range(inner):
-            loss, state["p"], state["o"] = step(
-                state["p"], state["o"], tok_s, tgt_s
-            )
-        jax.block_until_ready(state["p"])
-
-    # plain jax+optax baseline, identical model/loss
-    gold_tx = optax.adamw(1e-3)
-    gparams = gpt_init(jax.random.PRNGKey(0), cfg)
-    gstate = gold_tx.init(gparams)
-
-    import functools
-
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def gold_step(p, s, tok, tgt):
-        loss, g = jax.value_and_grad(
-            lambda p_: gpt_loss(p_, tok, tgt, cfg)
-        )(p)
-        u, s = gold_tx.update(g, s, p)
-        return loss, optax.apply_updates(p, u), s
-
-    gold = {"p": gparams, "o": gstate}
-
-    def run_gold():
-        for _ in range(inner):
-            loss, gold["p"], gold["o"] = gold_step(gold["p"], gold["o"],
-                                                   tokens, targets)
-        jax.block_until_ready(gold["p"])
-
-    # ≥3 repeated interleaved blocks: the device tunnel's latency drifts
-    # between runs, so a single 8-iteration median can swing ±20%; the
-    # reported ratio is the median of block ratios and the JSON carries
-    # the spread for the judge to sanity-check
-    ratios, ours_ms = [], []
-    for rep in range(3):
-        t_ours, t_gold = _time_pair(run_ours, run_gold)
-        t_ours /= inner
-        t_gold /= inner
-        ratios.append(t_gold / t_ours)  # >1 means FASTER than plain jax
-        ours_ms.append(t_ours * 1e3)
-        _log(f"gpt train step rep{rep} "
-             f"({'tiny/cpu' if on_cpu else 'base/tpu'}): "
-             f"ours {t_ours*1e3:.2f}ms, plain {t_gold*1e3:.2f}ms, "
-             f"ratio {ratios[-1]:.4f}")
-    t_ours_med = float(np.median(ours_ms)) / 1e3
-    tps = batch * seq / t_ours_med
-    return {
-        "metric": "GPT train-step throughput (full framework, 1 chip)",
-        "value": round(tps, 1),
-        "unit": "tokens/s",
-        "vs_baseline": round(float(np.median(ratios)), 4),
-        "ratio_spread": [round(min(ratios), 4), round(max(ratios), 4)],
-        "step_ms": [round(m, 3) for m in ours_ms],
-    }
-
-
 def bench_dcn() -> dict:
     """DCN summation-tier goodput on localhost: 2 workers + 1 native
     server, 4 MB partitions (the reference partition size), 4 pipeline
@@ -220,7 +532,6 @@ def bench_dcn() -> dict:
     from byteps_tpu.server import PSWorker, start_server, stop_server
 
     port = 23900
-    import os
     ncpu = os.cpu_count() or 1
     # thread count scales with cores: on a 1-core host extra threads only
     # thrash the scheduler (everything — clients, server engine, memcpys —
@@ -340,15 +651,34 @@ def _devices_or_die(timeout_s: float) -> int:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", choices=["auto", "dcn"], default="auto")
+    ap.add_argument("--model",
+                    choices=["gpt", "gpt2m", "bert", "resnet50"],
+                    default="gpt",
+                    help="single-chip workload (BASELINE configs: "
+                    "2=resnet50, 3=bert --compressor onebit, "
+                    "4=gpt2m --compressor topk)")
+    ap.add_argument("--compressor", choices=sorted(_COMPRESSORS),
+                    default="none",
+                    help="route dp aggregation through this compressor "
+                    "(single-chip: exercises the Pallas compress path; "
+                    "no comm to win back, so expect ratio < 1)")
     args = ap.parse_args()
+    flags_set = args.model != "gpt" or args.compressor != "none"
     if args.mode == "dcn":
+        if flags_set:
+            _log("bench: WARNING --model/--compressor ignored in dcn mode")
         result = bench_dcn()
     else:
         n = _devices_or_die(
             float(os.environ.get("BYTEPS_BENCH_DEVICE_TIMEOUT", "600")))
         _log(f"bench: {n} device(s): {jax.devices()[0].device_kind}")
-        result = (bench_allreduce_multichip() if n > 1
-                  else bench_gpt_singlechip())
+        if n > 1:
+            if flags_set:
+                _log("bench: WARNING --model/--compressor ignored with >1 "
+                     "device (all-reduce bandwidth mode)")
+            result = bench_allreduce_multichip()
+        else:
+            result = bench_model_singlechip(args.model, args.compressor)
     print(json.dumps(result), flush=True)
 
 

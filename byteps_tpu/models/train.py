@@ -1068,6 +1068,7 @@ def make_eval_step(cfg: GPTConfig, mesh: Mesh, seq_layout: str = "contiguous"):
     optimizer, no grads, safe to call on training params at any step.
     """
     dp, tp, sp = _axis(mesh, "dp"), _axis(mesh, "tp"), _axis(mesh, "sp")
+    _check_seq_layout(seq_layout, sp)
     batch_spec = P(dp, sp)
     pspecs = gpt_param_specs(cfg, tp)
 
