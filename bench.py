@@ -316,12 +316,12 @@ def _build_resnet(cfg, batch, img, compression_params, mesh_devices):
     try:
         compiled = gold_step.lower(gparams, gstate, gbn, images,
                                    labels).compile()
+        gold_exec = compiled  # keep the executable even if analysis fails
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0]
         f = float(ca.get("flops", -1))
         flops = f if f > 0 else None
-        gold_exec = compiled
     except Exception as e:  # noqa: BLE001 — cost analysis is best-effort
         _log(f"cost_analysis unavailable: {e!r}")
     if flops is None and cfg.depths == (3, 4, 6, 3) and img == 224:
@@ -432,7 +432,7 @@ def bench_model_singlechip(model: str, compressor: str) -> dict:
         out = step(*state.values(), *dev_batch)
         for k, v in zip(state, out[1:]):
             state[k] = v
-        return _fence(out[0])
+        return _fence(out[1])  # params tree: gates the full update chain
     t_step_fenced = _time_it(one_step, warmup=2, iters=8)
 
     achieved_tflops = flops / t_step / 1e12 if flops else None
