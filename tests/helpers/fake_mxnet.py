@@ -1,0 +1,100 @@
+"""Minimal vendored-mxnet stand-in: the exact surface
+``byteps_tpu/mxnet/adapter.py`` touches, over numpy.
+
+MXNet is EOL and absent from this image, so without this shim the adapter
+is 217 lines of never-executed code. The gate's contract is "with a
+vendored mxnet on sys.path the full surface loads" — this IS such a
+vendored mxnet, just small: ``nd.array``/``NDArray`` (numpy-backed,
+in-place ``[:]`` assignment, ``asnumpy``), ``gluon.Parameter``
+(``list_data``/``list_grad``/``grad_req``/``shape``) and
+``gluon.Trainer`` (``_params``, ``_scale``, ``_allreduce_grads`` hook
+point). ``install()``/``uninstall()`` register/remove it as the
+importable ``mxnet`` package.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as np
+
+
+class NDArray:
+    def __init__(self, data, dtype=None):
+        self._a = np.array(
+            data, dtype=dtype if dtype is not None else np.float32)
+
+    @property
+    def shape(self):
+        return self._a.shape
+
+    @property
+    def dtype(self):
+        return self._a.dtype
+
+    def asnumpy(self):
+        return self._a.copy()
+
+    def __setitem__(self, idx, value):
+        self._a[idx] = value._a if isinstance(value, NDArray) else value
+
+    def __getitem__(self, idx):
+        return self._a[idx]
+
+
+def array(data, dtype=None):
+    return NDArray(data, dtype)
+
+
+class Parameter:
+    def __init__(self, name, shape, grad_req="write"):
+        self.name = name
+        self.shape = tuple(shape)
+        self.grad_req = grad_req
+        self._data = NDArray(np.zeros(self.shape, np.float32))
+        self._grad = NDArray(np.zeros(self.shape, np.float32))
+
+    def list_data(self):
+        return [self._data]
+
+    def list_grad(self):
+        return [self._grad]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore=None):
+        assert kvstore is None, "byteps forces the kvstore off"
+        self._params = (list(params.values()) if hasattr(params, "values")
+                        else list(params))
+        self._scale = 1.0
+
+    def _allreduce_grads(self):  # overridden by DistributedTrainer
+        pass
+
+
+_nd = types.ModuleType("mxnet.nd")
+_nd.array = array
+_nd.NDArray = NDArray
+_gluon = types.ModuleType("mxnet.gluon")
+_gluon.Trainer = Trainer
+_gluon.Parameter = Parameter
+
+
+def install():
+    """Register the shim as the importable ``mxnet`` package."""
+    m = types.ModuleType("mxnet")
+    m.nd = _nd
+    m.gluon = _gluon
+    m.NDArray = NDArray
+    m.__fake__ = True
+    sys.modules["mxnet"] = m
+    sys.modules["mxnet.nd"] = _nd
+    sys.modules["mxnet.gluon"] = _gluon
+    return m
+
+
+def uninstall():
+    for k in ("mxnet", "mxnet.nd", "mxnet.gluon"):
+        sys.modules.pop(k, None)
