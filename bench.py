@@ -614,6 +614,100 @@ def bench_dcn() -> dict:
     }
 
 
+def bench_dcn_profile() -> dict:
+    """Component breakdown behind the DCN goodput number: on this host,
+    what do the raw ingredients cost? (a) pure loopback TCP throughput of
+    4 MB frames — the transport ceiling with zero server logic; (b) the
+    server's fp32 sum bandwidth (reduce_sum_f32); (c) host memcpy
+    bandwidth. Together these bound what any PS implementation could
+    deliver on this CPU, which is the evidence for/against the
+    'CPU-bound floor, not a transport ceiling' claim in
+    docs/performance.md."""
+    import socket
+    import threading
+
+    import numpy as np
+
+    nbytes = 4 * 1024 * 1024
+    rounds = 48
+
+    # (a) loopback TCP: one sender thread, one receiver thread
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    payload = np.random.default_rng(0).bytes(nbytes)
+    got = {}
+
+    def rx():
+        conn, _ = srv.accept()
+        buf = bytearray(nbytes)
+        view = memoryview(buf)
+        total = 0
+        for _ in range(rounds):
+            need = nbytes
+            off = 0
+            while need:
+                r = conn.recv_into(view[off:], need)
+                if not r:
+                    return
+                off += r
+                need -= r
+            total += nbytes
+        got["rx"] = total
+        conn.close()
+
+    t = threading.Thread(target=rx)
+    t.start()
+    cli = socket.create_connection(("127.0.0.1", port))
+    cli.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        cli.sendall(payload)
+    t.join()
+    el_tcp = time.perf_counter() - t0
+    cli.close()
+    srv.close()
+    tcp_gbps = got.get("rx", 0) / el_tcp / 1e9
+
+    # (b) server sum bandwidth (the engine's decode_sum raw path)
+    from byteps_tpu.server import reduce_sum_f32
+
+    acc = np.zeros(nbytes // 4, np.float32)
+    src = np.random.default_rng(1).standard_normal(nbytes // 4).astype(
+        np.float32)
+    reduce_sum_f32(acc, src)  # warm
+    t0 = time.perf_counter()
+    it = 64
+    for _ in range(it):
+        reduce_sum_f32(acc, src)
+    el_sum = time.perf_counter() - t0
+    sum_gbps = it * nbytes / el_sum / 1e9  # payload bytes summed per sec
+
+    # (c) memcpy bandwidth
+    dst = np.empty_like(src)
+    t0 = time.perf_counter()
+    for _ in range(it):
+        np.copyto(dst, src)
+    el_cp = time.perf_counter() - t0
+    memcpy_gbps = it * nbytes / el_cp / 1e9
+
+    ncpu = os.cpu_count() or 1
+    _log(f"dcn-profile ({ncpu} cpu): loopback TCP {tcp_gbps:.2f} GB/s, "
+         f"fp32 sum {sum_gbps:.2f} GB/s, memcpy {memcpy_gbps:.2f} GB/s")
+    return {
+        "metric": "DCN host component ceilings (loopback TCP one-way)",
+        "value": round(tcp_gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": 1.0,
+        "cpu_count": ncpu,
+        "loopback_tcp_gbps": round(tcp_gbps, 3),
+        "fp32_sum_gbps": round(sum_gbps, 2),
+        "memcpy_gbps": round(memcpy_gbps, 2),
+    }
+
+
 def _devices_or_die(timeout_s: float) -> int:
     """Initialize the backend with a watchdog.
 
@@ -650,7 +744,8 @@ def _devices_or_die(timeout_s: float) -> int:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", choices=["auto", "dcn"], default="auto")
+    ap.add_argument("--mode", choices=["auto", "dcn", "dcn-profile"],
+                    default="auto")
     ap.add_argument("--model",
                     choices=["gpt", "gpt2m", "bert", "resnet50"],
                     default="gpt",
@@ -664,10 +759,10 @@ def main() -> None:
                     "no comm to win back, so expect ratio < 1)")
     args = ap.parse_args()
     flags_set = args.model != "gpt" or args.compressor != "none"
-    if args.mode == "dcn":
+    if args.mode in ("dcn", "dcn-profile"):
         if flags_set:
             _log("bench: WARNING --model/--compressor ignored in dcn mode")
-        result = bench_dcn()
+        result = bench_dcn() if args.mode == "dcn" else bench_dcn_profile()
     else:
         n = _devices_or_die(
             float(os.environ.get("BYTEPS_BENCH_DEVICE_TIMEOUT", "600")))

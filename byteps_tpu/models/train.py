@@ -11,10 +11,15 @@ VMA notes (apply to every factory): per-device AD is exact under
 auto-inserted, and marking params dp-varying (``pcast``) keeps grads
 per-replica LOCAL so dp aggregation stays in DistributedOptimizer. The
 compressed collective (comm/ici.py) and the ZeRO-1 all_gather defeat the
-VMA replication analysis, so those modes run ``check_vma=False``: tp/sp
-axes are excluded (their in-forward collectives need VMA typing), while
-pp and ep compose — each leaf's stage-partial grads are psum'd
-explicitly over the axes its spec doesn't shard (``_manual_axis_sums``).
+VMA replication analysis, so those modes run ``check_vma=False`` with the
+VMA-equivalent gradient assembly done explicitly: pp/ep stage-partial
+grads psum over the axes their specs don't shard (``_manual_axis_sums``),
+and tp/sp — whose in-forward collectives leave no-VMA AD computing
+``d(sum over replicated loss copies)/dw`` via psum self-transpose — get
+the same psums plus a uniform division by the tp*sp axis product
+(``_novma_collective_fix``; pinned against the VMA path in
+tests/test_compressed_parallel.py). Every parallel composition therefore
+works compressed: dp x {tp, sp, pp, ep} and their products.
 """
 
 from __future__ import annotations
@@ -82,15 +87,32 @@ def _check_seq_layout(seq_layout, sp=None):
             "this mesh the permuted inputs would just be scrambled tokens")
 
 
-def _check_compression_mesh(use_vma, tp, sp):
-    if not use_vma and (tp is not None or sp is not None):
-        raise NotImplementedError(
-            "compressed aggregation and ZeRO-1 (zero_1=True) require a "
-            "mesh without tp/sp axes: their in-forward collectives need "
-            "the VMA path, which neither the compressed collective nor "
-            "the ZeRO all_gather supports. pp and ep compose — their grad "
-            "psums run explicitly in check_vma=False mode."
-        )
+def _novma_collective_fix(grads, pspecs, mesh, rep_axes, extra_sum_axes=()):
+    """Correct check_vma=False gradients for in-forward collective axes.
+
+    In no-VMA mode ``jax.lax.psum`` is its own transpose, so the adjoint
+    computes ``d(sum over all replicated loss copies)/dw`` — every
+    device's raw grad carries the cotangents of EVERY replica's loss copy
+    (verified: after the per-leaf psums, every leaf is exactly
+    ``prod(rep_axes sizes)`` times the VMA path's gradient, uniformly).
+    The fix: psum each leaf over the axes its spec doesn't shard (what
+    VMA would auto-insert; ``extra_sum_axes`` adds pp/ep whose
+    stage-partial sums are needed too), then divide ALL leaves by the
+    ``rep_axes`` product. ``rep_axes`` must be exactly the axes the loss
+    is REPLICATED over before grad (tp/sp here — pp's loss is
+    stage-masked and ep's is a per-device local mean, so they get sums
+    but no division)."""
+    rep_axes = tuple(a for a in rep_axes if a is not None)
+    sum_axes = rep_axes + tuple(a for a in extra_sum_axes if a is not None)
+    if not sum_axes:
+        return grads
+    grads = _manual_axis_sums(grads, pspecs, sum_axes)
+    denom = 1
+    for a in rep_axes:
+        denom *= mesh.shape[a]
+    if denom > 1:
+        grads = jax.tree.map(lambda g: g / denom, grads)
+    return grads
 
 
 def _dist_state_setup(mesh, params, pspecs, dp, zero_1):
@@ -333,18 +355,17 @@ def _make_resymmetrize(pspecs, dp):
 
 
 def _build_pp_jit(mesh, pspecs, ospecs, batch_spec, loss_fn, tx, dp, pp,
-                  ep=None, ep_size=1, mean_axes=(), use_vma=True):
+                  ep=None, ep_size=1, mean_axes=(), use_vma=True,
+                  rep_axes=()):
     """The grad-assembly skeleton both pipeline factories share: per-device
     masked loss -> psum of each leaf's stage-partial grads over the axes it
-    is NOT sharded on (pp always; ep too under check_vma=False, where no
-    VMA auto-psum exists), optional uniform /ep, resym, dp aggregation via
-    ``tx``, and VMA-collapsed loss reporting. ``use_vma=False`` is the
-    compressed mode (the compressed collective defeats VMA's replication
-    analysis)."""
+    is NOT sharded on (pp always; ep and tp/sp too under check_vma=False,
+    where no VMA auto-psum exists), optional uniform /ep, the
+    ``rep_axes`` (tp/sp) replicated-loss division (see
+    ``_novma_collective_fix``), resym, dp aggregation via ``tx``, and
+    VMA-collapsed loss reporting. ``use_vma=False`` is the compressed /
+    ZeRO mode (their collectives defeat VMA's replication analysis)."""
     resym = _make_resymmetrize(pspecs, dp)
-    # under check_vma=True VMA auto-inserts the ep psums for ep-invariant
-    # leaves; manual-summing them too would double-count
-    sum_axes = (pp,) if use_vma else tuple(a for a in (pp, ep) if a)
 
     def per_device_step(params, opt_state, tokens, targets):
         grad_params = _pcast_dp(params, dp, mesh, use_vma)
@@ -354,7 +375,13 @@ def _build_pp_jit(mesh, pspecs, ospecs, batch_spec, loss_fn, tx, dp, pp,
             grad_params, tokens, targets
         )
         loss = jax.lax.psum(loss, pp)  # replicate for reporting
-        grads = _manual_axis_sums(grads, pspecs, sum_axes)
+        if use_vma:
+            # VMA auto-inserts the ep/tp/sp psums for invariant leaves;
+            # manual-summing them too would double-count
+            grads = _manual_axis_sums(grads, pspecs, (pp,))
+        else:
+            grads = _novma_collective_fix(
+                grads, pspecs, mesh, rep_axes, extra_sum_axes=(pp, ep))
         if ep_size > 1:
             grads = jax.tree.map(lambda g: g / ep_size, grads)
         grads = resym(grads)  # collapse conservative VMA widening (no-op
@@ -421,7 +448,6 @@ def make_gpt_train_step(
     dp, tp, sp = _axis(mesh, "dp"), _axis(mesh, "tp"), _axis(mesh, "sp")
     _check_seq_layout(seq_layout, sp)
     use_vma = compression_params is None and not zero_1
-    _check_compression_mesh(use_vma, tp, sp)
     pspecs = gpt_param_specs(cfg, tp)
     params = gpt_init(jax.random.PRNGKey(0), cfg)
     state_axes, tx_kw, zero_numel = _dist_state_setup(
@@ -454,6 +480,8 @@ def make_gpt_train_step(
             loss, grads = vag(grad_params, tokens, targets)
             if use_vma:
                 grads = resym(grads)
+            else:
+                grads = _novma_collective_fix(grads, pspecs, mesh, (tp, sp))
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             if dp is not None:
@@ -501,10 +529,11 @@ def make_gpt_pp_train_step(
     DistributedOptimizer as everywhere else; grads of pp-replicated
     leaves (embeddings, final LN) are psum'd over pp first.
 
-    ``compression_params`` enables compressed dp aggregation on a
-    (pp, dp)-only mesh (check_vma=False mode, like the dense factory's):
-    each stage compresses its own slab + replicated-leaf grads over dp,
-    with per-(stage, worker) EF/momentum state.
+    ``compression_params`` enables compressed dp aggregation
+    (check_vma=False mode, like the dense factory's): each stage
+    compresses its own slab + replicated-leaf grads over dp, with
+    per-(stage, worker) EF/momentum state; tp/sp compose via the
+    explicit no-VMA gradient assembly (``_novma_collective_fix``).
 
     ``seq_layout="zigzag"`` runs the load-balanced causal ring over sp
     inside the stages — feed tokens/targets pre-permuted with
@@ -521,7 +550,6 @@ def make_gpt_pp_train_step(
         raise ValueError("mesh has no pp axis — use make_gpt_train_step")
     _check_seq_layout(seq_layout, sp)
     use_vma = compression_params is None and not zero_1
-    _check_compression_mesh(use_vma, tp, sp)
     nstages = mesh.shape[pp]
     if cfg.n_layers % nstages != 0:
         raise ValueError(
@@ -558,6 +586,7 @@ def make_gpt_pp_train_step(
         return _build_pp_jit(
             mesh, pspecs, ospecs, batch_spec, loss_fn, tx, dp, pp,
             mean_axes=(dp,) if dp is not None else (), use_vma=use_vma,
+            rep_axes=(tp, sp),
         )
 
     return (
@@ -587,10 +616,11 @@ def make_gpt_moe_train_step(
     mean-of-local-means loss needs; dp averaging stays in
     DistributedOptimizer as everywhere else.
 
-    ``compression_params`` enables compressed dp aggregation on a
-    (dp, ep)-only mesh (check_vma=False mode): the ep psums of
-    ep-invariant leaves run explicitly, then each (ep group, dp worker)
-    compresses its grads over dp with its own EF/momentum state.
+    ``compression_params`` enables compressed dp aggregation
+    (check_vma=False mode): the ep psums of ep-invariant leaves run
+    explicitly (tp/sp via ``_novma_collective_fix``), then each
+    (ep group, dp worker) compresses its grads over dp with its own
+    EF/momentum state.
 
     ``seq_layout="zigzag"`` runs the load-balanced causal ring over sp —
     feed tokens/targets pre-permuted with ``zigzag_permutation``, as for
@@ -615,7 +645,6 @@ def make_gpt_moe_train_step(
         )
     _check_seq_layout(seq_layout, sp)
     use_vma = compression_params is None and not zero_1
-    _check_compression_mesh(use_vma, tp, sp)
     ep_size = mesh.shape[ep] if ep is not None else 1
     if ep is not None and cfg.n_experts % ep_size != 0:
         raise ValueError(
@@ -645,15 +674,17 @@ def make_gpt_moe_train_step(
             loss, grads = jax.value_and_grad(loss_fn)(
                 grad_params, tokens, targets
             )
+            if not use_vma:
+                grads = _novma_collective_fix(
+                    grads, pspecs, mesh, (tp, sp), extra_sum_axes=(ep,))
             if ep is not None:
                 # the global loss is the MEAN of per-device local means;
                 # the ep-invariant leaves' grads must arrive SUMMED over
                 # ep (VMA auto-psum under check_vma=True, explicit psums
-                # in compressed mode) and the expert slabs already summed
-                # their peers' contributions through the all_to_all
-                # transpose — one uniform /ep gives means
-                if not use_vma:
-                    grads = _manual_axis_sums(grads, pspecs, (ep,))
+                # in compressed mode via _novma_collective_fix) and the
+                # expert slabs already summed their peers' contributions
+                # through the all_to_all transpose — one uniform /ep
+                # gives means
                 grads = jax.tree.map(lambda g: g / ep_size, grads)
             grads = resym(grads)  # collapse conservative VMA widening
             updates, opt_state = tx.update(grads, opt_state, params)
@@ -720,7 +751,6 @@ def make_gpt_moe_pp_train_step(
         raise ValueError("mesh has no pp axis — use make_gpt_moe_train_step")
     _check_seq_layout(seq_layout, sp)
     use_vma = compression_params is None and not zero_1
-    _check_compression_mesh(use_vma, tp, sp)
     nstages = mesh.shape[pp]
     ep_size = mesh.shape[ep] if ep is not None else 1
     if cfg.n_layers % nstages != 0:
@@ -763,7 +793,7 @@ def make_gpt_moe_pp_train_step(
             mesh, pspecs, ospecs, batch_spec, loss_fn, tx, dp, pp,
             ep=ep, ep_size=ep_size if ep is not None else 1,
             mean_axes=tuple(a for a in (dp, ep) if a is not None),
-            use_vma=use_vma,
+            use_vma=use_vma, rep_axes=(tp, sp),
         )
 
     return (
@@ -787,7 +817,6 @@ def make_bert_train_step(
     accum_steps semantics included)."""
     dp, tp, sp = _axis(mesh, "dp"), _axis(mesh, "tp"), _axis(mesh, "sp")
     use_vma = compression_params is None and not zero_1
-    _check_compression_mesh(use_vma, tp, sp)
     pspecs = bert_param_specs(cfg, tp)
     params = bert_init(jax.random.PRNGKey(0), cfg)
     state_axes, tx_kw, zero_numel = _dist_state_setup(
@@ -824,6 +853,8 @@ def make_bert_train_step(
             loss, grads = vag(grad_params, tokens, targets, mask)
             if use_vma:
                 grads = resym(grads)
+            else:
+                grads = _novma_collective_fix(grads, pspecs, mesh, (tp, sp))
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             if dp is not None:
@@ -861,7 +892,6 @@ def make_t5_train_step(
     decoder blocks (models/t5.py)."""
     dp, tp = _axis(mesh, "dp"), _axis(mesh, "tp")
     use_vma = compression_params is None and not zero_1
-    _check_compression_mesh(use_vma, tp, None)
     pspecs = t5_param_specs(cfg, tp)
     params = t5_init(jax.random.PRNGKey(0), cfg)
     state_axes, tx_kw, zero_numel = _dist_state_setup(
@@ -887,6 +917,8 @@ def make_t5_train_step(
             loss, grads = vag(grad_params, src, tgt_in, tgt_out)
             if use_vma:
                 grads = resym(grads)
+            else:
+                grads = _novma_collective_fix(grads, pspecs, mesh, (tp,))
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             if dp is not None:
@@ -924,7 +956,6 @@ def make_vit_train_step(
     ResNet (sp intentionally unsupported — models/vit.py rationale)."""
     dp, tp = _axis(mesh, "dp"), _axis(mesh, "tp")
     use_vma = compression_params is None and not zero_1
-    _check_compression_mesh(use_vma, tp, None)
     pspecs = vit_param_specs(cfg, tp)
     params = vit_init(jax.random.PRNGKey(0), cfg)
     state_axes, tx_kw, zero_numel = _dist_state_setup(
@@ -950,6 +981,8 @@ def make_vit_train_step(
             loss, grads = vag(grad_params, images, labels)
             if use_vma:
                 grads = resym(grads)
+            else:
+                grads = _novma_collective_fix(grads, pspecs, mesh, (tp,))
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             if dp is not None:

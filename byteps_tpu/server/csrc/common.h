@@ -23,6 +23,7 @@
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
+#include <sys/uio.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
 #include <unistd.h>
@@ -119,8 +120,35 @@ inline bool send_frame(int fd, Cmd cmd, uint64_t key, uint64_t version,
   h.key = key;
   h.version = version;
   h.len = len;
-  if (!send_all(fd, &h, sizeof(h))) return false;
-  if (len > 0 && !send_all(fd, payload, len)) return false;
+  // scatter-gather write: header + payload leave in one sendmsg (one
+  // syscall and one coalesced TCP segment stream instead of two sends
+  // per frame; MSG_NOSIGNAL keeps the no-SIGPIPE contract of send_all)
+  iovec iov[2];
+  iov[0].iov_base = &h;
+  iov[0].iov_len = sizeof(h);
+  iov[1].iov_base = const_cast<void*>(payload);
+  iov[1].iov_len = len;
+  msghdr msg{};
+  msg.msg_iov = iov;
+  msg.msg_iovlen = len > 0 ? 2 : 1;
+  while (msg.msg_iovlen > 0) {
+    ssize_t w = ::sendmsg(fd, &msg, MSG_NOSIGNAL);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    size_t n = static_cast<size_t>(w);
+    while (msg.msg_iovlen > 0 && n >= msg.msg_iov[0].iov_len) {
+      n -= msg.msg_iov[0].iov_len;
+      ++msg.msg_iov;
+      --msg.msg_iovlen;
+    }
+    if (msg.msg_iovlen > 0 && n > 0) {
+      msg.msg_iov[0].iov_base =
+          static_cast<char*>(msg.msg_iov[0].iov_base) + n;
+      msg.msg_iov[0].iov_len -= n;
+    }
+  }
   return true;
 }
 

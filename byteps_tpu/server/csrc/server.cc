@@ -1,5 +1,6 @@
 #include "server.h"
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -51,6 +52,12 @@ struct uninit_alloc : std::allocator<T> {
   }
 };
 using RawBuf = std::vector<char, uninit_alloc<char>>;
+// Accumulator/snapshot buffers skip value-initialization too: a closing
+// round MOVES accum into the snapshot and must re-allocate; zero-filling
+// 4 MB per round per key costs real memory bandwidth on the engine's
+// critical path, and the first push of a round overwrites (raw memcpy) or
+// explicitly zero+sums (other codecs) anyway.
+using FloatBuf = std::vector<float, uninit_alloc<float>>;
 
 // Ordered executor over the shared engine pool, one per (key, worker).
 // A worker's pushes for one key are applied in RECEIVE order: two
@@ -96,9 +103,10 @@ struct DeferredPush {
 // Per-key state (reference: BytePSArray store + the "all workers arrived →
 // answer queued pulls" logic in BytePSHandler). `accum` receives the
 // in-progress round; on completion it is MOVED into an immutable
-// shared_ptr snapshot (`result`) and a fresh zeroed accumulator allocated,
-// so responses serialize from the snapshot OUTSIDE the key mutex — large
-// sends never stall other consumers of the key.
+// shared_ptr snapshot (`result`) and a fresh UNINITIALIZED accumulator
+// allocated (the next round's first push overwrites or zero-fills it —
+// see ApplyPushLocked), so responses serialize from the snapshot OUTSIDE
+// the key mutex — large sends never stall other consumers of the key.
 struct KeyStore {
   std::mutex mu;
   std::condition_variable cv;  // local (in-process) pulls wait here
@@ -107,8 +115,8 @@ struct KeyStore {
   // reallocates it under mu, so an unlocked accum.size() can observe 0
   // and spuriously reject a concurrent pipelined push.
   size_t n_elems = 0;
-  std::vector<float> accum;
-  std::shared_ptr<const std::vector<float>> result;
+  FloatBuf accum;
+  std::shared_ptr<const FloatBuf> result;
   uint64_t version = 0;
   uint32_t arrived = 0;
   std::vector<uint8_t> pushed;         // per-worker arrival bitmap (sync)
@@ -302,7 +310,7 @@ class Server {
     if (!running_) return -10;
     KeyStore* ks = Get(key);
     if (ks == nullptr) return -1;
-    std::shared_ptr<const std::vector<float>> snap;
+    std::shared_ptr<const FloatBuf> snap;
     CodecHint hint;
     uint64_t v = 0;
     {
@@ -318,7 +326,7 @@ class Server {
       if (!running_) return -5;
       v = ks->version;
       if (async_) {
-        snap = std::make_shared<const std::vector<float>>(ks->accum);
+        snap = std::make_shared<const FloatBuf>(ks->accum);
         hint = ks->hint;
       } else {
         snap = ks->result;
@@ -486,8 +494,7 @@ class Server {
       slot = std::make_unique<KeyStore>();
       slot->n_elems = nfloats;
       slot->accum.assign(nfloats, 0.f);
-      slot->result =
-          std::make_shared<const std::vector<float>>(nfloats, 0.f);
+      slot->result = std::make_shared<const FloatBuf>(nfloats, 0.f);
       slot->pushed.assign(num_workers_, 0);
     }
     return slot.get();
@@ -507,7 +514,7 @@ class Server {
     ConnPtr conn;
     uint8_t codec;
     uint64_t version;
-    std::shared_ptr<const std::vector<float>> snap;
+    std::shared_ptr<const FloatBuf> snap;
     CodecHint hint;
   };
 
@@ -524,7 +531,23 @@ class Server {
       ks->deferred.push_back({worker, codec, std::move(buf)});
       return;
     }
-    decode_sum(codec, buf->data(), buf->size(), ks->accum.data(), n);
+    if (!async_ && ks->arrived == 0) {
+      // Start of a round: accum is UNINITIALIZED (the close path moves it
+      // into the snapshot and reallocates without a zero-fill). A raw
+      // push OVERWRITES it in one pass — memcpy instead of
+      // zero + read-modify-write saves two full memory sweeps per round
+      // on the engine's critical path; every other codec zero-fills
+      // first, then sums as before.
+      if (codec == kCodecRaw &&
+          buf->size() == static_cast<size_t>(n) * sizeof(float)) {
+        std::memcpy(ks->accum.data(), buf->data(), buf->size());
+      } else {
+        std::fill(ks->accum.begin(), ks->accum.end(), 0.f);
+        decode_sum(codec, buf->data(), buf->size(), ks->accum.data(), n);
+      }
+    } else {
+      decode_sum(codec, buf->data(), buf->size(), ks->accum.data(), n);
+    }
     update_hint(codec, buf->data(), buf->size(), &ks->hint);
     if (async_) {
       ks->version++;
@@ -536,8 +559,12 @@ class Server {
       // round complete: snapshot by MOVE, fresh zeroed accumulator; the
       // codec hint is frozen with the result so deferred next-round pushes
       // below cannot change how THIS round's responses are encoded
-      auto snap = std::make_shared<std::vector<float>>(std::move(ks->accum));
-      ks->accum.assign(snap->size(), 0.f);
+      auto snap = std::make_shared<FloatBuf>(std::move(ks->accum));
+      // moved-from accum is empty; resize on the no-init allocator
+      // allocates WITHOUT the 4 MB zero-fill (the next round's first
+      // push overwrites or zero+sums — ApplyPushLocked's start-of-round
+      // branch)
+      ks->accum.resize(snap->size());
       ks->result = std::move(snap);
       ks->result_hint = ks->hint;
       ks->version++;
@@ -579,7 +606,7 @@ class Server {
         while (it != ks->pending.end()) {
           ready.push_back(
               {it->conn, it->codec, ks->version,
-               std::make_shared<const std::vector<float>>(ks->accum),
+               std::make_shared<const FloatBuf>(ks->accum),
                ks->hint});
           it = ks->pending.erase(it);
         }
@@ -599,7 +626,7 @@ class Server {
   // immutable blob (zero-copy into SendFrame). `hint` is the codec hint
   // snapshotted when `snap`'s round closed, NOT the live ks->hint.
   std::shared_ptr<const std::vector<char>> EncodeResponse(
-      KeyStore* ks, const std::shared_ptr<const std::vector<float>>& snap,
+      KeyStore* ks, const std::shared_ptr<const FloatBuf>& snap,
       const CodecHint& hint, uint64_t version, uint8_t codec) {
     {
       std::lock_guard<std::mutex> lk(ks->mu);
@@ -623,7 +650,7 @@ class Server {
 
   void RespondPull(const ConnPtr& c, uint64_t key, KeyStore* ks,
                    uint8_t codec, uint64_t version,
-                   std::shared_ptr<const std::vector<float>> snap,
+                   std::shared_ptr<const FloatBuf> snap,
                    const CodecHint& hint) {
     const int64_t t0 = realtime_ns();
     if (codec == kCodecRaw) {
@@ -651,7 +678,7 @@ class Server {
     }
     bool ready;
     uint64_t v = 0;
-    std::shared_ptr<const std::vector<float>> snap;
+    std::shared_ptr<const FloatBuf> snap;
     CodecHint hint;
     {
       std::lock_guard<std::mutex> lk(ks->mu);
@@ -661,7 +688,7 @@ class Server {
       } else {
         v = ks->version;
         if (async_) {
-          snap = std::make_shared<const std::vector<float>>(ks->accum);
+          snap = std::make_shared<const FloatBuf>(ks->accum);
           hint = ks->hint;
         } else {
           snap = ks->result;

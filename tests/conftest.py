@@ -25,6 +25,26 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="also run @pytest.mark.slow tests (subprocess integration, "
+        "large parity matrices). Default `pytest tests/` is the smoke "
+        "tier; CI runs both: `pytest tests/` then `pytest tests/ "
+        "--runslow`.",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow") or os.environ.get("BYTEPS_TEST_FULL"):
+        return
+    skip = pytest.mark.skip(
+        reason="slow tier: pass --runslow (or BYTEPS_TEST_FULL=1)")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture(autouse=True)
 def _fresh_config(monkeypatch):
     """Each test sees a fresh Config parsed from (possibly monkeypatched) env."""

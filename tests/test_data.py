@@ -104,6 +104,7 @@ def test_loader_close_unblocks_producer(mesh_dp):
         next(loader)
 
 
+@pytest.mark.slow
 def test_loader_feeds_training(mesh_dp):
     """End to end: loader batches drive a ViT train step."""
     from byteps_tpu.models import ViTConfig, synthetic_vit_batch

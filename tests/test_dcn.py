@@ -16,6 +16,8 @@ from byteps_tpu.server import (
 )
 from byteps_tpu.server.native import load_lib
 
+pytestmark = pytest.mark.slow  # subprocess/integration tier
+
 BASE_PORT = 19500
 
 

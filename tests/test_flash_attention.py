@@ -188,6 +188,7 @@ def test_supported_shapes():
     assert not supported(64, 64, 512)   # head_dim beyond VMEM budget
 
 
+@pytest.mark.slow
 def test_zigzag_ring_matches_golden_both_backends(sp_mesh, monkeypatch):
     from byteps_tpu.parallel import (
         zigzag_inverse,
@@ -216,6 +217,7 @@ def test_zigzag_ring_matches_golden_both_backends(sp_mesh, monkeypatch):
                 rtol=2e-5, atol=2e-5, err_msg=f"{backend} causal={causal}")
 
 
+@pytest.mark.slow
 def test_zigzag_ring_grads_match_golden(sp_mesh):
     from byteps_tpu.parallel import (
         zigzag_inverse,
@@ -251,6 +253,7 @@ def test_zigzag_ring_grads_match_golden(sp_mesh):
 
 
 @pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.slow
 def test_gqa_kernel_matches_grouped_jnp(causal):
     """Native GQA kernels (narrow k/v via grid-index maps) vs the grouped
     jnp golden — fwd and all grads, dk/dv summed over the group."""

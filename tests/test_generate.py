@@ -41,6 +41,7 @@ def test_prefill_matches_forward(setup):
     assert int(cache.length) == prompt.shape[1]
 
 
+@pytest.mark.slow
 def test_incremental_decode_matches_forward(setup):
     """Appending one token at a time through the cache must equal running
     the full sequence through gpt_forward at every step."""
@@ -60,6 +61,7 @@ def test_incremental_decode_matches_forward(setup):
                                    rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_generate_greedy_matches_naive_loop(setup):
     params, prompt = setup
     gen = make_generate_fn(CFG, max_new=6)
@@ -128,6 +130,7 @@ def _moe_forward(params, tokens, cfg):
     return _readout(params, x)
 
 
+@pytest.mark.slow
 def test_moe_generate_greedy_matches_naive_loop():
     """MoE decode: cached generation equals full-sequence recompute.
     (tiny config's capacity_factor equals n_experts, so training and
@@ -148,6 +151,7 @@ def test_moe_generate_greedy_matches_naive_loop():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
 
 
+@pytest.mark.slow
 def test_moe_generate_under_expert_parallelism():
     """ep-sharded decode (experts split over the mesh, all_to_all
     dispatch) equals the single-device tokens."""
@@ -179,6 +183,7 @@ def test_moe_generate_under_expert_parallelism():
     np.testing.assert_array_equal(np.asarray(sharded), np.asarray(single))
 
 
+@pytest.mark.slow
 def test_moe_generate_under_ep_and_tp():
     """The full sharded decode: experts over ep AND Megatron tp inside
     attention + expert matmuls — tokens equal the single-device run."""

@@ -42,6 +42,7 @@ def test_gqa_forward_runs_and_is_head_grouped():
     assert np.isfinite(np.asarray(logits)).all()
 
 
+@pytest.mark.slow
 def test_gqa_generate_matches_naive_loop():
     params = gpt_init(jax.random.PRNGKey(4), GQA)
     prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 10), 0,
@@ -56,6 +57,7 @@ def test_gqa_generate_matches_naive_loop():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
 
 
+@pytest.mark.slow
 def test_gqa_with_rope_and_sp_ring_matches_dense():
     cfg = dataclasses.replace(GQA, pos_embedding="rope")
     params = gpt_init(jax.random.PRNGKey(7), cfg)
@@ -76,6 +78,7 @@ def test_gqa_with_rope_and_sp_ring_matches_dense():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_gqa_train_step_converges():
     import optax
 

@@ -5,6 +5,9 @@
 import os
 import subprocess
 import sys
+import pytest
+
+pytestmark = pytest.mark.slow  # subprocess/integration tier
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 HELPER = os.path.join(REPO, "tests", "helpers", "hybrid_worker.py")

@@ -235,6 +235,7 @@ def test_distributed_optimizer_matches_single_worker_sgd(mesh8):
     )
 
 
+@pytest.mark.slow
 def test_eager_push_pull_applies_error_feedback():
     """Regression: eager path must thread EF residuals (was silently ignored).
     Repeatedly pushing the same grads with onebit+EF, the ACCUMULATED pulled

@@ -10,6 +10,9 @@ import os
 import socket
 import subprocess
 import sys
+import pytest
+
+pytestmark = pytest.mark.slow  # subprocess/integration tier
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 HELPER = os.path.join(REPO, "tests", "helpers", "jd_worker.py")

@@ -59,6 +59,7 @@ def test_bert_sharded_forward_matches_single_device(mesh_dst):
                                rtol=5e-4, atol=5e-4)
 
 
+@pytest.mark.slow
 def test_bert_train_step_matches_single_device(mesh_dst):
     tokens, targets, mask = synthetic_mlm_batch(
         jax.random.PRNGKey(2), BCFG, 4, 32
@@ -109,6 +110,7 @@ def test_bert_mlm_loss_ignores_unmasked_positions():
     assert float(l1) == pytest.approx(float(l2))
 
 
+@pytest.mark.slow
 def test_resnet_train_step_matches_single_device(mesh_dp):
     rng = jax.random.PRNGKey(4)
     images = jax.random.normal(rng, (16, 16, 16, 3), jnp.float32)
@@ -147,6 +149,7 @@ def test_resnet_train_step_matches_single_device(mesh_dp):
                                    rtol=1e-3, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_resnet_loss_decreases(mesh_dp):
     images = jax.random.normal(jax.random.PRNGKey(6), (16, 16, 16, 3))
     labels = jax.random.randint(jax.random.PRNGKey(7), (16,), 0,
@@ -165,6 +168,7 @@ def test_resnet_loss_decreases(mesh_dp):
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_bert_compressed_dp_training(mesh_dp):
     tokens, targets, mask = synthetic_mlm_batch(
         jax.random.PRNGKey(8), BCFG, 8, 16

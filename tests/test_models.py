@@ -41,6 +41,7 @@ def test_sharded_forward_matches_single_device(mesh_dst):
                                rtol=5e-4, atol=5e-4)
 
 
+@pytest.mark.slow
 def test_train_step_matches_single_device(mesh_dst):
     """Full dp×tp×sp train step == unsharded adamw step, several steps."""
     tokens, targets = synthetic_batch(jax.random.PRNGKey(2), CFG, 4, 32)
@@ -78,6 +79,7 @@ def test_train_step_matches_single_device(mesh_dst):
                                    rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_train_step_loss_decreases(mesh_dst):
     tokens, targets = synthetic_batch(jax.random.PRNGKey(3), CFG, 8, 32)
     step, params, opt_state, bsh = make_gpt_train_step(
@@ -92,6 +94,7 @@ def test_train_step_loss_decreases(mesh_dst):
     assert losses[-1] < losses[0] * 0.8, losses
 
 
+@pytest.mark.slow
 def test_dp_only_mesh_with_compression():
     """The fused DistributedOptimizer path with onebit+EF inside the full
     model train step (BASELINE config 3's shape, tiny)."""
@@ -111,6 +114,7 @@ def test_dp_only_mesh_with_compression():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_remat_is_a_numerics_noop():
     """remat=True recomputes activations in backward instead of storing
     them — the loss trajectory must be identical to remat=False."""
@@ -135,6 +139,7 @@ def test_remat_is_a_numerics_noop():
     np.testing.assert_allclose(losses[False], losses[True], rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_zigzag_train_step_matches_dense_loss():
     """dp×sp zigzag training: with tokens/targets permuted into the
     layout, per-step losses equal the dp-only (full-sequence) step."""
@@ -164,6 +169,7 @@ def test_zigzag_train_step_matches_dense_loss():
     np.testing.assert_allclose(zz_losses, base_losses, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_eval_step_and_perplexity():
     from byteps_tpu.models.train import evaluate_perplexity, make_eval_step
 

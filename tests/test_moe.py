@@ -132,6 +132,7 @@ def _assert_moe_steps_match(cfg, shape_a, names_a, shape_b, names_b,
     assert np.isfinite(float(la))
 
 
+@pytest.mark.slow
 def test_moe_gpt_ep_matches_dense_training():
     """(dp=2, ep=2) expert-parallel MoE GPT tracks (dp=4) dense-expert
     training step-for-step: same init, same batch shards, same routing —
@@ -205,6 +206,7 @@ def test_moe_ffn_top2_ep_matches_dense(moe_params):
     np.testing.assert_allclose(float(aux), float(aux_d), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_moe_gpt_trains_with_top2():
     import dataclasses
 
@@ -243,6 +245,7 @@ def test_top1_combine_uses_raw_softmax_prob():
     assert float(jnp.abs(g).max()) > 1e-3
 
 
+@pytest.mark.slow
 def test_moe_gpt_ep_tp_matches_dense_training():
     """(dp=2, ep=2, tp=2) — Megatron-sharded experts + tp attention —
     tracks the (dp=2, ep=2) step step-for-step (which is itself pinned to
@@ -255,6 +258,7 @@ def test_moe_gpt_ep_tp_matches_dense_training():
                             (2, 2), ("dp", "ep"), seed=12)
 
 
+@pytest.mark.slow
 def test_moe_gpt_ep_sp_matches_ep_only_training():
     """(dp=2, ep=2, sp=2) — ring attention + per-sequence-shard routing —
     tracks the pinned (dp=2, ep=2) step APPROXIMATELY: the nll path
@@ -271,6 +275,7 @@ def test_moe_gpt_ep_sp_matches_ep_only_training():
                             (2, 2), ("dp", "ep"), seed=13, tol=2e-3)
 
 
+@pytest.mark.slow
 def test_moe_gpt_pp_ep_trains_and_tracks_ep_only():
     """(pp=2, dp=2, ep=2) — the full pipelined-MoE composition — tracks
     the pinned (dp=2, ep=2) step approximately (routing happens per
@@ -309,6 +314,7 @@ def test_moe_gpt_pp_ep_trains_and_tracks_ep_only():
     assert np.isfinite(float(l_p))
 
 
+@pytest.mark.slow
 def test_moe_gpt_pp_sp_aux_not_scaled_by_sp():
     """Regression (review catch): with sp sharding, the pipelined-MoE loss
     must pmean the WHOLE per-device scalar over sp — pmeaning only the
@@ -344,6 +350,7 @@ def test_moe_gpt_pp_sp_aux_not_scaled_by_sp():
                                rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_moe_zigzag_matches_contiguous():
     """dp×ep×sp MoE with the zigzag layout equals the contiguous step."""
     import optax
@@ -383,6 +390,7 @@ def test_moe_zigzag_matches_contiguous():
     np.testing.assert_allclose(zz, base, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_moe_pp_zigzag_runs_and_converges():
     """The full composition with zigzag on a pp×ep×sp mesh — microbatch
     reshape, ep all_to_all expert routing, stage aux, zigzag positions

@@ -114,6 +114,7 @@ def test_pipeline_apply_differentiable():
     )
 
 
+@pytest.mark.slow
 def test_gpt_pp_matches_dp_only_training():
     """(pp=2, dp=2) pipeline training tracks dp=4 training step-for-step:
     same init, same global batch, same optimizer — the schedule must not
@@ -150,6 +151,7 @@ def test_gpt_pp_matches_dp_only_training():
     assert float(l_pp) < 6.0 and np.isfinite(float(l_pp))
 
 
+@pytest.mark.slow
 def test_gpt_pp_tp_matches_dp_only_training():
     """(pp=2, dp=2, tp=2) — Megatron tp inside pipeline stages — still
     tracks dp-only training step-for-step: tp is a layout choice, VMA
@@ -186,6 +188,7 @@ def test_gpt_pp_tp_matches_dp_only_training():
     assert np.isfinite(float(l_pp))
 
 
+@pytest.mark.slow
 def test_gpt_pp_sp_matches_dp_only_training():
     """(pp=2, dp=2, sp=2) — ring attention inside pipeline stages — still
     tracks dp-only training step-for-step."""
@@ -234,6 +237,7 @@ def test_gpt_pp_rejects_bad_configs():
         make_gpt_pp_train_step(cfg3, _mesh((2,), ("pp",)), optax.sgd(0.1))
 
 
+@pytest.mark.slow
 def test_pp_remat_is_a_numerics_noop():
     import optax
 
@@ -261,6 +265,7 @@ def test_pp_remat_is_a_numerics_noop():
     np.testing.assert_allclose(losses[False], losses[True], rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_pp_zigzag_matches_pp_contiguous():
     """pp×dp×sp with the zigzag layout: losses equal the contiguous-layout
     pipeline step given zigzag-permuted inputs."""

@@ -83,6 +83,7 @@ def test_rope_zigzag_matches_dense(setup):
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_rope_generate_matches_naive_loop(setup):
     params, _ = setup
     prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 10), 0,
@@ -122,6 +123,7 @@ def test_unknown_pos_embedding_raises(setup):
         gpt_forward(params, tokens, bad)
 
 
+@pytest.mark.slow
 def test_moe_rope_train_decode_agree():
     """MoE + RoPE: the training forward and the cached decode must use
     the same rotations (regression: the MoE block once skipped them)."""

@@ -61,6 +61,7 @@ def test_swiglu_tp_matches_single_device():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_swiglu_train_step_converges():
     import optax
 
@@ -85,6 +86,7 @@ def test_unknown_mlp_raises():
         gpt_init(jax.random.PRNGKey(0), bad)
 
 
+@pytest.mark.slow
 def test_swiglu_pipeline_factory():
     """pp factory spec tree must match the swiglu param tree (w3 slab)."""
     import optax

@@ -49,6 +49,7 @@ def test_forward_shape_and_causality():
     assert float(jnp.max(jnp.abs(logits3 - logits))) > 1e-3
 
 
+@pytest.mark.slow
 def test_dp_step_matches_single_device(mesh_dp):
     step, params, opt_state, bsh = make_t5_train_step(
         CFG, mesh_dp, optax.adamw(1e-3))
@@ -76,6 +77,7 @@ def test_dp_step_matches_single_device(mesh_dp):
                                    rtol=1e-3, atol=3e-6)
 
 
+@pytest.mark.slow
 def test_dp_tp_matches_dp_only(mesh_dp, mesh_dt):
     """(dp=2, tp=4) training == (dp=8) training step-for-step."""
     batch = synthetic_seq2seq_batch(jax.random.PRNGKey(3), CFG, 16, 16, 12)
@@ -95,6 +97,7 @@ def test_dp_tp_matches_dp_only(mesh_dp, mesh_dt):
                                    rtol=3e-4, atol=3e-6)
 
 
+@pytest.mark.slow
 def test_loss_decreases_with_compression(mesh_dp):
     """fp16-wire compressed dp aggregation trains the seq2seq family."""
     step, params, opt_state, bsh = make_t5_train_step(
@@ -114,6 +117,7 @@ def test_loss_decreases_with_compression(mesh_dp):
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_cached_decode_matches_full_decode():
     """Prefill (T>1) and stepwise (T=1) cached decode == t5_decode."""
     from byteps_tpu.models import (
@@ -144,6 +148,7 @@ def test_cached_decode_matches_full_decode():
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_greedy_generation_matches_recompute():
     """make_t5_generate_fn greedy == argmax over full-forward recompute."""
     from byteps_tpu.models import make_t5_generate_fn, t5_encode
@@ -177,6 +182,7 @@ def test_generation_bound_guard():
         make_t5_generate_fn(CFG, CFG.max_tgt)  # 1 + max_new > max_tgt
 
 
+@pytest.mark.slow
 def test_generation_top_k_restricts_support():
     """top_k=1 sampling at temperature 1 must equal greedy decoding."""
     from byteps_tpu.models import make_t5_generate_fn

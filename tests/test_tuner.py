@@ -90,6 +90,7 @@ def test_tuner_explores_downward_from_grid_edge():
     assert tuner.best[0] == PARTITION_GRID[0], tuner.best
 
 
+@pytest.mark.slow
 def test_fused_path_retraces_with_tuned_partition(monkeypatch):
     """VERDICT r2 #4 'Done =': under BYTEPS_AUTO_TUNE=1 the train-step
     factory returns an AutoTunedStep whose tuner moves trigger a retrace at

@@ -44,6 +44,7 @@ def test_patchify_layout():
     np.testing.assert_array_equal(np.asarray(p[0, 1]), expect)
 
 
+@pytest.mark.slow
 def test_forward_shape_and_dtype():
     params = vit_init(jax.random.PRNGKey(0), CFG)
     imgs, labels = synthetic_vit_batch(jax.random.PRNGKey(1), CFG, 4)
@@ -54,6 +55,7 @@ def test_forward_shape_and_dtype():
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 def test_dp_step_matches_single_device(mesh_dp):
     step, params, opt_state, bsh = make_vit_train_step(
         CFG, mesh_dp, optax.adamw(1e-3))
@@ -81,6 +83,7 @@ def test_dp_step_matches_single_device(mesh_dp):
                                    rtol=3e-4, atol=3e-6)
 
 
+@pytest.mark.slow
 def test_dp_tp_matches_dp_only(mesh_dp, mesh_dt):
     """(dp=2, tp=4) training == (dp=8) training step-for-step."""
     imgs, labels = synthetic_vit_batch(jax.random.PRNGKey(3), CFG, 16)
@@ -101,6 +104,7 @@ def test_dp_tp_matches_dp_only(mesh_dp, mesh_dt):
                                    rtol=3e-4, atol=3e-6)
 
 
+@pytest.mark.slow
 def test_loss_decreases_with_compression_and_accum(mesh_dp):
     """onebit+EF compressed aggregation and accum_steps both train."""
     step, params, opt_state, bsh = make_vit_train_step(

@@ -37,6 +37,7 @@ def _run(made, tokens, targets, steps=6):
     return losses, opt_state
 
 
+@pytest.mark.slow
 def test_zero1_matches_replicated_adamw():
     """Elementwise inner transform ⇒ segment update ≡ replicated update."""
     tokens, targets = synthetic_batch(jax.random.PRNGKey(0), CFG, 8, 32)
@@ -66,6 +67,7 @@ def test_zero1_composes_with_compression():
     assert float(jnp.abs(opt_state.ef).max()) > 0.0
 
 
+@pytest.mark.slow
 def test_zero1_on_pipeline_mesh_matches_baseline():
     tokens, targets = synthetic_batch(jax.random.PRNGKey(2), CFG, 8, 32)
     mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("pp", "dp"))
@@ -80,6 +82,7 @@ def test_zero1_on_pipeline_mesh_matches_baseline():
     assert mu.sharding.spec == P("pp", "dp")
 
 
+@pytest.mark.slow
 def test_zero1_topk_identity_matches_uncompressed_zero():
     """Compressed ZeRO with the identity compressor equals plain ZeRO."""
     tokens, targets = synthetic_batch(jax.random.PRNGKey(3), CFG, 8, 32)
@@ -94,6 +97,7 @@ def test_zero1_topk_identity_matches_uncompressed_zero():
     np.testing.assert_allclose(comp, base, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_accum_steps_matches_full_batch():
     """accum_steps=2 over a batch ≡ the full-batch step (mean-of-means
     with equal microbatches; adam sees identical grads)."""
@@ -119,6 +123,7 @@ def test_accum_steps_with_zero_and_compression():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_bert_zero1_matches_replicated():
     from byteps_tpu.models import BertConfig
     from byteps_tpu.models.train import (
@@ -148,6 +153,7 @@ def test_bert_zero1_matches_replicated():
     np.testing.assert_allclose(zero, base, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_accum_steps_on_tp_mesh_matches_full_batch():
     """accum composes with the VMA (tp) path — carry widening + the
     post-scan resym/collapse keep grads and loss exact."""
@@ -160,6 +166,7 @@ def test_accum_steps_on_tp_mesh_matches_full_batch():
     np.testing.assert_allclose(acc, base, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_bert_accum_weighted_matches_full_batch():
     """Masked-mean loss: microbatch mask counts differ, so the
     accumulation must weight by count to reproduce the full-batch step."""
@@ -195,6 +202,7 @@ def test_zero1_without_dp_axis_raises():
         make_gpt_pp_train_step(CFG, mesh, optax.adam(1e-2), zero_1=True)
 
 
+@pytest.mark.slow
 def test_resnet_zero1_matches_replicated():
     from byteps_tpu.models import ResNetConfig
     from byteps_tpu.models.train import make_resnet_train_step
@@ -221,6 +229,7 @@ def test_resnet_zero1_matches_replicated():
     np.testing.assert_allclose(zero, base, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_bert_accum_on_sp_mesh_matches_full_batch():
     """sp-sharded masks: accumulation weights must be the sp-global count."""
     from byteps_tpu.models import BertConfig
