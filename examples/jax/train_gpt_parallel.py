@@ -41,29 +41,43 @@ def main():
     ap.add_argument("--batch-size", type=int, default=8)
     ap.add_argument("--seq", type=int, default=32)
     ap.add_argument("--n-micro", type=int, default=2)
+    ap.add_argument("--compressor", choices=["none", "onebit", "topk"],
+                    default="none",
+                    help="compressed dp aggregation — composes with every "
+                    "mesh axis (tp/sp/pp/ep) since round 4")
     args = ap.parse_args()
 
+    comp = (None if args.compressor == "none"
+            else {"compressor": args.compressor, "ef": "vanilla"})
     n = len(jax.devices())
     tx = optax.adamw(1e-3)
     if args.mode == "dense":
         cfg = GPTConfig.tiny()
         mesh = make_mesh(factor_devices(n))
-        step, params, opt_state, bsh = make_gpt_train_step(cfg, mesh, tx)
+        step, params, opt_state, bsh = make_gpt_train_step(
+            cfg, mesh, tx, compression_params=comp)
     elif args.mode == "pp":
         cfg = GPTConfig.tiny()
         pp = 2
         mesh = make_mesh(MeshAxes(pp=pp, dp=n // pp))
         step, params, opt_state, bsh = make_gpt_pp_train_step(
-            cfg, mesh, tx, n_micro=args.n_micro
+            cfg, mesh, tx, n_micro=args.n_micro, compression_params=comp
         )
     else:
         cfg = MoEGPTConfig.tiny()
         ep = 2
         mesh = make_mesh(MeshAxes(dp=n // ep, ep=ep))
         step, params, opt_state, bsh = make_gpt_moe_train_step(
-            cfg, mesh, tx
+            cfg, mesh, tx, compression_params=comp
         )
-    print(f"mode={args.mode} mesh={dict(mesh.shape)}", flush=True)
+    if comp is not None and "dp" not in mesh.axis_names:
+        raise SystemExit(
+            f"--compressor {args.compressor} needs a dp axis to compress "
+            f"over, but this mesh is {dict(mesh.shape)} — compression "
+            "rides the dp gradient aggregation (use more devices or a "
+            "mode whose factorization keeps dp > 1)")
+    print(f"mode={args.mode} mesh={dict(mesh.shape)} "
+          f"compressor={args.compressor}", flush=True)
 
     def host_batches():
         for i in range(args.steps):
