@@ -16,8 +16,11 @@ same shared parts as the rest of the zoo:
 * embeddings/readout are tied (``wte``), learned absolute positions per
   side, mirroring the GPT family's conventions.
 
-Sequence parallelism is not plumbed (seq2seq batches here are
-short-sequence; the sp ring story lives in the GPT family).
+Sequence parallelism (round 4): both sides shard over sp — the encoder
+runs the non-causal ring, the decoder the causal ring, and
+cross-attention a RECTANGULAR non-causal ring (stationary decoder-query
+blocks, rotating encoder-memory k/v blocks — the ring helpers take the
+k block's own length for offsets). Positions are sp-aware on both sides.
 """
 
 from __future__ import annotations
@@ -40,7 +43,10 @@ from byteps_tpu.models.gpt import (
     transformer_block,
 )
 from byteps_tpu.parallel.remat import maybe_remat
-from byteps_tpu.parallel.ring_attention import plain_attention
+from byteps_tpu.parallel.ring_attention import (
+    plain_attention,
+    ring_attention,
+)
 from byteps_tpu.parallel.tp import col_parallel_matmul, row_parallel_matmul
 
 
@@ -100,8 +106,13 @@ def _cross_specs(tp_axis) -> Dict[str, Any]:
     }
 
 
-def cross_attention(x, mem, p, head_dim: int, tp_axis):
-    """Decoder queries over encoder memory; bidirectional (no mask)."""
+def cross_attention(x, mem, p, head_dim: int, tp_axis, sp_axis=None):
+    """Decoder queries over encoder memory; bidirectional (no mask).
+
+    With ``sp_axis`` both sides are sequence-sharded: ``x`` is this
+    device's target block and ``mem`` its ENCODER-memory block — the
+    ring rotates the memory k/v blocks under the stationary queries
+    (rectangular, non-causal ring)."""
     B, Sq = x.shape[:2]
     Sk = mem.shape[1]
     q = col_parallel_matmul(x, p["xwq"].astype(x.dtype), p["xbq"].astype(x.dtype))
@@ -111,13 +122,13 @@ def cross_attention(x, mem, p, head_dim: int, tp_axis):
     q = q.reshape(B, Sq, h_loc, head_dim)
     k = k.reshape(B, Sk, h_loc, head_dim)
     v = v.reshape(B, Sk, h_loc, head_dim)
-    o = plain_attention(q, k, v, causal=False)
+    o = ring_attention(q, k, v, sp_axis, causal=False)
     o = o.reshape(B, Sq, h_loc * head_dim)
     return row_parallel_matmul(o, p["xwo"].astype(x.dtype), tp_axis,
                                p["xbo"].astype(x.dtype))
 
 
-def decoder_block(x, mem, p, head_dim: int, tp_axis=None):
+def decoder_block(x, mem, p, head_dim: int, tp_axis=None, sp_axis=None):
     """Causal self-attn → cross-attn over ``mem`` → MLP, all pre-LN.
 
     ``p`` is a GPT ``block_init`` dict (self-attn + MLP) merged with
@@ -127,9 +138,9 @@ def decoder_block(x, mem, p, head_dim: int, tp_axis=None):
     # transformer_block is attn-then-mlp; here cross-attn goes between,
     # so apply the pieces explicitly with the same param names
     x = x + _attention(_layernorm(x, p["ln1_g"], p["ln1_b"]), p, head_dim,
-                       tp_axis, None, causal=True)
+                       tp_axis, sp_axis, causal=True)
     x = x + cross_attention(_layernorm(x, p["lnx_g"], p["lnx_b"]), mem, p,
-                            head_dim, tp_axis)
+                            head_dim, tp_axis, sp_axis)
     return x + _mlp(_layernorm(x, p["ln2_g"], p["ln2_b"]), p, tp_axis)
 
 
@@ -181,15 +192,27 @@ def t5_param_specs(cfg: T5Config, tp_axis: Optional[str]) -> Dict[str, Any]:
     }
 
 
+def _sp_positions(S_loc: int, sp_axis: Optional[str]) -> jnp.ndarray:
+    """This device's global positions for its contiguous sequence block."""
+    off = (jax.lax.axis_index(sp_axis) * S_loc if sp_axis is not None
+           else 0)
+    return off + jnp.arange(S_loc)
+
+
 def t5_encode(params, src: jnp.ndarray, cfg: T5Config,
               tp_axis: Optional[str] = None,
+              sp_axis: Optional[str] = None,
               remat: bool = False) -> jnp.ndarray:
-    """(B, S_src) token ids → (B, S_src, d) encoder memory."""
+    """(B, S_src) token ids → (B, S_src, d) encoder memory.
+
+    With ``sp_axis``, ``src`` is this device's contiguous sequence block
+    and self-attention runs the non-causal ring."""
     S = src.shape[1]
-    x = (params["wte"][src] + params["wpe_src"][jnp.arange(S)]).astype(cfg.dtype)
+    pos = _sp_positions(S, sp_axis)
+    x = (params["wte"][src] + params["wpe_src"][pos]).astype(cfg.dtype)
 
     def apply_block(x, p):
-        return transformer_block(x, p, cfg.head_dim, tp_axis, None,
+        return transformer_block(x, p, cfg.head_dim, tp_axis, sp_axis,
                                  causal=False)
 
     apply_block = maybe_remat(apply_block, remat)
@@ -200,14 +223,20 @@ def t5_encode(params, src: jnp.ndarray, cfg: T5Config,
 
 def t5_decode(params, mem: jnp.ndarray, tgt_in: jnp.ndarray, cfg: T5Config,
               tp_axis: Optional[str] = None,
+              sp_axis: Optional[str] = None,
               remat: bool = False) -> jnp.ndarray:
-    """Teacher-forced decode: (B, S_tgt) shifted ids → f32 logits."""
+    """Teacher-forced decode: (B, S_tgt) shifted ids → f32 logits.
+
+    With ``sp_axis``, the target side is sequence-sharded too: causal
+    ring self-attention + rectangular cross-attention ring over the
+    sp-sharded encoder memory."""
     S = tgt_in.shape[1]
+    pos = _sp_positions(S, sp_axis)
     x = (params["wte"][tgt_in]
-         + params["wpe_tgt"][jnp.arange(S)]).astype(cfg.dtype)
+         + params["wpe_tgt"][pos]).astype(cfg.dtype)
 
     def apply_block(x, p):
-        return decoder_block(x, mem, p, cfg.head_dim, tp_axis)
+        return decoder_block(x, mem, p, cfg.head_dim, tp_axis, sp_axis)
 
     apply_block = maybe_remat(apply_block, remat)
     for p in params["dec_blocks"]:
@@ -217,21 +246,31 @@ def t5_decode(params, mem: jnp.ndarray, tgt_in: jnp.ndarray, cfg: T5Config,
 
 def t5_forward(params, src: jnp.ndarray, tgt_in: jnp.ndarray, cfg: T5Config,
                tp_axis: Optional[str] = None,
+               sp_axis: Optional[str] = None,
                remat: bool = False) -> jnp.ndarray:
-    mem = t5_encode(params, src, cfg, tp_axis=tp_axis, remat=remat)
-    return t5_decode(params, mem, tgt_in, cfg, tp_axis=tp_axis, remat=remat)
+    mem = t5_encode(params, src, cfg, tp_axis=tp_axis, sp_axis=sp_axis,
+                    remat=remat)
+    return t5_decode(params, mem, tgt_in, cfg, tp_axis=tp_axis,
+                     sp_axis=sp_axis, remat=remat)
 
 
 def t5_loss(params, src, tgt_in, tgt_out, cfg: T5Config,
             dp_axis: Optional[str] = None,
             tp_axis: Optional[str] = None,
+            sp_axis: Optional[str] = None,
             remat: bool = False) -> jnp.ndarray:
-    """Mean next-token CE over the target side (teacher forcing)."""
+    """Mean next-token CE over the target side (teacher forcing).
+
+    Replication contract mirrors gpt_loss: identical across tp; pmean
+    over sp (each device's local target-chunk mean is one summand of the
+    global mean — equal chunks, so mean-of-means is exact); dp-local
+    unless ``dp_axis`` is given."""
     logits = t5_forward(params, src, tgt_in, cfg, tp_axis=tp_axis,
-                        remat=remat)
+                        sp_axis=sp_axis, remat=remat)
     loss = _nll(logits, tgt_out).mean()
-    if dp_axis is not None:
-        loss = jax.lax.pmean(loss, dp_axis)
+    axes = tuple(a for a in (dp_axis, sp_axis) if a is not None)
+    if axes:
+        loss = jax.lax.pmean(loss, axes)
     return loss
 
 

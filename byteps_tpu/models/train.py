@@ -887,10 +887,14 @@ def make_t5_train_step(
     accum_steps: int = 1,
 ):
     """``step(params, opt_state, src, tgt_in, tgt_out) -> (loss, params,
-    opt_state)`` — encoder-decoder seq2seq over a (dp, tp) mesh; blocks
-    and tp sharding shared with GPT/BERT, cross-attention added by the
-    decoder blocks (models/t5.py)."""
+    opt_state)`` — encoder-decoder seq2seq over a (dp, tp, sp) mesh;
+    blocks and tp sharding shared with GPT/BERT, cross-attention added by
+    the decoder blocks (models/t5.py). With an sp axis BOTH sides
+    sequence-shard: non-causal encoder ring, causal decoder ring, and a
+    rectangular cross-attention ring over the sp-sharded encoder memory
+    (src and tgt lengths must each divide by the sp size)."""
     dp, tp = _axis(mesh, "dp"), _axis(mesh, "tp")
+    sp = _axis(mesh, "sp")
     use_vma = compression_params is None and not zero_1
     pspecs = t5_param_specs(cfg, tp)
     params = t5_init(jax.random.PRNGKey(0), cfg)
@@ -902,10 +906,10 @@ def make_t5_train_step(
                  **tx_kw),
         params, pspecs, dp, state_axes=state_axes, zero_numel=zero_numel,
     )
-    batch_spec = P(dp)
+    batch_spec = P(dp, sp)
     resym = _make_resymmetrize(pspecs, dp)
     loss_fn = functools.partial(
-        t5_loss, cfg=cfg, dp_axis=None, tp_axis=tp, remat=remat,
+        t5_loss, cfg=cfg, dp_axis=None, tp_axis=tp, sp_axis=sp, remat=remat,
     )
 
     def build_jit(pb):
@@ -918,7 +922,7 @@ def make_t5_train_step(
             if use_vma:
                 grads = resym(grads)
             else:
-                grads = _novma_collective_fix(grads, pspecs, mesh, (tp,))
+                grads = _novma_collective_fix(grads, pspecs, mesh, (tp, sp))
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             if dp is not None:

@@ -111,7 +111,10 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     # lax.scan would re-materialize the ring state each step for no gain.
     for step in range(n):
         src = (idx - step) % n                # owner of the block we hold
-        k_pos = src * S_loc + jnp.arange(S_loc)
+        # the k block's OWN length, not q's: cross-attention rings rotate
+        # encoder-memory blocks under decoder queries (Sk_loc != S_loc)
+        Sk_loc = k_blk.shape[1]
+        k_pos = src * Sk_loc + jnp.arange(Sk_loc)
         k_use = k_blk if rep == 1 else jnp.repeat(k_blk, rep, axis=2)
         v_use = v_blk if rep == 1 else jnp.repeat(v_blk, rep, axis=2)
         m, l, o = _block_attn(qf, k_use, v_use, q_pos, k_pos, scale,
@@ -244,8 +247,9 @@ def _ring_flash(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     k_blk, v_blk = k, v
     for step in range(n):
         src = (idx - step) % n                # owner of the block we hold
+        # offset by the k block's own length (rectangular cross-attn rings)
         o_s, lse_s = _flash_attention_lse(
-            q, k_blk, v_blk, q_off, src * S_loc, causal=causal)
+            q, k_blk, v_blk, q_off, src * k_blk.shape[1], causal=causal)
         o, lse = _merge_attention(o, lse, o_s, lse_s)
         if step + 1 < n:
             k_blk = jax.lax.ppermute(k_blk, sp_axis, perm)

@@ -54,28 +54,29 @@ def main():
     if args.mode == "dense":
         cfg = GPTConfig.tiny()
         mesh = make_mesh(factor_devices(n))
-        step, params, opt_state, bsh = make_gpt_train_step(
+        make = lambda: make_gpt_train_step(  # noqa: E731
             cfg, mesh, tx, compression_params=comp)
     elif args.mode == "pp":
         cfg = GPTConfig.tiny()
         pp = 2
         mesh = make_mesh(MeshAxes(pp=pp, dp=n // pp))
-        step, params, opt_state, bsh = make_gpt_pp_train_step(
-            cfg, mesh, tx, n_micro=args.n_micro, compression_params=comp
-        )
+        make = lambda: make_gpt_pp_train_step(  # noqa: E731
+            cfg, mesh, tx, n_micro=args.n_micro, compression_params=comp)
     else:
         cfg = MoEGPTConfig.tiny()
         ep = 2
         mesh = make_mesh(MeshAxes(dp=n // ep, ep=ep))
-        step, params, opt_state, bsh = make_gpt_moe_train_step(
-            cfg, mesh, tx, compression_params=comp
-        )
+        make = lambda: make_gpt_moe_train_step(  # noqa: E731
+            cfg, mesh, tx, compression_params=comp)
+    # guard BEFORE the factory: on a dp-less mesh _make_tx would silently
+    # drop compression after all the expensive setup
     if comp is not None and "dp" not in mesh.axis_names:
         raise SystemExit(
             f"--compressor {args.compressor} needs a dp axis to compress "
             f"over, but this mesh is {dict(mesh.shape)} — compression "
             "rides the dp gradient aggregation (use more devices or a "
             "mode whose factorization keeps dp > 1)")
+    step, params, opt_state, bsh = make()
     print(f"mode={args.mode} mesh={dict(mesh.shape)} "
           f"compressor={args.compressor}", flush=True)
 
