@@ -37,6 +37,7 @@ from byteps_tpu.models.gpt import (
     _layernorm,
     _mlp,
     _nll,
+    _positions as _gpt_positions,
     _readout,
     block_init,
     block_specs,
@@ -193,10 +194,9 @@ def t5_param_specs(cfg: T5Config, tp_axis: Optional[str]) -> Dict[str, Any]:
 
 
 def _sp_positions(S_loc: int, sp_axis: Optional[str]) -> jnp.ndarray:
-    """This device's global positions for its contiguous sequence block."""
-    off = (jax.lax.axis_index(sp_axis) * S_loc if sp_axis is not None
-           else 0)
-    return off + jnp.arange(S_loc)
+    """This device's global positions for its contiguous sequence block
+    (the GPT helper, fixed to the contiguous layout — T5 has no zigzag)."""
+    return _gpt_positions(S_loc, sp_axis, "contiguous")
 
 
 def t5_encode(params, src: jnp.ndarray, cfg: T5Config,

@@ -205,21 +205,51 @@ def zigzag_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     for step in range(n):
         src = (idx - step) % n
         k_offs = (src * c, (2 * n - 1 - src) * c)
-        for ki in range(2):
-            kh = k_blk[:, ki * c:(ki + 1) * c]
-            vh = v_blk[:, ki * c:(ki + 1) * c]
-            for qi in range(2):
-                if causal and qi == 0 and ki == 1:
-                    # early q chunk (id < n) vs late k chunk (id >= n):
-                    # fully masked at EVERY step — skip the dead quarter
-                    continue
-                # late q (id >= n) vs early k (id < n) is fully live at
-                # every step — run it unmasked (no per-tile mask math)
-                combo_causal = causal and not (qi == 1 and ki == 0)
+        kh = (k_blk[:, :c], k_blk[:, c:])
+        vh = (v_blk[:, :c], v_blk[:, c:])
+        if not causal:
+            # bidirectional: all four half-combos are live
+            for ki in range(2):
+                for qi in range(2):
+                    o_s, lse_s = _attention_lse(
+                        q_halves[qi], kh[ki], vh[ki], my_offs[qi],
+                        k_offs[ki], causal=False)
+                    state[qi] = _merge_attention(*state[qi], o_s, lse_s)
+        elif step == 0:
+            # diagonal step (src == idx): e_q×e_k and l_q×l_k carry their
+            # own causal masks; l_q×e_k is fully live
+            for qi, ki, cc in ((0, 0, True), (1, 0, False), (1, 1, True)):
                 o_s, lse_s = _attention_lse(
-                    q_halves[qi], kh, vh, my_offs[qi], k_offs[ki],
-                    causal=combo_causal)
+                    q_halves[qi], kh[ki], vh[ki], my_offs[qi], k_offs[ki],
+                    causal=cc)
                 state[qi] = _merge_attention(*state[qi], o_s, lse_s)
+        else:
+            # off-diagonal: exactly TWO live half-combos, both UNMASKED.
+            # l_q×e_k (late queries over early keys) is live at every
+            # step; of e_q×e_k / l_q×l_k exactly one is live — e_q×e_k
+            # when idx > src (early q block comes after the early k
+            # block), l_q×l_k when idx < src (the LATE ordering flips) —
+            # and the other is fully masked. Select the live combo's
+            # operands branchlessly (scalar where; the matmul runs once)
+            # and route its partial to the right half's accumulator by
+            # giving the other half a neutral lse (−1e30 merges to a
+            # no-op). This executes 2 block-matmuls per step instead of
+            # the naive 4 (or the previous 3): the measured FLOP edge
+            # over the contiguous ring grows from ~1.3× to ~1.8× at
+            # sp=8, asymptotically 2×.
+            o_s, lse_s = _attention_lse(
+                q_halves[1], kh[0], vh[0], my_offs[1], k_offs[0],
+                causal=False)
+            state[1] = _merge_attention(*state[1], o_s, lse_s)
+            sel = idx > src
+            qB = jnp.where(sel, q_halves[0], q_halves[1])
+            kB = jnp.where(sel, kh[0], kh[1])
+            vB = jnp.where(sel, vh[0], vh[1])
+            oB, lseB = _attention_lse(qB, kB, vB, 0, 0, causal=False)
+            state[0] = _merge_attention(
+                *state[0], oB, jnp.where(sel, lseB, _NEG))
+            state[1] = _merge_attention(
+                *state[1], oB, jnp.where(sel, _NEG, lseB))
         if step + 1 < n:
             k_blk = jax.lax.ppermute(k_blk, sp_axis, perm)
             v_blk = jax.lax.ppermute(v_blk, sp_axis, perm)
