@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Run every BASELINE-named bench config on the current device and collect
+# the JSON lines. On a healthy single TPU chip this produces the four
+# single-chip workloads (flagship GPT, ResNet-50, BERT+onebit,
+# GPT-2-medium+topk) plus the DCN tier and its component profile; each
+# line carries MFU/calibration/linearity accountability fields
+# (absolute_trusted=false + warnings when the numbers are physically
+# impossible — see docs/performance.md).
+#
+# Usage: scripts/bench_all.sh [outfile]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-bench_all.jsonl}"
+: > "$OUT"
+
+run() {
+  echo "== bench $* ==" >&2
+  timeout 1800 python bench.py "$@" 2>&2 | tail -1 >> "$OUT"
+}
+
+run                                      # flagship GPT (or all-reduce if >1 dev)
+run --model resnet50                     # BASELINE config 2
+run --model bert --compressor onebit     # BASELINE config 3
+run --model gpt2m --compressor topk      # BASELINE config 4
+run --mode dcn                           # DCN summation tier
+run --mode dcn-profile                   # host component ceilings
+
+echo "collected $(wc -l < "$OUT") results in $OUT" >&2
+cat "$OUT"
