@@ -22,6 +22,9 @@ run                                      # flagship GPT (or all-reduce if >1 dev
 run --model resnet50                     # BASELINE config 2
 run --model bert --compressor onebit     # BASELINE config 3
 run --model gpt2m --compressor topk      # BASELINE config 4
+run --model gpt2m                        # MFU-honest large config (uncompressed)
+run --model vit                          # beyond-reference families
+run --model t5
 run --mode dcn                           # DCN summation tier
 run --mode dcn-profile                   # host component ceilings
 
