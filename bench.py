@@ -650,6 +650,96 @@ def bench_model_singlechip(model: str, compressor: str) -> dict:
     }
 
 
+def bench_generate() -> dict:
+    """Cached-decode throughput (the KV-cache generation subsystem) vs
+    the naive full-recompute sampler a user would write without it. Both
+    sides are one jitted program fed identical prompts; the cached side
+    is prefill + lax.scan over single-token cached steps, the recompute
+    side re-runs the full forward at static padded length every step and
+    argmax-picks in the same way. vs_baseline here is the SPEEDUP
+    (t_recompute / t_cached, > 1 = cached wins) — generation is
+    beyond-reference, so there is no parity target, only the structural
+    win to quantify."""
+    on_cpu = jax.devices()[0].platform == "cpu"
+    kind, peak = _detect_peak()
+    cal_tflops, _, linearity, _ = _calibrate(peak, on_cpu)
+
+    from byteps_tpu.models import GPTConfig, gpt_forward, gpt_init
+    from byteps_tpu.models.generate import make_generate_fn
+
+    cfg = (
+        GPTConfig.tiny() if on_cpu else
+        GPTConfig(vocab_size=32768, max_seq=512, d_model=512, n_heads=8,
+                  n_layers=8, d_ff=2048, dtype=jnp.bfloat16)
+    )
+    B, T0, max_new = (2, 8, 12) if on_cpu else (8, 128, 128)
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (B, T0), 0, cfg.vocab_size)
+    gen = make_generate_fn(cfg, max_new)
+    rng = jax.random.PRNGKey(2)
+
+    fwd = jax.jit(lambda p, toks: gpt_forward(p, toks, cfg))
+
+    def run_recompute():
+        toks = jnp.pad(prompt, ((0, 0), (0, max_new)))
+        for i in range(max_new):
+            logits = fwd(params, toks)               # full padded length
+            nxt = jnp.argmax(logits[:, T0 + i - 1], axis=-1)
+            toks = toks.at[:, T0 + i].set(nxt)
+        return _fence(toks)
+
+    def run_cached(n=1):
+        def f():
+            out = None
+            for i in range(n):
+                out = gen(params, prompt, jax.random.fold_in(rng, i))
+            return _fence(out)
+        return f
+
+    # interleaved A/B: tunnel latency drifts between windows, so timing
+    # the two sides in disjoint blocks would bias the speedup (same
+    # reasoning as bench_model_singlechip's _time_pair use)
+    t_cached, t_recompute = _time_pair(
+        run_cached(), run_recompute, warmup=1, iters=3 if on_cpu else 5)
+    speedup = t_recompute / t_cached
+
+    # slope over chained gen calls cancels the per-call tunnel overhead;
+    # endpoints timed back-to-back so drift between them stays small
+    s_iters = 2 if on_cpu else 5
+    t1 = _time_it(run_cached(), warmup=0, iters=s_iters)
+    t3 = _time_it(run_cached(3), warmup=0, iters=s_iters)
+    t_slope = (t3 - t1) / 2 if t3 > t1 else None
+
+    # forward-only FLOPs: ~2 per matmul param per token; attention fwd
+    # ~4·L·B·S·d per query token against S keys
+    d, L = cfg.d_model, cfg.n_layers
+    n_mm = L * (4 * d * d + 2 * d * cfg.d_ff) + d * cfg.vocab_size
+    attn = 4 * L * B * d * (T0 * T0 + max_new * T0 + max_new * max_new // 2)
+    flops = 2 * n_mm * B * (T0 + max_new) + attn
+    tok_s = B * max_new / t_cached
+    _log(f"generate: cached {t_cached*1e3:.1f}ms "
+         f"({tok_s:.0f} new tok/s), full-recompute "
+         f"{t_recompute*1e3:.1f}ms, speedup {speedup:.2f}x"
+         + (f", slope/call {t_slope*1e3:.1f}ms" if t_slope else ""))
+    return {
+        "metric": f"GPT d{d}/L{L} cached decode, {max_new} new tokens "
+                  f"(B={B}, prompt {T0}) vs full recompute",
+        "value": round(tok_s, 1),
+        "unit": "new tokens/s",
+        "vs_baseline": round(speedup, 3),
+        "call_ms_cached": round(t_cached * 1e3, 3),
+        "call_ms_recompute": round(t_recompute * 1e3, 3),
+        "call_ms_slope": round(t_slope * 1e3, 3) if t_slope else None,
+        "device_kind": kind,
+        "peak_tflops_bf16": peak,
+        "flops_per_call": flops,
+        "calibration_tflops": round(cal_tflops, 2),
+        "linearity": round(linearity, 3),
+        "absolute_trusted": linearity >= 1.5,
+    }
+
+
 def bench_allreduce_multichip() -> dict:
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -914,7 +1004,8 @@ def _devices_or_die(timeout_s: float) -> int:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", choices=["auto", "dcn", "dcn-profile"],
+    ap.add_argument("--mode",
+                    choices=["auto", "dcn", "dcn-profile", "generate"],
                     default="auto")
     ap.add_argument("--model",
                     choices=["gpt", "gpt2m", "bert", "resnet50", "vit",
@@ -935,6 +1026,14 @@ def main() -> None:
         if flags_set:
             _log("bench: WARNING --model/--compressor ignored in dcn mode")
         result = bench_dcn() if args.mode == "dcn" else bench_dcn_profile()
+    elif args.mode == "generate":
+        if flags_set:
+            _log("bench: WARNING --model/--compressor ignored in "
+                 "generate mode")
+        n = _devices_or_die(
+            float(os.environ.get("BYTEPS_BENCH_DEVICE_TIMEOUT", "600")))
+        _log(f"bench: {n} device(s): {jax.devices()[0].device_kind}")
+        result = bench_generate()
     else:
         n = _devices_or_die(
             float(os.environ.get("BYTEPS_BENCH_DEVICE_TIMEOUT", "600")))

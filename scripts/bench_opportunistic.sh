@@ -16,6 +16,7 @@ declare -A CFG=(
   [gpt2m]="--model gpt2m"
   [vit]="--model vit"
   [t5]="--model t5"
+  [generate]="--mode generate"
 )
 # expected pattern of the JSON "metric" field — guards against bench.py
 # silently switching to all-reduce mode if the pool ever grants >1 device
@@ -23,13 +24,13 @@ declare -A WANT=(
   [gpt]="GPT d512"
   [resnet50]="ResNet-50"
   [bert_onebit]="BERT d.*onebit"
-  [gpt2m_topk]="GPT-2-medium.*topk"
+  [gpt2m_topk]='GPT-2-medium\+topk'     # excludes the CPU "(tiny-sub)" name
   [gpt2m]="GPT-2-medium train-step"
   [vit]="ViT-B/16"
   [t5]="T5-base"
+  [generate]="GPT d512/L8 cached decode"
 )
-WANT[gpt2m_topk]='GPT-2-medium\+topk'   # not the CPU "(tiny-sub)" fallback
-ORDER="gpt resnet50 bert_onebit gpt2m_topk gpt2m vit t5"
+ORDER="gpt resnet50 bert_onebit gpt2m_topk gpt2m vit t5 generate"
 
 for round in $(seq 1 ${BENCH_SWEEP_ROUNDS:-100}); do
   missing=0
@@ -50,7 +51,10 @@ for round in $(seq 1 ${BENCH_SWEEP_ROUNDS:-100}); do
       rc=$?
       rm -f "bench_results/$name.tmp"
       echo "[$(date +%H:%M:%S)] FAIL $name rc=$rc" >> bench_results/sweep.log
-      [ $rc -eq 3 ] && sleep 120   # tunnel down: back off before retry
+      # back off on ANY failure: rc=3 is the probe timeout, rc=124 the
+      # wedge-mid-run kill, grep mismatch a wrong-device run — all mean
+      # the tunnel is unhealthy; hammering it helps nobody
+      sleep 120
     fi
   done
   [ $missing -eq 0 ] && { echo "sweep complete" >> bench_results/sweep.log; exit 0; }
