@@ -262,3 +262,76 @@ def test_prefill_flash_backend_matches_forward(setup, monkeypatch):
     logits, _ = gpt_apply_cached(params, prompt16, cache, CFG)
     np.testing.assert_allclose(np.asarray(logits), np.asarray(logits_ref),
                                rtol=2e-5, atol=2e-5)
+
+
+# ---- int8 quantized KV cache ------------------------------------------------
+def test_quantize_block_exact_on_grid():
+    """Values already on their absmax/127 grid round-trip bit-exactly;
+    arbitrary values bound the error by scale/2."""
+    from byteps_tpu.models.generate import _quantize_block
+
+    rng = np.random.default_rng(0)
+    scale = rng.uniform(0.1, 2.0, size=(2, 3, 4)).astype(np.float32)
+    ints = rng.integers(-127, 128, size=(2, 3, 4, 8)).astype(np.float32)
+    # force at least one +/-127 per block so absmax recovers the scale
+    ints[..., 0] = 127.0
+    x = jnp.asarray(ints * scale[..., None])
+    q, s = _quantize_block(x)
+    np.testing.assert_allclose(np.asarray(s), scale, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(q), ints.astype(np.int8))
+    deq = np.asarray(q, np.float32) * np.asarray(s)[..., None]
+    np.testing.assert_allclose(deq, np.asarray(x), rtol=1e-6)
+
+    y = jnp.asarray(rng.normal(size=(2, 3, 4, 8)).astype(np.float32))
+    qy, sy = _quantize_block(y)
+    err = np.abs(np.asarray(qy, np.float32) * np.asarray(sy)[..., None]
+                 - np.asarray(y))
+    assert (err <= np.asarray(sy)[..., None] / 2 + 1e-7).all()
+
+
+def test_quant_cache_prefill_close_and_greedy_matches(setup):
+    """int8 cache: prefill logits stay close to the dense-cache logits
+    and greedy generation reproduces the dense-cache tokens on the tiny
+    model (deterministic seeds)."""
+    params, prompt = setup
+    B = prompt.shape[0]
+    cache_d = init_cache(CFG, B)
+    cache_q = init_cache(CFG, B, quant=True)
+    assert cache_q.k.dtype == jnp.int8 and cache_q.k_scale is not None
+    ld, _ = gpt_apply_cached(params, prompt, cache_d, CFG)
+    lq, cache_q = gpt_apply_cached(params, prompt, cache_q, CFG)
+    assert int(cache_q.length) == prompt.shape[1]
+    assert cache_q.k.dtype == jnp.int8            # stays quantized
+    # int8 absmax keeps per-element error <= scale/2; at tiny-model
+    # logit magnitudes that lands well inside this envelope
+    err = np.abs(np.asarray(lq) - np.asarray(ld))
+    ref = np.abs(np.asarray(ld)).max()
+    assert err.max() <= 0.05 * ref, (err.max(), ref)
+
+    toks_d = make_generate_fn(CFG, max_new=8)(
+        params, prompt, jax.random.PRNGKey(3))
+    toks_q = make_generate_fn(CFG, max_new=8, quant_cache=True)(
+        params, prompt, jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(np.asarray(toks_d), np.asarray(toks_q))
+
+
+def test_quant_cache_under_tensor_parallelism(setup):
+    """quant_cache composes with tp: per-shard caches quantize their own
+    head slices; tokens match the single-device quantized sampler."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    params, prompt = setup
+    from byteps_tpu.models import gpt_param_specs
+
+    mesh = make_mesh(MeshAxes(tp=2), devices=jax.devices()[:2])
+    pspecs = gpt_param_specs(CFG, "tp")
+    gen_tp = make_generate_fn(CFG, max_new=8, tp_axis="tp",
+                              quant_cache=True)
+    toks_tp = jax.jit(jax.shard_map(
+        lambda p, t, r: gen_tp(p, t, r, 0.0),
+        mesh=mesh, in_specs=(pspecs, P(), P()), out_specs=P(),
+        check_vma=False,
+    ))(params, prompt, jax.random.PRNGKey(3))
+    toks_1d = make_generate_fn(CFG, max_new=8, quant_cache=True)(
+        params, prompt, jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(np.asarray(toks_tp), np.asarray(toks_1d))
