@@ -470,7 +470,9 @@ def _model_setup(model: str, compressor: str, on_cpu: bool):
                       n_heads=16, n_layers=24, d_ff=4096,
                       dtype=jnp.bfloat16)
         )
-        b, s = (4, 32) if on_cpu else (4, 1024)
+        # B=2: both A/B sides (params+adam each) must fit the chip
+        # together; at B=4 the pair OOMs the tunnel v5e
+        b, s = (4, 32) if on_cpu else (2, 1024)
         name = "GPT-2-medium" if not on_cpu else "GPT-2-medium(tiny-sub)"
         return name, _build_gpt(cfg, b, s, cp, dev)
     if model == "bert":

@@ -168,10 +168,25 @@ def _attn_cached_half(x, p, cache_k, cache_v, pos0, head_dim, tp_axis,
         k = rope_rotate(k, pos, rope_base)
     cache_k = _cache_write(cache_k, k, pos0)
     cache_v = _cache_write(cache_v, v, pos0)
-    # GQA is native in attention_lse on both backends — prefill and
-    # decode read the narrow cache directly, no repeat anywhere
-    o = _cached_attention(q, _cache_read(cache_k, x.dtype),
-                          _cache_read(cache_v, x.dtype), pos0)
+    # GQA is native on every path — prefill and decode read the narrow
+    # cache directly, no repeat anywhere. The T=1 decode step takes the
+    # flash-decode kernel when available: it streams the cache in its
+    # STORED dtype (int8 included — scales fold algebraically), so the
+    # quantized cache is never materialized dequantized in HBM.
+    from byteps_tpu.ops.flash_decode import (
+        decode_supported, flash_decode, use_pallas)
+
+    S_max = (cache_k.q if isinstance(cache_k, _QuantSlot)
+             else cache_k).shape[1]
+    if T == 1 and use_pallas() and decode_supported(S_max, head_dim):
+        if isinstance(cache_k, _QuantSlot):
+            o = flash_decode(q, cache_k.q, cache_v.q, pos0,
+                             k_scale=cache_k.scale, v_scale=cache_v.scale)
+        else:
+            o = flash_decode(q, cache_k, cache_v, pos0)
+    else:
+        o = _cached_attention(q, _cache_read(cache_k, x.dtype),
+                              _cache_read(cache_v, x.dtype), pos0)
     o = o.reshape(B, T, h_loc * head_dim)
     x = x + row_parallel_matmul(o, p["wo"].astype(x.dtype), tp_axis,
                                 p["bo"].astype(x.dtype))

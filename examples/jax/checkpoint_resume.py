@@ -1,21 +1,24 @@
-"""Checkpoint/resume with orbax + broadcast_parameters.
+"""Checkpoint/resume with the byteps_tpu checkpoint subsystem.
 
 Reference behavior (SURVEY §5.4): checkpointing belongs to the host
 framework; BytePS contributes ``broadcast_parameters`` /
 ``broadcast_optimizer_state`` so rank 0's restored state reaches every
-worker. Here: orbax saves/restores on the controller, and in hybrid
-(multi-pod) mode ``broadcast_parameters`` synchronizes the restored pytree
-across pods.
+worker. Here: ``byteps_tpu.checkpoint.Checkpointer`` writes step-numbered
+sharded checkpoints (hybrid multi-pod mode gates the write to pod 0 via
+``should_save``), and on resume ``broadcast_parameters`` synchronizes the
+restored pytree across pods — same division of labor, sharded-aware.
 """
 
 import argparse
+import os
+import shutil
 
 import jax
 import jax.numpy as jnp
 import optax
-import orbax.checkpoint as ocp
 
 import byteps_tpu.jax as bps
+from byteps_tpu.checkpoint import Checkpointer
 from byteps_tpu.models import GPTConfig
 from byteps_tpu.models.train import make_gpt_train_step, synthetic_batch
 from byteps_tpu.parallel import MeshAxes, make_mesh
@@ -38,19 +41,27 @@ def main():
     tokens = jax.device_put(tokens, bsh)
     targets = jax.device_put(targets, bsh)
 
-    ckpt = ocp.StandardCheckpointer()
-    path = ocp.test_utils.erase_and_create_empty(args.ckpt_dir)
+    # a demo trains from scratch every run — clear stale steps so orbax's
+    # monotone step numbering starts fresh (real resume jobs keep the dir)
+    writer = bps.rank() == 0
+    if writer and os.path.isdir(args.ckpt_dir):
+        shutil.rmtree(args.ckpt_dir)
+    ckpt = Checkpointer(args.ckpt_dir, max_to_keep=2, should_save=writer)
 
     for i in range(args.steps):
         loss, params, opt_state = step(params, opt_state, tokens, targets)
-    print(f"trained {args.steps} steps, loss={float(loss):.4f}")
+        ckpt.save(i + 1, {"params": params})
+    ckpt.wait()
+    print(f"trained {args.steps} steps, loss={float(loss):.4f}; "
+          f"checkpoints kept: {ckpt.all_steps() if writer else 'n/a'}")
 
-    ckpt.save(path / "state", {"params": params})
-    ckpt.wait_until_finished()
-
-    # resume: restore on this controller, then (in hybrid mode) broadcast
-    # rank 0's restored values to every pod
-    restored = ckpt.restore(path / "state")["params"]
+    # resume, the reference's rank-0 recipe: only the WRITER pod restores
+    # (the ckpt dir need not be a shared filesystem); every other pod
+    # receives rank 0's values through broadcast_parameters
+    if writer:
+        restored = ckpt.restore({"params": params})["params"]
+    else:
+        restored = jax.tree.map(jnp.zeros_like, params)
     if bps.size() > bps.pod_size():
         stacked = jax.tree.map(
             lambda x: jnp.broadcast_to(x, (bps.pod_size(),) + x.shape),

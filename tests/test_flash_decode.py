@@ -1,0 +1,122 @@
+"""Flash-decode kernel vs the jnp golden (interpret mode on CPU).
+
+The contract (ops/flash_decode.py): for a single query token at global
+position ``pos``, the kernel must reproduce
+``attention_lse_jnp(q, K, V, pos, 0, causal=True)`` where K/V is the
+(dequantized) cache — f32 accumulation, output in q.dtype — while
+reading the stored cache layout directly (int8 included, via the
+algebraic scale folding).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from byteps_tpu.models.generate import _quantize_block
+from byteps_tpu.ops.flash_attention import attention_lse_jnp
+from byteps_tpu.ops.flash_decode import decode_supported, flash_decode
+
+
+def _mk(B, S, H, Hkv, D, dtype, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (B, 1, H, D), dtype)
+    k = jax.random.normal(ks[1], (B, S, Hkv, D), dtype)
+    v = jax.random.normal(ks[2], (B, S, Hkv, D), dtype)
+    return q, k, v
+
+
+def _golden(q, k, v, pos):
+    o, _ = attention_lse_jnp(q, k, v, pos, 0, causal=True)
+    return o
+
+
+@pytest.mark.parametrize("pos", [0, 5, 31, 32, 63])
+def test_matches_golden_mha(pos):
+    q, k, v = _mk(2, 64, 4, 4, 32, jnp.float32)
+    o = flash_decode(q, k, v, jnp.int32(pos))
+    np.testing.assert_allclose(np.asarray(o), np.asarray(_golden(q, k, v, pos)),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("g", [2, 4])
+def test_matches_golden_gqa(g):
+    H = 8
+    q, k, v = _mk(2, 64, H, H // g, 32, jnp.float32, seed=1)
+    o = flash_decode(q, k, v, jnp.int32(40))
+    np.testing.assert_allclose(np.asarray(o),
+                               np.asarray(_golden(q, k, v, 40)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_quantized_cache_matches_dequantized_golden():
+    """int8 cache + scale folding == dequantize-then-attend, exactly."""
+    q, k, v = _mk(2, 64, 4, 2, 32, jnp.float32, seed=2)
+    kq, ks = _quantize_block(k)
+    vq, vs = _quantize_block(v)
+    kd = (kq.astype(jnp.float32) * ks[..., None]).astype(k.dtype)
+    vd = (vq.astype(jnp.float32) * vs[..., None]).astype(v.dtype)
+    o = flash_decode(q, kq, vq, jnp.int32(50), k_scale=ks, v_scale=vs)
+    np.testing.assert_allclose(np.asarray(o),
+                               np.asarray(_golden(q, kd, vd, 50)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bf16_in_bf16_out_f32_accumulate():
+    q, k, v = _mk(1, 32, 2, 2, 64, jnp.bfloat16, seed=3)
+    o = flash_decode(q, k, v, jnp.int32(20))
+    assert o.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(o, np.float32),
+        np.asarray(_golden(q, k, v, 20), np.float32),
+        rtol=2e-2, atol=2e-2)
+
+
+def test_pos_is_a_runtime_scalar_one_trace():
+    """One jit trace serves every decode step (pos in SMEM)."""
+    q, k, v = _mk(1, 64, 2, 2, 32, jnp.float32, seed=4)
+    outs = [flash_decode(q, k, v, jnp.int32(p)) for p in (3, 17, 60)]
+    for p, o in zip((3, 17, 60), outs):
+        np.testing.assert_allclose(np.asarray(o),
+                                   np.asarray(_golden(q, k, v, p)),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_guards():
+    q, k, v = _mk(1, 64, 4, 2, 32, jnp.float32)
+    with pytest.raises(ValueError, match="T=1"):
+        flash_decode(jnp.concatenate([q, q], axis=1), k, v, 0)
+    with pytest.raises(ValueError, match="unsupported"):
+        flash_decode(q, k[:, :7], v[:, :7], 0)
+    with pytest.raises(ValueError, match="together"):
+        flash_decode(q, k, v, 0, k_scale=jnp.ones((1, 64, 2)))
+    assert not decode_supported(7, 32)
+    assert decode_supported(64, 32)
+
+
+# ---- end-to-end: the kernel inside the scanned sampler ---------------------
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_generation_pinned_across_backends(monkeypatch, dtype):
+    """Forced-pallas decode (kernel, interpret) must generate the SAME
+    tokens as the jnp backend — dense and int8-quantized caches, f32
+    AND bf16 models (the kernel's VMEM dequant rounds through the model
+    dtype exactly like _cache_read, so bf16+quant is pinned too)."""
+    import dataclasses
+
+    from byteps_tpu.models import GPTConfig
+    from byteps_tpu.models.gpt import gpt_init
+    from byteps_tpu.models.generate import make_generate_fn
+
+    cfg = dataclasses.replace(GPTConfig.tiny(),
+                              dtype=jnp.dtype(dtype).type)
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0,
+                                cfg.vocab_size)
+    for quant in (False, True):
+        monkeypatch.setenv("BYTEPS_KERNEL_BACKEND", "jnp")
+        ref = make_generate_fn(cfg, max_new=6, quant_cache=quant)(
+            params, prompt, jax.random.PRNGKey(2))
+        monkeypatch.setenv("BYTEPS_KERNEL_BACKEND", "pallas")
+        got = make_generate_fn(cfg, max_new=6, quant_cache=quant)(
+            params, prompt, jax.random.PRNGKey(2))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
