@@ -251,6 +251,47 @@ def _build_gpt(cfg, batch, seq, compression_params, mesh_devices):
     )
 
 
+def _build_moe(cfg, batch, seq, compression_params, mesh_devices):
+    """Switch-MoE GPT (single chip: all experts local, router + capacity
+    dispatch still run — the MoE subsystem's real overhead vs dense)."""
+    import optax
+
+    from byteps_tpu.models.moe_gpt import moe_gpt_init, moe_gpt_loss
+    from byteps_tpu.models.train import (
+        make_gpt_moe_train_step, synthetic_batch)
+    from byteps_tpu.parallel import MeshAxes, make_mesh
+
+    tokens, targets = synthetic_batch(jax.random.PRNGKey(0), cfg, batch, seq)
+    mesh = make_mesh(MeshAxes(dp=1), devices=mesh_devices)
+    step, params, opt_state, bsh = make_gpt_moe_train_step(
+        cfg, mesh, optax.adamw(1e-3), compression_params=compression_params
+    )
+    dev_batch = (jax.device_put(tokens, bsh), jax.device_put(targets, bsh))
+
+    gold_tx = optax.adamw(1e-3)
+    gparams = moe_gpt_init(jax.random.PRNGKey(0), cfg)
+    gstate = gold_tx.init(gparams)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def gold_step(p, s, tok, tgt):
+        loss, g = jax.value_and_grad(
+            lambda p_: moe_gpt_loss(p_, tok, tgt, cfg)
+        )(p)
+        u, s = gold_tx.update(g, s, p)
+        return loss, optax.apply_updates(p, u), s
+
+    # top-k routing: each token runs k expert FFNs (same shape as the
+    # dense MLP) + the d×E gate; dispatch einsums are O(T·E·cap·d) extra
+    flops = _transformer_step_flops(
+        cfg.d_model, cfg.n_layers, cfg.router_topk * cfg.d_ff,
+        cfg.vocab_size, batch, seq)
+    return dict(
+        ours=(step, {"p": params, "o": opt_state}, dev_batch),
+        gold=(gold_step, {"p": gparams, "o": gstate}, (tokens, targets)),
+        flops=flops, unit_per_step=batch * seq, unit="tokens",
+    )
+
+
 def _build_bert(cfg, batch, seq, compression_params, mesh_devices):
     import optax
 
@@ -475,6 +516,18 @@ def _model_setup(model: str, compressor: str, on_cpu: bool):
         b, s = (4, 32) if on_cpu else (2, 1024)
         name = "GPT-2-medium" if not on_cpu else "GPT-2-medium(tiny-sub)"
         return name, _build_gpt(cfg, b, s, cp, dev)
+    if model == "moe":
+        from byteps_tpu.models.moe_gpt import MoEGPTConfig
+        cfg = (
+            MoEGPTConfig.tiny() if on_cpu else
+            MoEGPTConfig(vocab_size=32768, max_seq=512, d_model=512,
+                         n_heads=8, n_layers=8, d_ff=2048, n_experts=8,
+                         dtype=jnp.bfloat16)
+        )
+        b, s = (4, 32) if on_cpu else (8, 512)
+        name = (f"Switch-MoE E{cfg.n_experts} d{cfg.d_model}/"
+                f"L{cfg.n_layers}")
+        return name, _build_moe(cfg, b, s, cp, dev)
     if model == "bert":
         cfg = (
             BertConfig.tiny() if on_cpu else
@@ -706,6 +759,17 @@ def bench_generate() -> dict:
         run_cached(), run_recompute, warmup=1, iters=3 if on_cpu else 5)
     speedup = t_recompute / t_cached
 
+    # int8 cache variant: same sampler, quantized k/v (flash-decode reads
+    # int8 + scales directly — half the cache bandwidth per token)
+    gen_q = make_generate_fn(cfg, max_new, quant_cache=True)
+
+    def run_quant():
+        return _fence(gen_q(params, prompt, rng))
+
+    t_quant, t_dense = _time_pair(
+        run_quant, run_cached(), warmup=1, iters=3 if on_cpu else 5)
+    quant_ratio = t_dense / t_quant     # >1 = int8 cache decodes faster
+
     # slope over chained gen calls cancels the per-call tunnel overhead;
     # endpoints timed back-to-back so drift between them stays small
     s_iters = 2 if on_cpu else 5
@@ -723,7 +787,9 @@ def bench_generate() -> dict:
     _log(f"generate: cached {t_cached*1e3:.1f}ms "
          f"({tok_s:.0f} new tok/s), full-recompute "
          f"{t_recompute*1e3:.1f}ms, speedup {speedup:.2f}x"
-         + (f", slope/call {t_slope*1e3:.1f}ms" if t_slope else ""))
+         + (f", slope/call {t_slope*1e3:.1f}ms" if t_slope else "")
+         + f"; int8-cache {t_quant*1e3:.1f}ms "
+         f"({quant_ratio:.2f}x vs dense cache)")
     return {
         "metric": f"GPT d{d}/L{L} cached decode, {max_new} new tokens "
                   f"(B={B}, prompt {T0}) vs full recompute",
@@ -733,6 +799,8 @@ def bench_generate() -> dict:
         "call_ms_cached": round(t_cached * 1e3, 3),
         "call_ms_recompute": round(t_recompute * 1e3, 3),
         "call_ms_slope": round(t_slope * 1e3, 3) if t_slope else None,
+        "call_ms_quant_cache": round(t_quant * 1e3, 3),
+        "quant_vs_dense_cache": round(quant_ratio, 3),
         "device_kind": kind,
         "peak_tflops_bf16": peak,
         "flops_per_call": flops,
@@ -1011,7 +1079,7 @@ def main() -> None:
                     default="auto")
     ap.add_argument("--model",
                     choices=["gpt", "gpt2m", "bert", "resnet50", "vit",
-                             "t5"],
+                             "t5", "moe"],
                     default="gpt",
                     help="single-chip workload (BASELINE configs: "
                     "2=resnet50, 3=bert --compressor onebit, "

@@ -170,9 +170,10 @@ def _attn_cached_half(x, p, cache_k, cache_v, pos0, head_dim, tp_axis,
     cache_v = _cache_write(cache_v, v, pos0)
     # GQA is native on every path — prefill and decode read the narrow
     # cache directly, no repeat anywhere. The T=1 decode step takes the
-    # flash-decode kernel when available: it streams the cache in its
-    # STORED dtype (int8 included — scales fold algebraically), so the
-    # quantized cache is never materialized dequantized in HBM.
+    # flash-decode kernel when available: one explicit VMEM online-
+    # softmax pass over the stored cache (int8 read directly, dequant
+    # per block in VMEM with _cache_read's rounding), dead blocks
+    # skipped past the fill level.
     from byteps_tpu.ops.flash_decode import (
         decode_supported, flash_decode, use_pallas)
 

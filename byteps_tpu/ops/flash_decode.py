@@ -3,11 +3,15 @@
 No reference analog (the reference is a training system; its models
 delegate attention to torch/TF). On TPU, autoregressive decode is
 HBM-bandwidth-bound: every generated token reads the whole KV cache
-once. The jnp path pays extra for that read twice over — with an
-int8-quantized cache it first *materializes* a full bf16 dequantized
-copy in HBM (``models/generate.py _cache_read``), then runs dense
-(1, S) attention over it. This kernel streams the cache through VMEM
-exactly once, in its stored dtype:
+once. The jnp fallback leaves that op to XLA's fusion of a ``(1, S)``
+einsum/softmax chain over the full static cache (int8 reads stay fused
+— see ``models/generate.py _cache_read``); this kernel makes the
+schedule explicit instead of hoping the fusion holds: one VMEM-resident
+online-softmax pass over the stored cache with no intermediate
+score/probability arrays in HBM, compute skipped block-by-block past
+the fill level (the jnp chain always computes all of ``S_max``), and
+the dequantized view never materialized anywhere (the pallas *prefill*
+path must materialize it once per prefill, taking concrete operands):
 
 * Grid ``(B, nk)`` — one program per sequence, ``nk`` sequential key
   blocks with flash-style online-softmax state ``(m, l, acc)`` in VMEM
@@ -21,11 +25,10 @@ exactly once, in its stored dtype:
   there is no per-step transpose/copy of anything.
 * int8 dequantization happens in VMEM, block by block: each head's
   ``(bk, D)`` int8 tile is multiplied by its ``(bk, 1)`` scale column
-  and rounded through the model dtype — bit-identical to the jnp
-  path's ``_cache_read`` semantics — but the full-cache dequantized
-  copy that path materializes in HBM never exists: the int8 cache is
-  read from HBM at HALF the bf16 bandwidth. The dense (non-quantized)
-  signature carries no scale operands at all.
+  and rounded through the model dtype — bit-identical to
+  ``_cache_read``'s semantics — so the int8 cache is read from HBM at
+  half the bf16 bandwidth by construction, not by fusion luck. The
+  dense (non-quantized) signature carries no scale operands at all.
 * Fill-level masking: keys at global positions ``> pos`` (the query's
   position) are dead — whole dead blocks skip compute via ``pl.when``,
   the boundary block masks by global column index. ``pos`` is a runtime

@@ -41,10 +41,18 @@ def main():
     tokens = jax.device_put(tokens, bsh)
     targets = jax.device_put(targets, bsh)
 
+    # Two multi-host regimes, two recipes:
+    #  - global mesh (BYTEPS_JAX_DISTRIBUTED=1): arrays are globally
+    #    sharded, so save/restore are COLLECTIVE — every process
+    #    participates (shared filesystem required), no broadcast needed.
+    #  - hybrid PS pods: independent jax worlds — pod 0 writes, everyone
+    #    receives the restored values via broadcast_parameters.
+    collective = jax.process_count() > 1
+    writer = collective or bps.rank() == 0
     # a demo trains from scratch every run — clear stale steps so orbax's
     # monotone step numbering starts fresh (real resume jobs keep the dir)
-    writer = bps.rank() == 0
-    if writer and os.path.isdir(args.ckpt_dir):
+    if jax.process_index() == 0 and bps.rank() == 0 \
+            and os.path.isdir(args.ckpt_dir):
         shutil.rmtree(args.ckpt_dir)
     ckpt = Checkpointer(args.ckpt_dir, max_to_keep=2, should_save=writer)
 
@@ -55,14 +63,15 @@ def main():
     print(f"trained {args.steps} steps, loss={float(loss):.4f}; "
           f"checkpoints kept: {ckpt.all_steps() if writer else 'n/a'}")
 
-    # resume, the reference's rank-0 recipe: only the WRITER pod restores
-    # (the ckpt dir need not be a shared filesystem); every other pod
-    # receives rank 0's values through broadcast_parameters
+    # resume: collective restore on a global mesh; otherwise the
+    # reference's rank-0 recipe — the writer pod restores (its ckpt dir
+    # need not be shared) and every other pod receives the values
+    # through broadcast_parameters
     if writer:
         restored = ckpt.restore({"params": params})["params"]
     else:
         restored = jax.tree.map(jnp.zeros_like, params)
-    if bps.size() > bps.pod_size():
+    if not collective and bps.size() > bps.pod_size():
         stacked = jax.tree.map(
             lambda x: jnp.broadcast_to(x, (bps.pod_size(),) + x.shape),
             restored,

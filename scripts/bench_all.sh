@@ -25,6 +25,7 @@ run --model gpt2m --compressor topk      # BASELINE config 4
 run --model gpt2m                        # MFU-honest large config (uncompressed)
 run --model vit                          # beyond-reference families
 run --model t5
+run --model moe                          # Switch-MoE routing overhead vs dense
 run --mode generate                      # KV-cache decode vs full recompute
 run --mode dcn                           # DCN summation tier
 run --mode dcn-profile                   # host component ceilings
