@@ -26,9 +26,12 @@ import jax.numpy as jnp
 
 from byteps_tpu.models.gpt import (
     GPTConfig,
+    _bias,
     _layernorm,
     _mlp,
     _readout,
+    resolve_norm,
+    resolve_rope,
     rope_rotate,
 )
 from byteps_tpu.parallel.tp import col_parallel_matmul, row_parallel_matmul
@@ -141,7 +144,8 @@ def _cached_attention(q, k_cache, v_cache, q_pos0):
 
 
 def _attn_cached_half(x, p, cache_k, cache_v, pos0, head_dim, tp_axis,
-                      rope_base: float = 0.0):
+                      rope_base: float = 0.0, norm_fn=_layernorm,
+                      norm_eps: float = 1e-5, use_bias: bool = True):
     """The attention residual branch over T new tokens with cache append.
 
     x: (B, T, d); cache_k/v: (B, S_max, h_loc, D) this layer's cache.
@@ -153,10 +157,10 @@ def _attn_cached_half(x, p, cache_k, cache_v, pos0, head_dim, tp_axis,
     cache-append path.
     """
     B, T = x.shape[:2]
-    h = _layernorm(x, p["ln1_g"], p["ln1_b"])
-    q = col_parallel_matmul(h, p["wq"].astype(x.dtype), p["bq"].astype(x.dtype))
-    k = col_parallel_matmul(h, p["wk"].astype(x.dtype), p["bk"].astype(x.dtype))
-    v = col_parallel_matmul(h, p["wv"].astype(x.dtype), p["bv"].astype(x.dtype))
+    h = norm_fn(x, p["ln1_g"], p.get("ln1_b"), norm_eps)
+    q = col_parallel_matmul(h, p["wq"].astype(x.dtype), _bias(p, "bq", x, use_bias))
+    k = col_parallel_matmul(h, p["wk"].astype(x.dtype), _bias(p, "bk", x, use_bias))
+    v = col_parallel_matmul(h, p["wv"].astype(x.dtype), _bias(p, "bv", x, use_bias))
     h_loc = q.shape[-1] // head_dim
     kv_loc = k.shape[-1] // head_dim    # GQA: the cache stores kv heads only
     q = q.reshape(B, T, h_loc, head_dim)
@@ -190,17 +194,19 @@ def _attn_cached_half(x, p, cache_k, cache_v, pos0, head_dim, tp_axis,
                               _cache_read(cache_v, x.dtype), pos0)
     o = o.reshape(B, T, h_loc * head_dim)
     x = x + row_parallel_matmul(o, p["wo"].astype(x.dtype), tp_axis,
-                                p["bo"].astype(x.dtype))
+                                _bias(p, "bo", x, use_bias))
     return x, cache_k, cache_v
 
 
-def _block_step(x, p, cache_k, cache_v, pos0, cfg, tp_axis, ep_axis):
+def _block_step(x, p, cache_k, cache_v, pos0, cfg, tp_axis, ep_axis,
+                norm_fn=_layernorm, norm_eps: float = 1e-5):
     """One transformer block (dense-MLP or MoE, by param structure) over
     T new tokens with cache append."""
     x, cache_k, cache_v = _attn_cached_half(
         x, p, cache_k, cache_v, pos0, cfg.head_dim, tp_axis,
-        rope_base=(cfg.rope_base if cfg.pos_embedding == "rope" else 0.0))
-    h = _layernorm(x, p["ln2_g"], p["ln2_b"])
+        rope_base=(cfg.rope_base if cfg.pos_embedding == "rope" else 0.0),
+        norm_fn=norm_fn, norm_eps=norm_eps, use_bias=cfg.use_bias)
+    h = norm_fn(x, p["ln2_g"], p.get("ln2_b"), norm_eps)
     if "moe" in p:
         from byteps_tpu.parallel.moe import moe_ffn
 
@@ -212,7 +218,7 @@ def _block_step(x, p, cache_k, cache_v, pos0, cfg, tp_axis, ep_axis):
             tp_axis=tp_axis, no_drop=True)
         x = x + m
     else:
-        x = x + _mlp(h, p, tp_axis)
+        x = x + _mlp(h, p, tp_axis, use_bias=cfg.use_bias)
     return x, cache_k, cache_v
 
 
@@ -229,9 +235,8 @@ def gpt_apply_cached(params, tokens: jnp.ndarray, cache: KVCache,
     MoE GPT families (block type detected from the params; ``ep_axis``
     shards the experts inside shard_map).
     """
-    from byteps_tpu.models.gpt import resolve_rope
-
     resolve_rope(cfg)   # validate the position scheme decode-side too
+    norm_fn, norm_eps = resolve_norm(cfg)
     B, T = tokens.shape
     pos0 = cache.length
     if cfg.pos_embedding == "rope":
@@ -248,7 +253,8 @@ def gpt_apply_cached(params, tokens: jnp.ndarray, cache: KVCache,
               else cache.k[li])
         cv = (_QuantSlot(cache.v[li], cache.v_scale[li]) if quant
               else cache.v[li])
-        x, ck, cv = _block_step(x, p, ck, cv, pos0, cfg, tp_axis, ep_axis)
+        x, ck, cv = _block_step(x, p, ck, cv, pos0, cfg, tp_axis, ep_axis,
+                                norm_fn=norm_fn, norm_eps=norm_eps)
         if quant:
             new_k.append(ck.q)
             new_ks.append(ck.scale)
@@ -257,7 +263,7 @@ def gpt_apply_cached(params, tokens: jnp.ndarray, cache: KVCache,
         else:
             new_k.append(ck)
             new_v.append(cv)
-    logits = _readout(params, x)
+    logits = _readout(params, x, norm_fn, norm_eps)
     return logits, KVCache(
         k=jnp.stack(new_k), v=jnp.stack(new_v), length=pos0 + T,
         k_scale=jnp.stack(new_ks) if quant else None,

@@ -40,8 +40,9 @@ class GPTConfig:
     d_ff: int = 3072
     dtype: Any = jnp.float32
     # "learned" = GPT-2 wpe table; "rope" = rotary position embeddings
-    # applied to q/k per head (wpe stays in the param tree, unused — the
-    # tree structure is position-scheme independent)
+    # applied to q/k per head (no wpe leaf — the param tree carries
+    # exactly the leaves the config trains, so lossy gradient
+    # compression can never perturb a structurally-dead parameter)
     pos_embedding: str = "learned"
     rope_base: float = 10000.0
     # grouped-query attention: k/v carry n_kv_heads heads (None = n_heads,
@@ -51,6 +52,19 @@ class GPTConfig:
     # "gelu" = GPT-2 2-matrix MLP; "swiglu" = gated 3-matrix llama-style
     # FFN (silu(x·w1) ∘ (x·w3)) · w2 — same d_ff hidden width
     mlp: str = "gelu"
+    # "layernorm" = GPT-2 LN (mean-centered, affine); "rmsnorm" =
+    # llama-style RMS norm (no centering, no bias — the ln*_b / lnf_b
+    # leaves are absent from the param tree)
+    norm: str = "layernorm"
+    norm_eps: float = 1e-5
+    # False = llama-style bias-free projections: no b* leaves in the
+    # tree. Leaves the config doesn't train must NOT exist — inert
+    # zeros would drift under lossy gradient compression (onebit maps
+    # a zero gradient to ±scale) and break checkpoint re-export.
+    use_bias: bool = True
+    # True = GPT-2 weight-tied readout (h @ wte.T); False = separate
+    # (d, vocab) "lm_head" leaf (llama-style untied readout)
+    tied_readout: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -76,6 +90,15 @@ class GPTConfig:
         return cls(vocab_size=50304, max_seq=1024, d_model=1024,
                    n_heads=16, n_layers=24, d_ff=4096, dtype=jnp.bfloat16)
 
+    @classmethod
+    def llama(cls, **kw) -> "GPTConfig":
+        """The llama-family option set (RoPE + GQA + SwiGLU + RMSNorm +
+        untied readout); size fields via ``**kw``."""
+        defaults = dict(pos_embedding="rope", mlp="swiglu", norm="rmsnorm",
+                        tied_readout=False, use_bias=False)
+        defaults.update(kw)
+        return cls(**defaults)
+
 
 def gpt_init(rng: jnp.ndarray, cfg: GPTConfig) -> Dict[str, Any]:
     """Initialize full (unsharded) parameters; shard via device_put after."""
@@ -89,15 +112,20 @@ def gpt_init(rng: jnp.ndarray, cfg: GPTConfig) -> Dict[str, Any]:
     keys = jax.random.split(rng, 2 + cfg.n_layers)
     params: Dict[str, Any] = {
         "wte": dense(keys[0], (cfg.vocab_size, d)),
-        "wpe": dense(keys[1], (cfg.max_seq, d)),
         "lnf_g": jnp.ones((d,), jnp.float32),
-        "lnf_b": jnp.zeros((d,), jnp.float32),
         "blocks": [
             block_init(keys[2 + li], d, ff, hd, cfg.n_layers, kv_hd=kv_hd,
-                       mlp=cfg.mlp)
+                       mlp=cfg.mlp, use_bias=cfg.use_bias, norm=cfg.norm)
             for li in range(cfg.n_layers)
         ],
     }
+    if cfg.pos_embedding == "learned":
+        params["wpe"] = dense(keys[1], (cfg.max_seq, d))
+    if cfg.norm == "layernorm":
+        params["lnf_b"] = jnp.zeros((d,), jnp.float32)
+    if not cfg.tied_readout:
+        params["lm_head"] = dense(jax.random.fold_in(keys[0], 1),
+                                  (d, cfg.vocab_size))
     return params
 
 
@@ -110,8 +138,12 @@ def gpt_param_specs(cfg: GPTConfig, tp_axis: Optional[str]) -> Dict[str, Any]:
     replication is implicit — those axes never appear in param specs).
     """
     return {
-        "wte": P(), "wpe": P(), "lnf_g": P(), "lnf_b": P(),
-        "blocks": [block_specs(tp_axis, cfg.mlp)
+        "wte": P(), "lnf_g": P(),
+        **({"wpe": P()} if cfg.pos_embedding == "learned" else {}),
+        **({"lnf_b": P()} if cfg.norm == "layernorm" else {}),
+        **({} if cfg.tied_readout else {"lm_head": P()}),
+        "blocks": [block_specs(tp_axis, cfg.mlp, use_bias=cfg.use_bias,
+                               norm=cfg.norm)
                    for _ in range(cfg.n_layers)],
     }
 
@@ -158,19 +190,52 @@ def rope_rotate(x: jnp.ndarray, pos: jnp.ndarray,
     return out.astype(x.dtype)
 
 
-def _layernorm(x: jnp.ndarray, g: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+def _layernorm(x: jnp.ndarray, g: jnp.ndarray, b: jnp.ndarray,
+               eps: float = 1e-5) -> jnp.ndarray:
     xf = x.astype(jnp.float32)
     mu = xf.mean(-1, keepdims=True)
     var = ((xf - mu) ** 2).mean(-1, keepdims=True)
-    return ((xf - mu) * jax.lax.rsqrt(var + 1e-5) * g + b).astype(x.dtype)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps) * g + b).astype(x.dtype)
+
+
+def _rmsnorm(x: jnp.ndarray, g: jnp.ndarray, b=None,
+             eps: float = 1e-5) -> jnp.ndarray:
+    """Llama-style RMS norm. ``b`` is accepted for signature parity with
+    layernorm but must be absent (RMSNorm has no bias — rmsnorm trees
+    carry no ln*_b leaves)."""
+    assert b is None, "rmsnorm trees carry no norm-bias leaf"
+    xf = x.astype(jnp.float32)
+    ms = (xf * xf).mean(-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(ms + eps) * g).astype(x.dtype)
+
+
+_NORMS = {"layernorm": _layernorm, "rmsnorm": _rmsnorm}
+
+
+def resolve_norm(cfg: GPTConfig):
+    """Validate cfg.norm and return the (norm_fn, eps) pair to thread to
+    the blocks/readout."""
+    if cfg.norm not in _NORMS:
+        raise ValueError(f"unknown norm {cfg.norm!r} — expected one of "
+                         f"{sorted(_NORMS)}")
+    if not cfg.norm_eps > 0.0:
+        raise ValueError(f"norm_eps must be > 0; got {cfg.norm_eps}")
+    return _NORMS[cfg.norm], cfg.norm_eps
+
+
+def _bias(p, name, x, use_bias: bool):
+    """The projection bias to apply — None under use_bias=False (the
+    leaf stays in the tree, inert, zero-gradient)."""
+    return p[name].astype(x.dtype) if use_bias else None
 
 
 def _attention(x, p, head_dim: int, tp_axis, sp_axis, causal: bool = True,
-               seq_layout: str = "contiguous", rope_base: float = 0.0):
+               seq_layout: str = "contiguous", rope_base: float = 0.0,
+               use_bias: bool = True):
     B, S = x.shape[:2]
-    q = col_parallel_matmul(x, p["wq"].astype(x.dtype), p["bq"].astype(x.dtype))
-    k = col_parallel_matmul(x, p["wk"].astype(x.dtype), p["bk"].astype(x.dtype))
-    v = col_parallel_matmul(x, p["wv"].astype(x.dtype), p["bv"].astype(x.dtype))
+    q = col_parallel_matmul(x, p["wq"].astype(x.dtype), _bias(p, "bq", x, use_bias))
+    k = col_parallel_matmul(x, p["wk"].astype(x.dtype), _bias(p, "bk", x, use_bias))
+    v = col_parallel_matmul(x, p["wv"].astype(x.dtype), _bias(p, "bv", x, use_bias))
     h_loc = q.shape[-1] // head_dim     # query heads this tp shard owns
     kv_loc = k.shape[-1] // head_dim    # kv heads (GQA: fewer)
     if kv_loc == 0 or h_loc % kv_loc != 0:
@@ -198,41 +263,50 @@ def _attention(x, p, head_dim: int, tp_axis, sp_axis, causal: bool = True,
                          "'contiguous' or 'zigzag'")
     o = o.reshape(B, S, h_loc * head_dim)
     return row_parallel_matmul(o, p["wo"].astype(x.dtype), tp_axis,
-                               p["bo"].astype(x.dtype))
+                               _bias(p, "bo", x, use_bias))
 
 
-def _mlp(x, p, tp_axis):
-    h = col_parallel_matmul(x, p["w1"].astype(x.dtype), p["b1"].astype(x.dtype))
+def _mlp(x, p, tp_axis, use_bias: bool = True):
+    h = col_parallel_matmul(x, p["w1"].astype(x.dtype),
+                            _bias(p, "b1", x, use_bias))
     if "w3" in p:
         # SwiGLU: silu-gated hidden (w1 value path ∘ w3 gate path); w1/w3
         # col-parallel over tp, w2 row-parallel as in the gelu MLP
         g = col_parallel_matmul(x, p["w3"].astype(x.dtype),
-                                p["b3"].astype(x.dtype))
+                                _bias(p, "b3", x, use_bias))
         h = jax.nn.silu(h) * g
     else:
         h = jax.nn.gelu(h)
     return row_parallel_matmul(h, p["w2"].astype(x.dtype), tp_axis,
-                               p["b2"].astype(x.dtype))
+                               _bias(p, "b2", x, use_bias))
 
 
 def transformer_block(x, p, head_dim: int, tp_axis=None, sp_axis=None,
                       causal: bool = True, seq_layout: str = "contiguous",
-                      rope_base: float = 0.0):
+                      rope_base: float = 0.0, norm_fn=_layernorm,
+                      norm_eps: float = 1e-5, use_bias: bool = True):
     """Pre-LN block shared by the GPT (causal) and BERT (bidirectional)
     families: attention + MLP, tp col/row-parallel, optional sp ring
     (contiguous or zigzag sequence layout), optional RoPE
-    (``rope_base > 0``)."""
-    x = x + _attention(_layernorm(x, p["ln1_g"], p["ln1_b"]), p, head_dim,
-                       tp_axis, sp_axis, causal=causal,
-                       seq_layout=seq_layout, rope_base=rope_base)
-    return x + _mlp(_layernorm(x, p["ln2_g"], p["ln2_b"]), p, tp_axis)
+    (``rope_base > 0``), layernorm or rmsnorm (``norm_fn``), optional
+    llama-style bias-free projections (``use_bias=False``)."""
+    x = x + _attention(norm_fn(x, p["ln1_g"], p.get("ln1_b"), norm_eps), p,
+                       head_dim, tp_axis, sp_axis, causal=causal,
+                       seq_layout=seq_layout, rope_base=rope_base,
+                       use_bias=use_bias)
+    return x + _mlp(norm_fn(x, p["ln2_g"], p.get("ln2_b"), norm_eps), p,
+                    tp_axis, use_bias=use_bias)
 
 
 def block_init(rng, d: int, ff: int, hd: int, n_layers: int,
-               kv_hd: int = None, mlp: str = "gelu"):
+               kv_hd: int = None, mlp: str = "gelu",
+               use_bias: bool = True, norm: str = "layernorm"):
     """One transformer block's params (shape shared across families).
     ``kv_hd`` (default ``hd``) narrows the k/v projections for GQA;
-    ``mlp="swiglu"`` adds the gate matrix ``w3``."""
+    ``mlp="swiglu"`` adds the gate matrix ``w3``; ``use_bias=False``
+    omits the projection biases and ``norm="rmsnorm"`` the norm biases
+    — absent, not zero, so no optimizer/compression state exists for
+    them (see GPTConfig.use_bias)."""
     if mlp not in ("gelu", "swiglu"):
         raise ValueError(f"unknown mlp {mlp!r} — expected 'gelu' or "
                          "'swiglu'")
@@ -244,41 +318,54 @@ def block_init(rng, d: int, ff: int, hd: int, n_layers: int,
     def dense(key, shape):
         return jax.random.normal(key, shape, jnp.float32) * std
 
-    return {
+    p = {
         "ln1_g": jnp.ones((d,), jnp.float32),
-        "ln1_b": jnp.zeros((d,), jnp.float32),
-        "wq": dense(bk[0], (d, hd)), "bq": jnp.zeros((hd,), jnp.float32),
+        "wq": dense(bk[0], (d, hd)),
         "wk": dense(bk[1], (d, kv_hd)),
-        "bk": jnp.zeros((kv_hd,), jnp.float32),
         "wv": dense(bk[2], (d, kv_hd)),
-        "bv": jnp.zeros((kv_hd,), jnp.float32),
         "wo": dense(bk[3], (hd, d)) / (2 * n_layers) ** 0.5,
-        "bo": jnp.zeros((d,), jnp.float32),
         "ln2_g": jnp.ones((d,), jnp.float32),
-        "ln2_b": jnp.zeros((d,), jnp.float32),
-        "w1": dense(bk[4], (d, ff)), "b1": jnp.zeros((ff,), jnp.float32),
+        "w1": dense(bk[4], (d, ff)),
         "w2": dense(bk[5], (ff, d)) / (2 * n_layers) ** 0.5,
-        "b2": jnp.zeros((d,), jnp.float32),
-        **({"w3": dense(bk[6], (d, ff)),
-            "b3": jnp.zeros((ff,), jnp.float32)} if mlp == "swiglu"
-           else {}),
+        **({"w3": dense(bk[6], (d, ff))} if mlp == "swiglu" else {}),
     }
+    if norm == "layernorm":
+        p["ln1_b"] = jnp.zeros((d,), jnp.float32)
+        p["ln2_b"] = jnp.zeros((d,), jnp.float32)
+    if use_bias:
+        p.update({
+            "bq": jnp.zeros((hd,), jnp.float32),
+            "bk": jnp.zeros((kv_hd,), jnp.float32),
+            "bv": jnp.zeros((kv_hd,), jnp.float32),
+            "bo": jnp.zeros((d,), jnp.float32),
+            "b1": jnp.zeros((ff,), jnp.float32),
+            "b2": jnp.zeros((d,), jnp.float32),
+            **({"b3": jnp.zeros((ff,), jnp.float32)} if mlp == "swiglu"
+               else {}),
+        })
+    return p
 
 
-def block_specs(tp_axis, mlp: str = "gelu"):
+def block_specs(tp_axis, mlp: str = "gelu", use_bias: bool = True,
+                norm: str = "layernorm"):
     """PartitionSpec dict for one transformer block (see gpt_param_specs)."""
     t = tp_axis
-    return {
-        "ln1_g": P(), "ln1_b": P(),
-        "wq": P(None, t), "bq": P(t),
-        "wk": P(None, t), "bk": P(t),
-        "wv": P(None, t), "bv": P(t),
-        "wo": P(t, None), "bo": P(),
-        "ln2_g": P(), "ln2_b": P(),
-        "w1": P(None, t), "b1": P(t),
-        "w2": P(t, None), "b2": P(),
-        **({"w3": P(None, t), "b3": P(t)} if mlp == "swiglu" else {}),
+    s = {
+        "ln1_g": P(), "wq": P(None, t), "wk": P(None, t), "wv": P(None, t),
+        "wo": P(t, None), "ln2_g": P(),
+        "w1": P(None, t), "w2": P(t, None),
+        **({"w3": P(None, t)} if mlp == "swiglu" else {}),
     }
+    if norm == "layernorm":
+        s["ln1_b"] = P()
+        s["ln2_b"] = P()
+    if use_bias:
+        s.update({
+            "bq": P(t), "bk": P(t), "bv": P(t), "bo": P(),
+            "b1": P(t), "b2": P(),
+            **({"b3": P(t)} if mlp == "swiglu" else {}),
+        })
+    return s
 
 
 def _embed(params, tokens: jnp.ndarray, cfg: GPTConfig,
@@ -296,11 +383,15 @@ def _embed(params, tokens: jnp.ndarray, cfg: GPTConfig,
             + jnp.take(params["wpe"], pos, axis=0)).astype(cfg.dtype)
 
 
-def _readout(params, h: jnp.ndarray) -> jnp.ndarray:
-    """Final LN → weight-tied fp32 readout, shared by the dense and
-    pipelined paths so their numerics cannot diverge."""
-    h = _layernorm(h, params["lnf_g"], params["lnf_b"])
-    return h.astype(jnp.float32) @ params["wte"].T.astype(jnp.float32)
+def _readout(params, h: jnp.ndarray, norm_fn=_layernorm,
+             norm_eps: float = 1e-5) -> jnp.ndarray:
+    """Final norm → fp32 readout (weight-tied ``wte.T`` unless the tree
+    carries an untied ``lm_head``), shared by the dense and pipelined
+    paths so their numerics cannot diverge."""
+    h = norm_fn(h, params["lnf_g"], params.get("lnf_b"), norm_eps)
+    head = (params["lm_head"] if "lm_head" in params
+            else params["wte"].T)
+    return h.astype(jnp.float32) @ head.astype(jnp.float32)
 
 
 def _nll(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
@@ -308,8 +399,9 @@ def _nll(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
     return -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
 
 
-def _readout_nll(params, h: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
-    return _nll(_readout(params, h), targets)
+def _readout_nll(params, h: jnp.ndarray, targets: jnp.ndarray,
+                 norm_fn=_layernorm, norm_eps: float = 1e-5) -> jnp.ndarray:
+    return _nll(_readout(params, h, norm_fn, norm_eps), targets)
 
 
 def gpt_forward(params, tokens: jnp.ndarray, cfg: GPTConfig,
@@ -325,12 +417,14 @@ def gpt_forward(params, tokens: jnp.ndarray, cfg: GPTConfig,
     over tp by construction).
     """
     rope_base = resolve_rope(cfg)
+    norm_fn, norm_eps = resolve_norm(cfg)
     x = _embed(params, tokens, cfg, sp_axis, seq_layout)
 
     def apply_block(x, p):
         return transformer_block(x, p, cfg.head_dim, tp_axis, sp_axis,
                                  causal=True, seq_layout=seq_layout,
-                                 rope_base=rope_base)
+                                 rope_base=rope_base, norm_fn=norm_fn,
+                                 norm_eps=norm_eps, use_bias=cfg.use_bias)
 
     # rematerialize per block: activations recomputed in backward — HBM
     # for FLOPs, the long-context lever (see maybe_remat for the tp/sp
@@ -338,8 +432,8 @@ def gpt_forward(params, tokens: jnp.ndarray, cfg: GPTConfig,
     apply_block = maybe_remat(apply_block, remat)
     for p in params["blocks"]:
         x = apply_block(x, p)
-    # weight-tied readout, f32 logits for a stable softmax/loss
-    return _readout(params, x)
+    # f32 logits for a stable softmax/loss
+    return _readout(params, x, norm_fn, norm_eps)
 
 
 def gpt_pp_loss(params, tokens, targets, cfg: GPTConfig,
@@ -374,16 +468,18 @@ def gpt_pp_loss(params, tokens, targets, cfg: GPTConfig,
     x_mb = x.reshape(n_micro, B // n_micro, S_loc, x.shape[-1])
 
     rope_base = resolve_rope(cfg)
+    norm_fn, norm_eps = resolve_norm(cfg)
 
     def blk(h, p):
         return transformer_block(
             h, p, cfg.head_dim, tp_axis, sp_axis, causal=True,
-            seq_layout=seq_layout, rope_base=rope_base)
+            seq_layout=seq_layout, rope_base=rope_base, norm_fn=norm_fn,
+            norm_eps=norm_eps, use_bias=cfg.use_bias)
 
     y_mb = pipeline_apply(x_mb, params["blocks"], blk, pp_axis,
                           remat=remat, vma_axes=vma_axes)
     y = y_mb.reshape(B, S_loc, -1)
-    nll = _readout_nll(params, y, targets)
+    nll = _readout_nll(params, y, targets, norm_fn, norm_eps)
     loss = nll.mean()
     if sp_axis is not None:
         # mean over the sequence shards (inside the grad — VMA types the

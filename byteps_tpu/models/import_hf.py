@@ -1,0 +1,311 @@
+"""HuggingFace checkpoint bridge for the GPT model family.
+
+The reference framework trains torch models in place — its users' weights
+live in torch/HF checkpoints (reference analog: the torch adapter +
+``broadcast_parameters`` recipe, SURVEY §2.4). This module is the
+switching path: load an HF ``GPT2LMHeadModel`` or ``LlamaForCausalLM``
+(or its bare ``state_dict``) into this framework's functional GPT param
+tree, train/generate on TPU, and export back.
+
+Conventions bridged (both directions):
+
+* GPT-2 stores Conv1D weights ``(in, out)`` — our layout, no transpose;
+  the fused ``c_attn`` ``(d, 3d)`` splits into wq/wk/wv. Weight-tied
+  readout maps to ``tied_readout=True``.
+* Llama stores ``nn.Linear`` weights ``(out, in)`` — transposed on the
+  way in. RMSNorm maps to ``norm="rmsnorm"`` and bias-free projections
+  to ``use_bias=False`` — the imported tree carries NO leaves the
+  checkpoint doesn't have (no wpe, no norm/projection biases), so
+  training — including under lossy gradient compression, which would
+  perturb an "inert" zero leaf — touches only real parameters and the
+  tree re-exports cleanly. Rotary embeddings map to
+  ``pos_embedding="rope"`` (both
+  sides use the half-split/rotate_half convention with
+  ``inv_freq = base^(-2i/D)``; HF checkpoints are already stored in
+  this convention), GQA to ``n_kv_heads`` (query head ``q`` reads kv
+  head ``q // G`` on both sides), SwiGLU to ``mlp="swiglu"`` with
+  ``w1=gate_proj``, ``w3=up_proj``, ``w2=down_proj``; ``lm_head`` maps
+  to the untied readout leaf unless ``tie_word_embeddings``.
+
+Numerical parity (logits, fp32) against the HF torch forward is pinned
+in ``tests/test_import_hf.py`` for both families, plus export
+round-trips through ``load_state_dict(strict=True)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from byteps_tpu.models.gpt import GPTConfig
+
+
+def _np(t) -> np.ndarray:
+    """torch tensor / array-like → float32 numpy."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def _state_and_config(source, config):
+    """Accept a live HF model (carries its config) or a bare state_dict
+    (config required)."""
+    if hasattr(source, "state_dict"):
+        cfg = config if config is not None else source.config
+        return source.state_dict(), cfg
+    if config is None:
+        raise ValueError("a bare state_dict needs the HF config object "
+                         "(or a dict of its fields) passed as config=")
+    return dict(source), config
+
+
+def _cfgget(hf_cfg, name, default=None):
+    if isinstance(hf_cfg, dict):
+        return hf_cfg.get(name, default)
+    return getattr(hf_cfg, name, default)
+
+
+def from_hf_gpt2(source, config=None,
+                 dtype: Any = jnp.float32
+                 ) -> Tuple[GPTConfig, Dict[str, Any]]:
+    """``GPT2LMHeadModel`` (or its state_dict + config) → (GPTConfig,
+    params) for this framework's GPT family. ``dtype`` sets the
+    activation dtype (params stay fp32, cast per-op as everywhere else).
+    """
+    sd, hf = _state_and_config(source, config)
+    act = _cfgget(hf, "activation_function", "gelu_new")
+    if act != "gelu_new":
+        raise NotImplementedError(
+            f"activation_function={act!r} — the GPT family's gelu is the "
+            "tanh approximation (HF 'gelu_new', the GPT-2 default); "
+            "importing a different activation would silently change the "
+            "model's numerics")
+    for flag in ("scale_attn_by_inverse_layer_idx",
+                 "reorder_and_upcast_attn"):
+        if _cfgget(hf, flag, False):
+            raise NotImplementedError(
+                f"{flag}=True is not implemented — importing it with "
+                "standard attention scaling would silently change the "
+                "model's numerics")
+    d = _cfgget(hf, "n_embd")
+    n_inner = _cfgget(hf, "n_inner") or 4 * d
+    cfg = GPTConfig(
+        vocab_size=_cfgget(hf, "vocab_size"),
+        max_seq=_cfgget(hf, "n_positions"),
+        d_model=d,
+        n_heads=_cfgget(hf, "n_head"),
+        n_layers=_cfgget(hf, "n_layer"),
+        d_ff=n_inner,
+        dtype=dtype,
+        norm_eps=float(_cfgget(hf, "layer_norm_epsilon", 1e-5)),
+    )
+
+    def g(key):
+        return _np(sd[key])
+
+    blocks = []
+    for i in range(cfg.n_layers):
+        p = f"transformer.h.{i}."
+        w_attn = g(p + "attn.c_attn.weight")          # (d, 3d), Conv1D
+        b_attn = g(p + "attn.c_attn.bias")
+        wq, wk, wv = np.split(w_attn, 3, axis=1)
+        bq, bk, bv = np.split(b_attn, 3)
+        blocks.append({
+            "ln1_g": g(p + "ln_1.weight"), "ln1_b": g(p + "ln_1.bias"),
+            "wq": wq, "bq": bq, "wk": wk, "bk": bk, "wv": wv, "bv": bv,
+            "wo": g(p + "attn.c_proj.weight"),
+            "bo": g(p + "attn.c_proj.bias"),
+            "ln2_g": g(p + "ln_2.weight"), "ln2_b": g(p + "ln_2.bias"),
+            "w1": g(p + "mlp.c_fc.weight"), "b1": g(p + "mlp.c_fc.bias"),
+            "w2": g(p + "mlp.c_proj.weight"),
+            "b2": g(p + "mlp.c_proj.bias"),
+        })
+    params = {
+        "wte": g("transformer.wte.weight"),
+        "wpe": g("transformer.wpe.weight"),
+        "lnf_g": g("transformer.ln_f.weight"),
+        "lnf_b": g("transformer.ln_f.bias"),
+        "blocks": blocks,
+    }
+    return cfg, _to_jnp(params)
+
+
+def to_hf_gpt2(params: Dict[str, Any], cfg: GPTConfig) -> Dict[str, Any]:
+    """Our GPT-2-shaped params → an HF ``GPT2LMHeadModel`` state_dict
+    (numpy values; wrap with ``torch.from_numpy`` per leaf or let
+    ``load_state_dict`` do it via ``torch.as_tensor``). Inverse of
+    :func:`from_hf_gpt2` — round-trip pinned in tests."""
+    if (cfg.norm != "layernorm" or not cfg.tied_readout
+            or cfg.mlp != "gelu" or not cfg.use_bias
+            or cfg.pos_embedding != "learned"):
+        raise ValueError("to_hf_gpt2 exports the GPT-2 option set "
+                         "(layernorm, tied readout, gelu MLP, biases, "
+                         "learned positions); got "
+                         f"norm={cfg.norm!r} tied={cfg.tied_readout} "
+                         f"mlp={cfg.mlp!r} use_bias={cfg.use_bias} "
+                         f"pos={cfg.pos_embedding!r}")
+    out: Dict[str, Any] = {
+        "transformer.wte.weight": np.asarray(params["wte"]),
+        "transformer.wpe.weight": np.asarray(params["wpe"]),
+        "transformer.ln_f.weight": np.asarray(params["lnf_g"]),
+        "transformer.ln_f.bias": np.asarray(params["lnf_b"]),
+        "lm_head.weight": np.asarray(params["wte"]),
+    }
+    for i, b in enumerate(params["blocks"]):
+        p = f"transformer.h.{i}."
+        out[p + "attn.c_attn.weight"] = np.concatenate(
+            [np.asarray(b["wq"]), np.asarray(b["wk"]), np.asarray(b["wv"])],
+            axis=1)
+        out[p + "attn.c_attn.bias"] = np.concatenate(
+            [np.asarray(b["bq"]), np.asarray(b["bk"]), np.asarray(b["bv"])])
+        out[p + "attn.c_proj.weight"] = np.asarray(b["wo"])
+        out[p + "attn.c_proj.bias"] = np.asarray(b["bo"])
+        out[p + "ln_1.weight"] = np.asarray(b["ln1_g"])
+        out[p + "ln_1.bias"] = np.asarray(b["ln1_b"])
+        out[p + "ln_2.weight"] = np.asarray(b["ln2_g"])
+        out[p + "ln_2.bias"] = np.asarray(b["ln2_b"])
+        out[p + "mlp.c_fc.weight"] = np.asarray(b["w1"])
+        out[p + "mlp.c_fc.bias"] = np.asarray(b["b1"])
+        out[p + "mlp.c_proj.weight"] = np.asarray(b["w2"])
+        out[p + "mlp.c_proj.bias"] = np.asarray(b["b2"])
+    return out
+
+
+def from_hf_llama(source, config=None,
+                  dtype: Any = jnp.float32,
+                  max_seq: Optional[int] = None
+                  ) -> Tuple[GPTConfig, Dict[str, Any]]:
+    """``LlamaForCausalLM`` (or state_dict + config) → (GPTConfig,
+    params). Also fits Llama-architecture descendants whose state_dict
+    uses the same key scheme AND whose head_dim is the standard
+    ``hidden_size / num_attention_heads`` (optional attention biases
+    are imported when present, zeros otherwise; an explicit decoupled
+    ``head_dim`` à la Mistral-Nemo is rejected at import).
+
+    ``max_seq`` (default: HF ``max_position_embeddings``) caps the
+    context window for cache sizing — with rope there is no position
+    table, so it is a pure bound, not a parameter shape."""
+    sd, hf = _state_and_config(source, config)
+    d = _cfgget(hf, "hidden_size")
+    n_heads = _cfgget(hf, "num_attention_heads")
+    tied = bool(_cfgget(hf, "tie_word_embeddings", False))
+    scaling = _cfgget(hf, "rope_scaling")
+    if scaling is not None:
+        raise NotImplementedError(
+            f"this checkpoint uses rope_scaling={scaling!r} (Llama-3.1-"
+            "style frequency remapping); importing it with plain "
+            "rope_theta would silently change the model's numerics — "
+            "scaled-rope import is not implemented")
+    explicit_hd = _cfgget(hf, "head_dim")
+    if explicit_hd is not None and explicit_hd != d // n_heads:
+        raise NotImplementedError(
+            f"checkpoint declares head_dim={explicit_hd} decoupled from "
+            f"hidden_size/num_attention_heads={d // n_heads} — the GPT "
+            "family derives head_dim from d_model/n_heads")
+    cfg = GPTConfig(
+        vocab_size=_cfgget(hf, "vocab_size"),
+        max_seq=(max_seq if max_seq is not None
+                 else _cfgget(hf, "max_position_embeddings")),
+        d_model=d,
+        n_heads=n_heads,
+        n_layers=_cfgget(hf, "num_hidden_layers"),
+        d_ff=_cfgget(hf, "intermediate_size"),
+        dtype=dtype,
+        pos_embedding="rope",
+        rope_base=float(_cfgget(hf, "rope_theta", 10000.0)),
+        n_kv_heads=_cfgget(hf, "num_key_value_heads", n_heads),
+        mlp="swiglu",
+        norm="rmsnorm",
+        norm_eps=float(_cfgget(hf, "rms_norm_eps", 1e-5)),
+        tied_readout=tied,
+        # bias-free (plain Llama): the tree carries NO bias leaves.
+        # Qwen-style checkpoints with projection biases get
+        # use_bias=True — decided from the state_dict itself (some HF
+        # config classes carry the biases unconditionally, without an
+        # attention_bias/mlp_bias field), applying absent slots as
+        # zeros.
+        use_bias=any(".bias" in k for k in sd
+                     if ".layers.0.self_attn." in k or ".layers.0.mlp." in k),
+    )
+    if d % n_heads != 0:
+        raise ValueError(f"hidden_size {d} not divisible by "
+                         f"num_attention_heads {n_heads}")
+
+    kv_hd = cfg.kv_heads * cfg.head_dim
+
+    def lin(block, ours, key, out_dim):
+        """nn.Linear weight (out, in) → (in, out); the bias leaf exists
+        only under use_bias=True (absent HF bias slots become zeros)."""
+        block["w" + ours] = _np(sd[key + ".weight"]).T
+        if cfg.use_bias:
+            block["b" + ours] = (
+                _np(sd[key + ".bias"]) if key + ".bias" in sd
+                else np.zeros((out_dim,), np.float32))
+
+    blocks = []
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        b: Dict[str, Any] = {
+            "ln1_g": _np(sd[p + "input_layernorm.weight"]),
+            "ln2_g": _np(sd[p + "post_attention_layernorm.weight"]),
+        }
+        lin(b, "q", p + "self_attn.q_proj", d)
+        lin(b, "k", p + "self_attn.k_proj", kv_hd)
+        lin(b, "v", p + "self_attn.v_proj", kv_hd)
+        lin(b, "o", p + "self_attn.o_proj", d)
+        lin(b, "1", p + "mlp.gate_proj", cfg.d_ff)   # silu path
+        lin(b, "3", p + "mlp.up_proj", cfg.d_ff)     # value path
+        lin(b, "2", p + "mlp.down_proj", d)
+        blocks.append(b)
+    params = {
+        # rope carries positions — no wpe leaf; rmsnorm — no bias leaves
+        "wte": _np(sd["model.embed_tokens.weight"]),
+        "lnf_g": _np(sd["model.norm.weight"]),
+        "blocks": blocks,
+    }
+    if not tied:
+        params["lm_head"] = _np(sd["lm_head.weight"]).T
+    return cfg, _to_jnp(params)
+
+
+def to_hf_llama(params: Dict[str, Any], cfg: GPTConfig) -> Dict[str, Any]:
+    """Our llama-shaped params → an HF ``LlamaForCausalLM`` state_dict
+    (numpy values, ``(out, in)`` Linear layout). Requires the bias-free
+    llama option set — a ``use_bias=True`` tree (Qwen-style) has bias
+    leaves plain ``LlamaForCausalLM`` offers no slots for."""
+    if cfg.norm != "rmsnorm" or cfg.mlp != "swiglu" \
+            or cfg.pos_embedding != "rope" or cfg.use_bias:
+        raise ValueError("to_hf_llama exports the llama option set "
+                         "(rmsnorm, swiglu, rope, bias-free); got "
+                         f"norm={cfg.norm!r} mlp={cfg.mlp!r} "
+                         f"pos={cfg.pos_embedding!r} "
+                         f"use_bias={cfg.use_bias}")
+    out: Dict[str, Any] = {
+        "model.embed_tokens.weight": np.asarray(params["wte"]),
+        "model.norm.weight": np.asarray(params["lnf_g"]),
+    }
+    if cfg.tied_readout:
+        out["lm_head.weight"] = np.asarray(params["wte"])
+    else:
+        out["lm_head.weight"] = np.asarray(params["lm_head"]).T
+    for i, b in enumerate(params["blocks"]):
+        p = f"model.layers.{i}."
+        for ours, theirs in (("wq", "self_attn.q_proj"),
+                             ("wk", "self_attn.k_proj"),
+                             ("wv", "self_attn.v_proj"),
+                             ("wo", "self_attn.o_proj"),
+                             ("w1", "mlp.gate_proj"),
+                             ("w3", "mlp.up_proj"),
+                             ("w2", "mlp.down_proj")):
+            out[p + theirs + ".weight"] = np.asarray(b[ours]).T
+        out[p + "input_layernorm.weight"] = np.asarray(b["ln1_g"])
+        out[p + "post_attention_layernorm.weight"] = np.asarray(b["ln2_g"])
+    return out
+
+
+def _to_jnp(tree):
+    import jax
+
+    return jax.tree_util.tree_map(jnp.asarray, tree)

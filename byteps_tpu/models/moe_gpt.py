@@ -22,7 +22,7 @@ from byteps_tpu.models.gpt import (
     GPTConfig,
     _attention,
     _embed,
-    _layernorm,
+    resolve_norm,
     _readout_nll,
     block_init,
     block_specs,
@@ -55,9 +55,10 @@ def moe_block_init(rng, cfg: MoEGPTConfig):
             "gated experts are future work")
     b = block_init(rng, cfg.d_model, cfg.d_ff,
                    cfg.n_heads * cfg.head_dim, cfg.n_layers,
-                   kv_hd=cfg.kv_heads * cfg.head_dim)
+                   kv_hd=cfg.kv_heads * cfg.head_dim,
+                   use_bias=cfg.use_bias, norm=cfg.norm)
     for k in ("w1", "b1", "w2", "b2"):
-        del b[k]
+        b.pop(k, None)   # bias keys absent under use_bias=False
     b["moe"] = moe_init(jax.random.fold_in(rng, 99), cfg.d_model,
                         cfg.d_ff, cfg.n_experts)
     return b
@@ -69,21 +70,24 @@ def moe_gpt_init(rng, cfg: MoEGPTConfig) -> Dict[str, Any]:
     return {
         "wte": jax.random.normal(keys[0], (cfg.vocab_size, d),
                                  jnp.float32) * 0.02,
-        "wpe": jax.random.normal(keys[1], (cfg.max_seq, d),
-                                 jnp.float32) * 0.02,
         "lnf_g": jnp.ones((d,), jnp.float32),
-        "lnf_b": jnp.zeros((d,), jnp.float32),
+        **({"wpe": jax.random.normal(keys[1], (cfg.max_seq, d),
+                                     jnp.float32) * 0.02}
+           if cfg.pos_embedding == "learned" else {}),
+        **({"lnf_b": jnp.zeros((d,), jnp.float32)}
+           if cfg.norm == "layernorm" else {}),
         "blocks": [moe_block_init(keys[2 + li], cfg)
                    for li in range(cfg.n_layers)],
     }
 
 
-def moe_block_specs(ep_axis: Optional[str], tp_axis: Optional[str] = None):
+def moe_block_specs(ep_axis: Optional[str], tp_axis: Optional[str] = None,
+                    use_bias: bool = True, norm: str = "layernorm"):
     # derive from the dense family's specs exactly like moe_block_init
     # derives from block_init, so new attention params cannot diverge
-    s = block_specs(tp_axis)
+    s = block_specs(tp_axis, use_bias=use_bias, norm=norm)
     for k in ("w1", "b1", "w2", "b2"):
-        del s[k]
+        s.pop(k, None)
     s["moe"] = moe_specs(ep_axis, tp_axis)
     return s
 
@@ -91,8 +95,11 @@ def moe_block_specs(ep_axis: Optional[str], tp_axis: Optional[str] = None):
 def moe_gpt_param_specs(cfg: MoEGPTConfig, ep_axis: Optional[str],
                         tp_axis: Optional[str] = None) -> Dict[str, Any]:
     return {
-        "wte": P(), "wpe": P(), "lnf_g": P(), "lnf_b": P(),
-        "blocks": [moe_block_specs(ep_axis, tp_axis)
+        "wte": P(), "lnf_g": P(),
+        **({"wpe": P()} if cfg.pos_embedding == "learned" else {}),
+        **({"lnf_b": P()} if cfg.norm == "layernorm" else {}),
+        "blocks": [moe_block_specs(ep_axis, tp_axis,
+                                   use_bias=cfg.use_bias, norm=cfg.norm)
                    for _ in range(cfg.n_layers)],
     }
 
@@ -103,10 +110,12 @@ def moe_transformer_block(x, p, cfg: MoEGPTConfig,
                           sp_axis: Optional[str] = None,
                           seq_layout: str = "contiguous"):
     """Pre-LN attention + MoE FFN; returns (x, aux_loss)."""
-    x = x + _attention(_layernorm(x, p["ln1_g"], p["ln1_b"]), p,
+    norm_fn, norm_eps = resolve_norm(cfg)
+    x = x + _attention(norm_fn(x, p["ln1_g"], p.get("ln1_b"), norm_eps), p,
                        cfg.head_dim, tp_axis, sp_axis, causal=True,
-                       seq_layout=seq_layout, rope_base=resolve_rope(cfg))
-    m, aux = moe_ffn(_layernorm(x, p["ln2_g"], p["ln2_b"]), p["moe"],
+                       seq_layout=seq_layout, rope_base=resolve_rope(cfg),
+                       use_bias=cfg.use_bias)
+    m, aux = moe_ffn(norm_fn(x, p["ln2_g"], p.get("ln2_b"), norm_eps), p["moe"],
                      cfg.capacity_factor, ep_axis,
                      router_topk=cfg.router_topk, tp_axis=tp_axis)
     return x + m, aux
@@ -132,7 +141,7 @@ def moe_gpt_loss(params, tokens, targets, cfg: MoEGPTConfig,
     for p in params["blocks"]:
         x, aux = apply_block(x, p)
         aux_total = aux_total + aux
-    nll = _readout_nll(params, x, targets)
+    nll = _readout_nll(params, x, targets, *resolve_norm(cfg))
     loss = nll.mean() + cfg.aux_coef * aux_total / cfg.n_layers
     if sp_axis is not None:
         loss = jax.lax.pmean(loss, sp_axis)
@@ -170,7 +179,7 @@ def moe_gpt_pp_loss(params, tokens, targets, cfg: MoEGPTConfig,
         remat=remat, vma_axes=vma_axes, has_aux=True,
     )
     y = y_mb.reshape(B, S_loc, -1)
-    nll = _readout_nll(params, y, targets).mean()
+    nll = _readout_nll(params, y, targets, *resolve_norm(cfg)).mean()
     stage = jax.lax.axis_index(pp_axis)
     nstages = jax.lax.axis_size(pp_axis)
     masked_nll = jnp.where(stage == nstages - 1, nll, 0.0)

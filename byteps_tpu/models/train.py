@@ -87,6 +87,37 @@ def _check_seq_layout(seq_layout, sp=None):
             "this mesh the permuted inputs would just be scrambled tokens")
 
 
+def _resolve_init_params(init_params, cfg, pspecs):
+    """Fresh :func:`gpt_init` weights, or the caller's ``init_params``
+    (e.g. imported via ``models.import_hf``) validated — tree structure
+    AND leaf shapes — against what the config would initialize, so a
+    config/weights mismatch fails here instead of as a shape error deep
+    inside the jitted step."""
+    if init_params is None:
+        return gpt_init(jax.random.PRNGKey(0), cfg)
+    want = jax.tree_util.tree_structure(pspecs)
+    got = jax.tree_util.tree_structure(init_params)
+    if want != got:
+        raise ValueError(
+            "init_params tree structure does not match the config's "
+            f"parameter tree:\n  config expects {want}\n  got {got}")
+    expect = jax.eval_shape(
+        lambda: gpt_init(jax.random.PRNGKey(0), cfg))
+    bad = []
+
+    def _cmp(path, e, g):
+        if tuple(e.shape) != tuple(jnp.shape(g)):
+            bad.append(f"  {jax.tree_util.keystr(path)}: config expects "
+                       f"{tuple(e.shape)}, got {tuple(jnp.shape(g))}")
+
+    jax.tree_util.tree_map_with_path(_cmp, expect, init_params)
+    if bad:
+        raise ValueError(
+            "init_params leaf shapes do not match the config:\n"
+            + "\n".join(bad))
+    return init_params
+
+
 def _novma_collective_fix(grads, pspecs, mesh, rep_axes, extra_sum_axes=()):
     """Correct check_vma=False gradients for in-forward collective axes.
 
@@ -423,9 +454,13 @@ def make_gpt_train_step(
     zero_1: bool = False,
     accum_steps: int = 1,
     seq_layout: str = "contiguous",
+    init_params: Optional[Dict[str, Any]] = None,
 ):
     """Returns ``(step, params, opt_state, batch_sharding)``.
 
+    ``init_params`` (structure of :func:`gpt_init`) starts training from
+    existing weights — e.g. a checkpoint imported with
+    ``models.import_hf`` — instead of a fresh initialization.
     ``step(params, opt_state, tokens, targets) -> (loss, params, opt_state)``
     is jitted over ``mesh``; tokens/targets are global (B, S) arrays
     sharded (dp, sp) by ``batch_sharding``. ``remat=True`` rematerializes
@@ -449,7 +484,7 @@ def make_gpt_train_step(
     _check_seq_layout(seq_layout, sp)
     use_vma = compression_params is None and not zero_1
     pspecs = gpt_param_specs(cfg, tp)
-    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    params = _resolve_init_params(init_params, cfg, pspecs)
     state_axes, tx_kw, zero_numel = _dist_state_setup(
         mesh, params, pspecs, dp, zero_1)
     params, opt_state, ospecs = _shard_params_state(
@@ -515,8 +550,12 @@ def make_gpt_pp_train_step(
     remat: bool = False,
     zero_1: bool = False,
     seq_layout: str = "contiguous",
+    init_params: Optional[Dict[str, Any]] = None,
 ):
     """Pipeline-parallel GPT train step over a (pp, dp[, tp][, sp]) mesh.
+
+    ``init_params`` takes UNSTACKED weights (the :func:`gpt_init` /
+    ``import_hf`` structure) and stacks them into the pipeline slab here.
 
     Transformer blocks are stacked on a leading layer axis and sharded
     ``P('pp')`` — each stage owns n_layers/pp contiguous layers and its
@@ -555,16 +594,15 @@ def make_gpt_pp_train_step(
         raise ValueError(
             f"n_layers={cfg.n_layers} not divisible by pp={nstages}"
         )
-    raw = gpt_init(jax.random.PRNGKey(0), cfg)
-    params = {
-        "wte": raw["wte"], "wpe": raw["wpe"],
-        "lnf_g": raw["lnf_g"], "lnf_b": raw["lnf_b"],
-        "blocks": stack_blocks(raw["blocks"]),
-    }
-    pspecs = {
-        "wte": P(), "wpe": P(), "lnf_g": P(), "lnf_b": P(),
-        "blocks": stacked_specs(block_specs(tp, cfg.mlp), pp),
-    }
+    raw = _resolve_init_params(init_params, cfg, gpt_param_specs(cfg, tp))
+    # pp-replicated leaves follow the config's tree (wpe only under
+    # learned positions, lnf_b only under layernorm, lm_head only
+    # untied); the blocks become the stacked stage slab
+    params = {k: v for k, v in raw.items() if k != "blocks"}
+    params["blocks"] = stack_blocks(raw["blocks"])
+    pspecs = {k: P() for k in params if k != "blocks"}
+    pspecs["blocks"] = stacked_specs(
+        block_specs(tp, cfg.mlp, use_bias=cfg.use_bias, norm=cfg.norm), pp)
     state_axes, tx_kw, zero_numel = _dist_state_setup(
         mesh, params, pspecs, dp, zero_1)
     params, opt_state, ospecs = _shard_params_state(
@@ -762,15 +800,11 @@ def make_gpt_moe_pp_train_step(
             f"n_experts={cfg.n_experts} not divisible by ep={ep_size}"
         )
     raw = moe_gpt_init(jax.random.PRNGKey(0), cfg)
-    params = {
-        "wte": raw["wte"], "wpe": raw["wpe"],
-        "lnf_g": raw["lnf_g"], "lnf_b": raw["lnf_b"],
-        "blocks": stack_blocks(raw["blocks"]),
-    }
-    pspecs = {
-        "wte": P(), "wpe": P(), "lnf_g": P(), "lnf_b": P(),
-        "blocks": stacked_specs(moe_block_specs(ep, tp), pp),
-    }
+    params = {k: v for k, v in raw.items() if k != "blocks"}
+    params["blocks"] = stack_blocks(raw["blocks"])
+    pspecs = {k: P() for k in params if k != "blocks"}
+    pspecs["blocks"] = stacked_specs(
+        moe_block_specs(ep, tp, use_bias=cfg.use_bias, norm=cfg.norm), pp)
     state_axes, tx_kw, zero_numel = _dist_state_setup(
         mesh, params, pspecs, dp, zero_1)
     params, opt_state, ospecs = _shard_params_state(

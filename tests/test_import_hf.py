@@ -1,0 +1,271 @@
+"""HF checkpoint bridge: logits parity against transformers' own torch
+forward, export round-trips through ``load_state_dict(strict=True)``,
+decode parity through our KV-cache sampler, and train-from-imported-
+weights smoke (the reference-user switching path, SURVEY §2.4)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import optax
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from byteps_tpu.models.gpt import GPTConfig, gpt_forward, gpt_init  # noqa: E402
+from byteps_tpu.models.import_hf import (  # noqa: E402
+    from_hf_gpt2,
+    from_hf_llama,
+    to_hf_gpt2,
+    to_hf_llama,
+)
+
+B, S = 2, 16
+
+
+def _tiny_gpt2_model():
+    cfg = transformers.GPT2Config(
+        vocab_size=256, n_positions=64, n_embd=64, n_layer=2, n_head=4,
+        attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0)
+    torch.manual_seed(0)
+    return transformers.GPT2LMHeadModel(cfg).eval()
+
+
+def _tiny_llama_model(**kw):
+    cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, rope_theta=10000.0,
+        attention_dropout=0.0, **kw)
+    torch.manual_seed(1)
+    return transformers.LlamaForCausalLM(cfg).eval()
+
+
+def _hf_logits(model, tokens: np.ndarray) -> np.ndarray:
+    with torch.no_grad():
+        return model(torch.from_numpy(tokens)).logits.float().numpy()
+
+
+def _tokens(vocab: int, seed: int = 0) -> np.ndarray:
+    return np.random.RandomState(seed).randint(0, vocab, (B, S)).astype(
+        np.int64)
+
+
+def test_gpt2_logits_parity():
+    model = _tiny_gpt2_model()
+    cfg, params = from_hf_gpt2(model)
+    assert cfg.tied_readout and cfg.norm == "layernorm" and cfg.mlp == "gelu"
+    toks = _tokens(cfg.vocab_size)
+    ours = np.asarray(gpt_forward(params, jnp.asarray(toks), cfg))
+    theirs = _hf_logits(model, toks)
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=1e-4)
+
+
+def test_gpt2_export_round_trip():
+    model = _tiny_gpt2_model()
+    cfg, params = from_hf_gpt2(model)
+    sd = {k: torch.as_tensor(v) for k, v in to_hf_gpt2(params, cfg).items()}
+    fresh = transformers.GPT2LMHeadModel(model.config).eval()
+    # transformer.wte.weight / lm_head.weight are tied inside HF; both
+    # keys are present in the export, strict load accepts the pair
+    missing, unexpected = fresh.load_state_dict(sd, strict=False)
+    assert not unexpected
+    assert all("attn.bias" in k or "masked_bias" in k for k in missing), \
+        missing  # only HF's non-persistent causal-mask buffers may be absent
+    toks = _tokens(cfg.vocab_size, seed=3)
+    np.testing.assert_allclose(
+        np.asarray(gpt_forward(params, jnp.asarray(toks), cfg)),
+        _hf_logits(fresh, toks), atol=2e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("tied", [False, True])
+def test_llama_logits_parity(tied):
+    model = _tiny_llama_model(tie_word_embeddings=tied)
+    cfg, params = from_hf_llama(model)
+    assert cfg.norm == "rmsnorm" and cfg.mlp == "swiglu"
+    assert cfg.pos_embedding == "rope" and cfg.n_kv_heads == 2
+    assert cfg.tied_readout == tied
+    assert ("lm_head" in params) == (not tied)
+    toks = _tokens(cfg.vocab_size, seed=1)
+    ours = np.asarray(gpt_forward(params, jnp.asarray(toks), cfg))
+    theirs = _hf_logits(model, toks)
+    np.testing.assert_allclose(ours, theirs, atol=3e-4, rtol=1e-4)
+
+
+def test_llama_export_round_trip():
+    model = _tiny_llama_model()
+    cfg, params = from_hf_llama(model)
+    sd = {k: torch.as_tensor(v) for k, v in to_hf_llama(params, cfg).items()}
+    fresh = transformers.LlamaForCausalLM(model.config).eval()
+    missing, unexpected = fresh.load_state_dict(sd, strict=False)
+    assert not missing and not unexpected
+    toks = _tokens(cfg.vocab_size, seed=4)
+    np.testing.assert_allclose(
+        np.asarray(gpt_forward(params, jnp.asarray(toks), cfg)),
+        _hf_logits(fresh, toks), atol=3e-4, rtol=1e-4)
+
+
+def test_llama_export_rejects_biased_tree():
+    """A use_bias=True (Qwen-style) tree has bias leaves plain
+    LlamaForCausalLM offers no slots for — export must refuse."""
+    model = _tiny_llama_model()
+    cfg, params = from_hf_llama(model)
+    cfg_biased = dataclasses.replace(cfg, use_bias=True)
+    with pytest.raises(ValueError, match="use_bias"):
+        to_hf_llama(params, cfg_biased)
+
+
+def test_llama_greedy_decode_matches_hf_generate():
+    """End to end through OUR KV-cache sampler (rmsnorm + rope + GQA +
+    swiglu + untied readout on the decode path) vs HF greedy generate."""
+    from byteps_tpu.models.generate import make_generate_fn
+
+    model = _tiny_llama_model()
+    cfg, params = from_hf_llama(model)
+    prompt = _tokens(cfg.vocab_size, seed=7)[:, :8]
+    n_new = 6
+    with torch.no_grad():
+        hf_out = model.generate(
+            torch.from_numpy(prompt), max_new_tokens=n_new, do_sample=False,
+            pad_token_id=0).numpy()
+    gen = make_generate_fn(cfg, max_new=n_new)
+    ours = np.asarray(gen(params, jnp.asarray(prompt),
+                          jax.random.PRNGKey(0), temperature=0.0))
+    np.testing.assert_array_equal(ours[:, prompt.shape[1]:],
+                                  hf_out[:, prompt.shape[1]:])
+
+
+def test_train_step_from_imported_weights(mesh8):
+    """make_gpt_train_step(init_params=imported) — the switching path:
+    bring an HF checkpoint, train it under the framework's dp
+    aggregation; the first loss must equal the imported model's own
+    next-token loss (weights actually used, not re-initialized)."""
+    from byteps_tpu.models.train import make_gpt_train_step
+
+    model = _tiny_llama_model()
+    cfg, params = from_hf_llama(model)
+    step, p, o, bs = make_gpt_train_step(
+        cfg, mesh8, optax.adamw(1e-3), init_params=params)
+    toks = np.random.RandomState(9).randint(0, cfg.vocab_size, (8, S))
+    tgts = np.roll(toks, -1, axis=1)
+    # reference loss BEFORE stepping — the jitted step donates its param
+    # buffers, so `params` leaves are consumed by the step call
+    ref = np.asarray(gpt_forward(params, jnp.asarray(toks), cfg))
+    logp = jax.nn.log_softmax(jnp.asarray(ref), axis=-1)
+    want = float(-jnp.take_along_axis(
+        logp, jnp.asarray(tgts)[..., None], axis=-1).mean())
+
+    loss, p, o = step(p, o, jnp.asarray(toks), jnp.asarray(tgts))
+    assert np.isfinite(float(loss))
+    np.testing.assert_allclose(float(loss), want, rtol=1e-5)
+
+
+def test_llama_rejects_rope_scaling_and_decoupled_head_dim():
+    model = _tiny_llama_model()
+    sd = model.state_dict()
+    base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=64)
+    with pytest.raises(NotImplementedError, match="rope_scaling"):
+        from_hf_llama(sd, config={**base, "rope_scaling":
+                                  {"rope_type": "llama3", "factor": 8.0}})
+    with pytest.raises(NotImplementedError, match="head_dim"):
+        from_hf_llama(sd, config={**base, "head_dim": 32})
+
+
+def test_llama_tree_is_lean_and_max_seq_overrides():
+    """The imported tree carries ONLY leaves the checkpoint trains: no
+    wpe under rope, no norm/projection biases under rmsnorm/bias-free —
+    absent leaves can't drift under lossy gradient compression."""
+    model = _tiny_llama_model()
+    cfg, params = from_hf_llama(model, max_seq=16)
+    assert cfg.max_seq == 16 and cfg.use_bias is False
+    assert "wpe" not in params and "lnf_b" not in params
+    b0 = params["blocks"][0]
+    assert "bq" not in b0 and "b1" not in b0 and "ln1_b" not in b0
+    toks = _tokens(cfg.vocab_size, seed=2)  # S=16 fits exactly
+    np.testing.assert_allclose(
+        np.asarray(gpt_forward(params, jnp.asarray(toks), cfg)),
+        _hf_logits(model, toks), atol=3e-4, rtol=1e-4)
+
+
+def test_moe_rmsnorm_train_decode_consistent():
+    """cfg.norm threads through the MoE train path AND the shared decode
+    path — prefill logits through gpt_apply_cached must match what the
+    MoE training loss sees (guards the silent train/decode numerics
+    split the review flagged)."""
+    from byteps_tpu.models.generate import gpt_apply_cached, init_cache
+    from byteps_tpu.models.moe_gpt import (
+        MoEGPTConfig, moe_gpt_init, moe_gpt_loss)
+
+    cfg = dataclasses.replace(MoEGPTConfig.tiny(), norm="rmsnorm",
+                              norm_eps=1e-6)
+    params = moe_gpt_init(jax.random.PRNGKey(2), cfg)
+    toks = np.random.RandomState(5).randint(0, cfg.vocab_size, (2, 16))
+    tgts = np.roll(toks, -1, axis=1)
+
+    cache = init_cache(cfg, 2)
+    logits, _ = gpt_apply_cached(params, jnp.asarray(toks), cache, cfg)
+    logp = jax.nn.log_softmax(jnp.asarray(logits), axis=-1)
+    nll = float(-jnp.take_along_axis(
+        logp, jnp.asarray(tgts)[..., None], axis=-1).mean())
+
+    loss = float(moe_gpt_loss(params, jnp.asarray(toks),
+                              jnp.asarray(tgts), cfg))
+    # training loss = nll + aux; decode-path nll must account for all of
+    # the non-aux part (rmsnorm applied identically on both paths)
+    aux = loss - nll
+    assert 0.0 <= aux < 1.0, (loss, nll)
+    # structural: the rmsnorm tree carries no norm-bias leaves, the
+    # layernorm tree does — the config and the tree cannot disagree
+    assert "ln1_b" not in params["blocks"][0]
+    assert "lnf_b" not in params
+    params_ln = moe_gpt_init(jax.random.PRNGKey(2), MoEGPTConfig.tiny())
+    assert "ln1_b" in params_ln["blocks"][0] and "lnf_b" in params_ln
+
+
+def test_gpt2_rejects_unsupported_variants():
+    model = _tiny_gpt2_model()
+    sd = model.state_dict()
+    base = dict(vocab_size=256, n_positions=64, n_embd=64, n_layer=2,
+                n_head=4)
+    with pytest.raises(NotImplementedError, match="activation"):
+        from_hf_gpt2(sd, config={**base, "activation_function": "gelu"})
+    with pytest.raises(NotImplementedError, match="scale_attn"):
+        from_hf_gpt2(sd, config={
+            **base, "scale_attn_by_inverse_layer_idx": True})
+
+
+def test_export_guard_names_option_set():
+    cfg = GPTConfig(vocab_size=256, max_seq=64, d_model=64, n_heads=4,
+                    n_layers=1, d_ff=128, use_bias=False)
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError, match="use_bias"):
+        to_hf_gpt2(params, cfg)
+
+
+def test_init_params_structure_mismatch_raises(mesh8):
+    from byteps_tpu.models.train import make_gpt_train_step
+
+    cfg = GPTConfig.tiny()
+    bad = gpt_init(jax.random.PRNGKey(0), cfg)
+    del bad["wpe"]
+    with pytest.raises(ValueError, match="tree structure"):
+        make_gpt_train_step(cfg, mesh8, optax.adamw(1e-3), init_params=bad)
+
+
+def test_init_params_shape_mismatch_raises(mesh8):
+    """Same tree structure, wrong leaf shapes (config/weights size
+    mismatch) must fail in the factory, not deep inside jit."""
+    from byteps_tpu.models.train import make_gpt_train_step
+
+    cfg = GPTConfig.tiny()
+    wrong = gpt_init(jax.random.PRNGKey(0),
+                     dataclasses.replace(cfg, d_model=32, n_heads=2))
+    with pytest.raises(ValueError, match="leaf shapes"):
+        make_gpt_train_step(cfg, mesh8, optax.adamw(1e-3),
+                            init_params=wrong)

@@ -31,9 +31,11 @@ def setup():
 
 def test_rope_changes_logits_vs_learned(setup):
     params, tokens = setup
+    assert "wpe" not in params   # rope trees carry no position table
     rope = gpt_forward(params, tokens, CFG)
-    learned = gpt_forward(params, tokens,
-                          dataclasses.replace(CFG, pos_embedding="learned"))
+    cfg_learned = dataclasses.replace(CFG, pos_embedding="learned")
+    params_learned = gpt_init(jax.random.PRNGKey(0), cfg_learned)
+    learned = gpt_forward(params_learned, tokens, cfg_learned)
     assert not np.allclose(np.asarray(rope), np.asarray(learned))
 
 
