@@ -232,10 +232,16 @@ def _bias(p, name, x, use_bias: bool):
 def _attention(x, p, head_dim: int, tp_axis, sp_axis, causal: bool = True,
                seq_layout: str = "contiguous", rope_base: float = 0.0,
                use_bias: bool = True):
+    from byteps_tpu.models.lora import lora_delta
+
     B, S = x.shape[:2]
     q = col_parallel_matmul(x, p["wq"].astype(x.dtype), _bias(p, "bq", x, use_bias))
     k = col_parallel_matmul(x, p["wk"].astype(x.dtype), _bias(p, "bk", x, use_bias))
     v = col_parallel_matmul(x, p["wv"].astype(x.dtype), _bias(p, "bv", x, use_bias))
+    if "lora" in p:
+        q = q + lora_delta(x, p, "wq")
+        k = k + lora_delta(x, p, "wk")
+        v = v + lora_delta(x, p, "wv")
     h_loc = q.shape[-1] // head_dim     # query heads this tp shard owns
     kv_loc = k.shape[-1] // head_dim    # kv heads (GQA: fewer)
     if kv_loc == 0 or h_loc % kv_loc != 0:
@@ -262,23 +268,35 @@ def _attention(x, p, head_dim: int, tp_axis, sp_axis, causal: bool = True,
         raise ValueError(f"unknown seq_layout {seq_layout!r} — expected "
                          "'contiguous' or 'zigzag'")
     o = o.reshape(B, S, h_loc * head_dim)
-    return row_parallel_matmul(o, p["wo"].astype(x.dtype), tp_axis,
-                               _bias(p, "bo", x, use_bias))
+    out = row_parallel_matmul(o, p["wo"].astype(x.dtype), tp_axis,
+                              _bias(p, "bo", x, use_bias))
+    if "lora" in p:
+        out = out + lora_delta(o, p, "wo", tp_axis)
+    return out
 
 
 def _mlp(x, p, tp_axis, use_bias: bool = True):
+    from byteps_tpu.models.lora import lora_delta
+
     h = col_parallel_matmul(x, p["w1"].astype(x.dtype),
                             _bias(p, "b1", x, use_bias))
+    if "lora" in p:
+        h = h + lora_delta(x, p, "w1")
     if "w3" in p:
         # SwiGLU: silu-gated hidden (w1 value path ∘ w3 gate path); w1/w3
         # col-parallel over tp, w2 row-parallel as in the gelu MLP
         g = col_parallel_matmul(x, p["w3"].astype(x.dtype),
                                 _bias(p, "b3", x, use_bias))
+        if "lora" in p:
+            g = g + lora_delta(x, p, "w3")
         h = jax.nn.silu(h) * g
     else:
         h = jax.nn.gelu(h)
-    return row_parallel_matmul(h, p["w2"].astype(x.dtype), tp_axis,
-                               _bias(p, "b2", x, use_bias))
+    out = row_parallel_matmul(h, p["w2"].astype(x.dtype), tp_axis,
+                              _bias(p, "b2", x, use_bias))
+    if "lora" in p:
+        out = out + lora_delta(h, p, "w2", tp_axis)
+    return out
 
 
 def transformer_block(x, p, head_dim: int, tp_axis=None, sp_axis=None,

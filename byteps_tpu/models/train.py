@@ -540,6 +540,128 @@ def make_gpt_train_step(
     )
 
 
+def make_gpt_lora_train_step(
+    cfg: GPTConfig,
+    mesh: Mesh,
+    base_tx: optax.GradientTransformation,
+    rank: int = 8,
+    alpha: float = 16.0,
+    targets: Tuple[str, ...] = ("wq", "wv"),
+    base_params: Optional[Dict[str, Any]] = None,
+    init_adapters: Optional[Dict[str, Any]] = None,
+    rng: Optional[jax.Array] = None,
+    compression_params: Optional[Dict[str, Any]] = None,
+    partition_bytes: Optional[int] = None,
+    remat: bool = False,
+    accum_steps: int = 1,
+    seq_layout: str = "contiguous",
+):
+    """LoRA fine-tuning step over a (dp[, tp][, sp]) mesh: the frozen
+    base never moves and ONLY the adapter gradients ride the dp
+    aggregation tier (compressed or not) — rank/d_model the gradient
+    traffic of full fine-tuning per targeted projection.
+
+    ``base_params`` (default: fresh init) is typically an imported
+    checkpoint (``models.import_hf``); ``init_adapters`` resumes from
+    saved adapters and ``rng`` seeds a fresh adapter init (multi-seed
+    sweeps). Returns ``(step, adapters,
+    opt_state, base, batch_sharding)`` with
+    ``step(adapters, opt_state, base, tokens, targets) ->
+    (loss, adapters, opt_state)`` — the base is an explicit input
+    (replicated over dp/sp, tp-sharded like the dense factory), never
+    donated, never updated. ``b`` adapters start at zero, so step 0
+    computes exactly the frozen model's loss. Merge for inference or
+    export with :func:`byteps_tpu.models.lora.merge_lora`
+    (``scale = alpha / rank``).
+
+    Under tp, column-parallel targets add NO collective (``a``
+    replicated, ``b`` column-sharded); row-parallel targets psum a thin
+    ``(B, S, rank)`` intermediate. ``compression_params`` composes the
+    same way as the dense factory (no-VMA explicit psums over tp/sp on
+    the adapter grads).
+    """
+    from byteps_tpu.models.lora import (
+        graft_lora, lora_init, lora_param_specs)
+
+    dp, tp, sp = _axis(mesh, "dp"), _axis(mesh, "tp"), _axis(mesh, "sp")
+    _check_seq_layout(seq_layout, sp)
+    use_vma = compression_params is None
+    scale = alpha / rank
+
+    base_specs = gpt_param_specs(cfg, tp)
+    base = _resolve_init_params(base_params, cfg, base_specs)
+    base = jax.device_put(
+        base, jax.tree.map(lambda s: NamedSharding(mesh, s), base_specs,
+                           is_leaf=lambda x: isinstance(x, P)))
+
+    aspecs = lora_param_specs(cfg, tp, rank, targets)
+    if init_adapters is not None:
+        adapters = init_adapters
+        want = jax.tree_util.tree_structure(aspecs)
+        got = jax.tree_util.tree_structure(adapters)
+        if want != got:
+            raise ValueError(
+                "init_adapters tree structure does not match "
+                f"(rank/targets/n_layers?):\n  expects {want}\n  got {got}")
+    else:
+        adapters = lora_init(rng if rng is not None
+                             else jax.random.PRNGKey(1), cfg, rank, targets)
+    # EF/momentum compressor state must be sized/sharded for THIS mesh
+    # (per-device grads are tp-local shards) — same bookkeeping as the
+    # dense factory
+    state_axes, tx_kw, _ = _dist_state_setup(mesh, adapters, aspecs, dp,
+                                             False)
+    adapters, opt_state, ospecs = _shard_params_state(
+        mesh,
+        _make_tx(mesh, base_tx, compression_params, partition_bytes, dp,
+                 **tx_kw),
+        adapters, aspecs, dp, state_axes=state_axes,
+    )
+    batch_spec = P(dp, sp)
+    resym = _make_resymmetrize(aspecs, dp)
+
+    def loss_fn(adapters, base, tokens, targets_):
+        grafted = graft_lora(base, adapters, scale)
+        return gpt_loss(grafted, tokens, targets_, cfg, dp_axis=None,
+                        tp_axis=tp, sp_axis=sp, remat=remat,
+                        seq_layout=seq_layout)
+
+    def build_jit(pb):
+        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, **tx_kw)
+
+        def per_device_step(adapters, opt_state, base, tokens, targets_):
+            # base rides the closure: the accumulator microbatches every
+            # positional batch arg, and the frozen base is not a batch
+            vag = _accumulating_value_and_grad(
+                lambda a, tok, tgt: loss_fn(a, base, tok, tgt),
+                accum_steps)
+            grad_adapters = _pcast_dp(adapters, dp, mesh, use_vma)
+            loss, grads = vag(grad_adapters, tokens, targets_)
+            if use_vma:
+                grads = resym(grads)
+            else:
+                grads = _novma_collective_fix(grads, aspecs, mesh, (tp, sp))
+            updates, opt_state = tx.update(grads, opt_state, adapters)
+            adapters = optax.apply_updates(adapters, updates)
+            if dp is not None:
+                loss = jax.lax.pmean(loss, dp)
+            return _collapse_vma(loss), adapters, opt_state
+
+        sharded = jax.shard_map(
+            per_device_step,
+            mesh=mesh,
+            in_specs=(aspecs, ospecs, base_specs, batch_spec, batch_spec),
+            out_specs=(P(), aspecs, ospecs),
+            check_vma=use_vma,
+        )
+        return jax.jit(sharded, donate_argnums=(0, 1))
+
+    return (
+        _finalize_step(build_jit, partition_bytes, dp),
+        adapters, opt_state, base, NamedSharding(mesh, batch_spec),
+    )
+
+
 def make_gpt_pp_train_step(
     cfg: GPTConfig,
     mesh: Mesh,

@@ -168,6 +168,28 @@ def test_dp_tp_sp_topk_full_matches_uncompressed(names):
 
 
 @pytest.mark.slow
+def test_llama_options_dp_tp_topk_full_matches_uncompressed():
+    """The lean llama tree (no wpe / norm-bias / projection-bias leaves
+    — the leaves lossy compression must never see) through compressed
+    dp aggregation with tp in-forward collectives."""
+    from byteps_tpu.models.train import make_gpt_train_step
+
+    lcfg = GPTConfig.llama(vocab_size=256, max_seq=64, d_model=64,
+                           n_heads=4, n_kv_heads=2, n_layers=2, d_ff=128)
+    tokens, targets = synthetic_batch(jax.random.PRNGKey(11), lcfg, 8, 32)
+    mesh = _mesh((2, 2), ("dp", "tp"))
+
+    def build(**kw):
+        return make_gpt_train_step(lcfg, mesh, optax.adam(1e-2), **kw)
+
+    base, _ = _run(*build(), tokens, targets)
+    comp, _ = _run(*build(
+        compression_params={"compressor": "topk", "k": 1.0}),
+        tokens, targets)
+    np.testing.assert_allclose(comp, base, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
 def test_dp_tp_sp_combined_topk_full_matches_uncompressed():
     tokens, targets = synthetic_batch(jax.random.PRNGKey(6), CFG, 8, 32)
     mesh = _mesh((2, 2, 2), ("dp", "tp", "sp"))

@@ -152,6 +152,47 @@ def test_gpt_pp_matches_dp_only_training():
 
 
 @pytest.mark.slow
+def test_gpt_pp_llama_options_match_dp_only_training():
+    """The llama option set (rope + GQA + SwiGLU + RMSNorm + untied
+    readout, lean param tree) through the (pp=2, dp=2) pipeline tracks
+    dp-only training step-for-step — the new config axes ride the
+    pipeline restructure (conditional leaves, stacked slabs) unchanged."""
+    from byteps_tpu.models import GPTConfig
+    from byteps_tpu.models.train import (
+        make_gpt_pp_train_step,
+        make_gpt_train_step,
+        synthetic_batch,
+    )
+
+    cfg = GPTConfig.llama(vocab_size=256, max_seq=64, d_model=64,
+                          n_heads=4, n_kv_heads=2, n_layers=2, d_ff=128)
+    B, S = 8, 32
+    tokens, targets = synthetic_batch(jax.random.PRNGKey(17), cfg, B, S)
+
+    mesh_pp = _mesh((2, 2), ("pp", "dp"))
+    step_pp, params_pp, opt_pp, bsh_pp = make_gpt_pp_train_step(
+        cfg, mesh_pp, optax.adamw(1e-3), n_micro=2
+    )
+    assert "wpe" not in params_pp and "lnf_b" not in params_pp
+    assert "lm_head" in params_pp
+    mesh_dp = _mesh((4,), ("dp",))
+    step_dp, params_dp, opt_dp, bsh_dp = make_gpt_train_step(
+        cfg, mesh_dp, optax.adamw(1e-3)
+    )
+
+    t_pp = jax.device_put(tokens, bsh_pp)
+    g_pp = jax.device_put(targets, bsh_pp)
+    t_dp = jax.device_put(tokens, bsh_dp)
+    g_dp = jax.device_put(targets, bsh_dp)
+    for _ in range(3):
+        l_pp, params_pp, opt_pp = step_pp(params_pp, opt_pp, t_pp, g_pp)
+        l_dp, params_dp, opt_dp = step_dp(params_dp, opt_dp, t_dp, g_dp)
+        np.testing.assert_allclose(float(l_pp), float(l_dp),
+                                   rtol=2e-4, atol=2e-4)
+    assert np.isfinite(float(l_pp))
+
+
+@pytest.mark.slow
 def test_gpt_pp_tp_matches_dp_only_training():
     """(pp=2, dp=2, tp=2) — Megatron tp inside pipeline stages — still
     tracks dp-only training step-for-step: tp is a layout choice, VMA
