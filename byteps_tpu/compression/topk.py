@@ -4,6 +4,27 @@ Keeps the k coordinates of largest magnitude; wire format = (index, value)
 pairs, matching the reference. ``k`` may be an absolute count or a float
 ratio in (0, 1] (interpreted per compressed chunk, as the reference does
 per partition).
+
+Three selection strategies (same wire format, same budget, same
+densify-sum server path — EF recirculates whatever a near-miss leaves
+behind, so all three preserve the sparsifier's contract):
+
+* ``selection="exact"`` (default) — ``lax.top_k``, the reference's
+  semantics. On TPU this is catastrophically slow at gradient-chunk
+  sizes: a GPT-2-medium fused step measured ~50× slower than the whole
+  uncompressed step on one v5e (docs/performance.md).
+* ``selection="approx"`` — ``jax.lax.approx_max_k``, the TPU-native
+  partial-reduce selection with a ``recall_target`` bound. ~5× faster
+  than exact at GPT-2-medium scale, but the dense reconstruction is
+  still a scatter (serialized on TPU).
+* ``selection="block"`` — blockwise top-1 (local top-k): reshape to
+  ``(k, n/k)`` rows, keep each row's argmax. Selection is a pure
+  vectorized reduce AND reconstruction is a one-hot multiply — no sort,
+  no scatter anywhere, which is why it is the TPU-shaped variant
+  (measured ~60× faster end-to-end than exact at GPT-2-medium scale).
+  The support differs from global top-k (exactly one winner per block),
+  a standard local-selection tradeoff the EF decorator compensates;
+  index budget and wire format are identical.
 """
 
 from __future__ import annotations
@@ -14,6 +35,8 @@ import jax
 import jax.numpy as jnp
 
 from byteps_tpu.compression.base import Compressor, Payload, register_compressor
+
+_SELECTIONS = ("exact", "approx", "block")
 
 
 def resolve_k(k: Union[int, float], n: int) -> int:
@@ -27,14 +50,55 @@ class TopkCompressor(Compressor):
     name = "topk"
     presummable = False  # per-worker supports differ; must densify to sum
 
-    def __init__(self, k: Union[int, float] = 0.01, **_ignored):
+    def __init__(self, k: Union[int, float] = 0.01, approx: bool = False,
+                 recall_target: float = 0.95,
+                 selection: Optional[str] = None, **_ignored):
         self.k = k
+        # approx=True is the compat spelling of selection="approx"
+        self.selection = (selection if selection is not None
+                          else ("approx" if approx else "exact"))
+        if self.selection not in _SELECTIONS:
+            raise ValueError(f"unknown selection {self.selection!r} — "
+                             f"expected one of {_SELECTIONS}")
+        if not 0.0 < recall_target <= 1.0:
+            raise ValueError(
+                f"recall_target must be in (0, 1]; got {recall_target}")
+        self.recall_target = float(recall_target)
+
+    # -- block layout -------------------------------------------------
+    def _block_shape(self, n: int) -> tuple:
+        """(rows, block) with rows*block >= n covering n with k rows."""
+        k = resolve_k(self.k, n)
+        block = -(-n // k)          # ceil: block size per winner
+        rows = -(-n // block)       # rows actually needed to cover n
+        return rows, block
 
     def compress(self, x: jnp.ndarray, rng: Optional[jnp.ndarray] = None) -> Payload:
         n = x.shape[0]
         k = resolve_k(self.k, n)
         xf = x.astype(jnp.float32)
-        _, idx = jax.lax.top_k(jnp.abs(xf), k)
+        if self.selection == "block" and k < n:
+            rows, block = self._block_shape(n)
+            pad = rows * block - n
+            xa = jnp.abs(xf)
+            if pad:
+                # padding is -1 < 0 <= |x|: a padded slot can never win
+                # unless the whole row is padding (sliced away below)
+                xa = jnp.concatenate([xa, jnp.full((pad,), -1.0)])
+                xv = jnp.concatenate([xf, jnp.zeros((pad,))])
+            else:
+                xv = xf
+            xa = xa.reshape(rows, block)
+            local = jnp.argmax(xa, axis=1)                     # (rows,)
+            idx = (jnp.arange(rows) * block + local).astype(jnp.int32)
+            vals = xv.reshape(rows, block)[jnp.arange(rows), local]
+            return {"indices": idx, "values": vals}
+        if self.selection == "approx" and k < n:
+            _, idx = jax.lax.approx_max_k(
+                jnp.abs(xf), k, recall_target=self.recall_target)
+        else:
+            # exact; k == n degenerates to identity for every strategy
+            _, idx = jax.lax.top_k(jnp.abs(xf), k)
         return {"indices": idx.astype(jnp.int32), "values": xf[idx]}
 
     def decompress(
@@ -44,10 +108,23 @@ class TopkCompressor(Compressor):
         dtype=jnp.float32,
         rng: Optional[jnp.ndarray] = None,
     ) -> jnp.ndarray:
+        idx, vals = payload["indices"], payload["values"]
+        rows, block = self._block_shape(n)
+        if self.selection == "block" and idx.shape[0] == rows and block > 1:
+            # scatter-free reconstruction: indices follow the per-row
+            # pattern (row*block + local), so a one-hot multiply rebuilds
+            # the dense chunk — the TPU win over .at[].add
+            local = idx - jnp.arange(rows, dtype=idx.dtype) * block
+            dense = (jax.nn.one_hot(local, block, dtype=jnp.float32)
+                     * vals[:, None]).reshape(rows * block)
+            return dense[:n].astype(dtype)
         dense = jnp.zeros((n,), jnp.float32)
-        dense = dense.at[payload["indices"]].add(payload["values"])
+        dense = dense.at[idx].add(vals)
         return dense.astype(dtype)
 
     def compressed_bytes(self, n: int, itemsize: int = 4) -> int:
+        if self.selection == "block":
+            rows, _ = self._block_shape(n)
+            return rows * (4 + itemsize)
         k = resolve_k(self.k, n)
         return k * (4 + itemsize)

@@ -95,6 +95,70 @@ def test_topk_ratio(x):
     assert c.compressed_bytes(1000) == 50 * 8
 
 
+def test_topk_approx_contract(x):
+    """approx=True (TPU-native approx_max_k selection): same wire shape,
+    high-recall support vs exact, and k=1.0 stays the exact identity."""
+    exact = TopkCompressor(k=50)
+    approx = TopkCompressor(k=50, approx=True, recall_target=0.95)
+    pe = exact.compress(x)
+    pa = approx.compress(x)
+    assert pa["values"].shape == pe["values"].shape
+    assert pa["indices"].dtype == pe["indices"].dtype
+    overlap = len(set(np.asarray(pa["indices"]).tolist())
+                  & set(np.asarray(pe["indices"]).tolist()))
+    assert overlap >= int(0.9 * 50), overlap
+    # selected values must be the true values at those coordinates
+    xn = np.asarray(x)
+    np.testing.assert_allclose(np.asarray(pa["values"]),
+                               xn[np.asarray(pa["indices"])], rtol=1e-6)
+    # k = n short-circuits to exact top_k: identity round trip
+    ident = TopkCompressor(k=1.0, approx=True)
+    xh = ident.decompress(ident.compress(x), x.shape[0])
+    np.testing.assert_allclose(np.asarray(xh), xn, rtol=1e-6)
+    with pytest.raises(ValueError, match="recall_target"):
+        TopkCompressor(k=10, recall_target=0.0)
+
+
+@pytest.mark.parametrize("n,k", [(1000, 50), (1000, 7)])
+def test_topk_block_selection(n, k):
+    """selection='block' (scatter-free local top-k): one winner per
+    block, each the block's |max|, same wire format; reconstruction
+    equals the generic scatter path exactly. (k == n takes the exact
+    identity path — covered by test_topk_block_identity_at_full_k,
+    where indices are value-ordered, not block-ordered.)"""
+    xn = np.random.RandomState(n + k).randn(n).astype(np.float32)
+    c = TopkCompressor(k=k, selection="block")
+    p = c.compress(jnp.asarray(xn))
+    idx = np.asarray(p["indices"])
+    vals = np.asarray(p["values"])
+    rows, block = c._block_shape(n)
+    assert idx.shape == (rows,) and abs(rows - k) <= 1
+    # each winner is its block's max-|x| element, value preserved
+    for r in range(rows):
+        lo, hi = r * block, min((r + 1) * block, n)
+        assert lo <= idx[r] < hi
+        assert abs(xn[idx[r]]) == np.abs(xn[lo:hi]).max()
+        assert vals[r] == xn[idx[r]]
+    # one-hot reconstruction == scatter reconstruction
+    dense = np.asarray(c.decompress(p, n))
+    golden = np.zeros(n, np.float32)
+    golden[idx] = vals
+    np.testing.assert_array_equal(dense, golden)
+    assert c.compressed_bytes(n) == rows * 8
+
+
+def test_topk_block_identity_at_full_k():
+    xn = np.random.RandomState(3).randn(256).astype(np.float32)
+    c = TopkCompressor(k=1.0, selection="block")
+    xh = c.decompress(c.compress(jnp.asarray(xn)), 256)
+    np.testing.assert_allclose(np.asarray(xh), xn, rtol=1e-6)
+
+
+def test_topk_selection_validation():
+    with pytest.raises(ValueError, match="selection"):
+        TopkCompressor(k=10, selection="nope")
+
+
 # ---------------- randomk ---------------------------------------------------
 def test_randomk_synced_indices(x):
     """Same rng key => same indices on 'different workers' (values-only wire)."""
