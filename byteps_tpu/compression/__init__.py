@@ -19,6 +19,7 @@ from byteps_tpu.compression.base import (  # noqa: F401
     register_compressor,
 )
 from byteps_tpu.compression.fp16 import Fp16Compressor  # noqa: F401
+from byteps_tpu.compression.fp8 import Fp8Compressor  # noqa: F401
 from byteps_tpu.compression.onebit import OnebitCompressor  # noqa: F401
 from byteps_tpu.compression.topk import TopkCompressor  # noqa: F401
 from byteps_tpu.compression.randomk import RandomkCompressor  # noqa: F401

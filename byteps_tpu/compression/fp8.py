@@ -1,0 +1,58 @@
+"""Scaled fp8 (e4m3) compression — half of fp16's wire bytes.
+
+Beyond-reference wire format (the reference stops at fp16,
+``byteps/torch/compression.py``): one fp32 absmax scale per partition +
+one e4m3 byte per element, quartering raw fp32 push/pull traffic. The
+e4m3 grid (4 exponent bits, 3 mantissa, max 448) holds ~2 decimal
+digits — with the per-partition scale pinning the dynamic range, the
+quantization error is ≤ 2^-4 relative per element, and the error-
+feedback decorator (``ef``) recirculates it for convergence-sensitive
+runs.
+
+The TPU path quantizes with the native ``jnp.float8_e4m3fn`` dtype
+(hardware cast); the DCN wire twin (``wire.Fp8Wire``) uses ml_dtypes on
+the host, and the C++ server decodes/re-encodes bit-exactly
+(``server/csrc/codec.cc``: ``fp8_to_float`` / ``float_to_fp8``,
+round-to-nearest-even — parity asserted over all 256 byte values and
+random grids in ``tests/test_dcn.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from byteps_tpu.compression.base import Compressor, Payload, register_compressor
+
+FP8_MAX = 448.0  # largest finite e4m3fn value
+
+
+@register_compressor("fp8")
+class Fp8Compressor(Compressor):
+    name = "fp8"
+    # per-worker scales differ -> positional byte sums do NOT commute
+    presummable = False
+
+    def __init__(self, **_ignored):
+        pass
+
+    def compress(self, x: jnp.ndarray, rng: Optional[jnp.ndarray] = None) -> Payload:
+        xf = x.astype(jnp.float32)
+        absmax = jnp.max(jnp.abs(xf))
+        scale = jnp.where(absmax > 0, absmax / FP8_MAX, 1.0)
+        q = jnp.clip(xf / scale, -FP8_MAX, FP8_MAX)
+        return {"values": q.astype(jnp.float8_e4m3fn), "scale": scale}
+
+    def decompress(
+        self,
+        payload: Payload,
+        n: int,
+        dtype=jnp.float32,
+        rng: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        return (payload["values"].astype(jnp.float32)
+                * payload["scale"]).astype(dtype)
+
+    def compressed_bytes(self, n: int, itemsize: int = 4) -> int:
+        return 4 + n

@@ -29,6 +29,7 @@ WIRE_FP16 = 1
 WIRE_ONEBIT = 2
 WIRE_TOPK = 3
 WIRE_DITHER = 4
+WIRE_FP8 = 5
 
 _DITHER_NATURAL = 0x1
 _DITHER_MAXNORM = 0x2
@@ -79,6 +80,42 @@ class Fp16Wire(WireCodec):
 
     def wire_bytes(self, n: int) -> int:
         return n * 2
+
+
+class Fp8Wire(WireCodec):
+    """[f32 scale][n bytes e4m3fn] — quarter of raw fp32, half of fp16.
+    scale = absmax/448 (1.0 for an all-zero partition); elements are
+    clipped to the finite e4m3 range before the ml_dtypes RNE cast so
+    the overflow->NaN cast semantics can never fire. Byte-exact C++
+    twin in server/csrc/codec.cc."""
+
+    codec_id = WIRE_FP8
+
+    FP8_MAX = 448.0
+
+    def encode(self, x: np.ndarray, seed: int = 0) -> np.ndarray:
+        import ml_dtypes
+
+        xf = np.ascontiguousarray(x, np.float32)
+        absmax = float(np.max(np.abs(xf))) if xf.size else 0.0
+        scale = np.float32(absmax / self.FP8_MAX if absmax > 0 else 1.0)
+        q = np.clip(xf / scale, -self.FP8_MAX, self.FP8_MAX)
+        body = q.astype(ml_dtypes.float8_e4m3fn).view(np.uint8)
+        out = np.empty(4 + xf.size, np.uint8)
+        out[:4] = np.frombuffer(scale.tobytes(), np.uint8)
+        out[4:] = body
+        return out
+
+    def decode(self, buf: np.ndarray, n: int, seed: int = 0) -> np.ndarray:
+        import ml_dtypes
+
+        buf = np.ascontiguousarray(buf)
+        scale = buf[:4].view(np.float32)[0]
+        vals = buf[4:4 + n].view(ml_dtypes.float8_e4m3fn).astype(np.float32)
+        return vals * scale
+
+    def wire_bytes(self, n: int) -> int:
+        return 4 + n
 
 
 class OnebitWire(WireCodec):
@@ -326,4 +363,6 @@ def make_wire_codec(spec: CompressionSpec) -> Optional[WireCodec]:
         )
     if name == "fp16":
         return Fp16Wire()
+    if name == "fp8":
+        return Fp8Wire()
     raise ValueError(f"no DCN wire codec for compressor '{name}'")

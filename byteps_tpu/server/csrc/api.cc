@@ -6,6 +6,7 @@
 #include <vector>
 
 #include "client.h"
+#include "codec.h"
 #include "server.h"
 
 extern "C" {
@@ -22,6 +23,13 @@ void bps_server_wait() { bps::WaitServer(); }
 void bps_server_stop() { bps::StopServer(); }
 
 void bps_server_trace_enable(int on) { bps::ServerTraceEnable(on != 0); }
+
+// e4m3 conversions exposed for the Python<->C++ bit-exactness tests
+// (tests/test_dcn.py asserts parity with the ml_dtypes cast over all
+// 256 byte values and random grids).
+float bps_fp8_to_float(uint8_t b) { return bps::fp8_to_float(b); }
+
+uint8_t bps_float_to_fp8(float f) { return bps::float_to_fp8(f); }
 
 int bps_server_trace_dump(const char* path) {
   return bps::ServerTraceDump(path);

@@ -91,12 +91,70 @@ uint16_t float_to_half(float f) {
   return static_cast<uint16_t>(sign | (exp << 10) | half_man);
 }
 
+float fp8_to_float(uint8_t b) {
+  const float sign = (b & 0x80u) ? -1.0f : 1.0f;
+  const int exp = (b >> 3) & 0xF;
+  const int man = b & 0x7;
+  if (exp == 15 && man == 7) return std::nanf("");  // the only NaN pattern
+  if (exp == 0) return sign * std::ldexp(static_cast<float>(man), -9);
+  // (1 + man/8) * 2^(exp-7) == (8 + man) * 2^(exp-10)
+  return sign * std::ldexp(static_cast<float>(8 + man), exp - 10);
+}
+
+uint8_t float_to_fp8(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  const uint8_t sign = static_cast<uint8_t>((bits >> 24) & 0x80u);
+  const uint32_t exp_f = (bits >> 23) & 0xFFu;
+  const uint32_t man_f = bits & 0x7FFFFFu;
+  if (exp_f == 0xFF) return sign | 0x7F;  // inf/NaN -> NaN
+  if ((bits & 0x7FFFFFFFu) == 0) return sign;  // ±0
+  const int e = static_cast<int>(exp_f) - 127;
+  // 24-bit significand with the implicit bit (fp32 subnormal inputs have
+  // e == -127 and no implicit bit, but those are << the fp8 subnormal
+  // cutoff and fall into the shift>31 underflow below regardless)
+  const uint32_t sig = man_f | 0x800000u;
+  int shift, out_exp;
+  if (e < -6) {  // fp8-subnormal target: ulp = 2^-9
+    shift = 20 + (-6 - e);
+    out_exp = 0;
+    if (shift > 31) return sign;  // underflow to ±0
+  } else {
+    shift = 20;
+    out_exp = e + 7;
+  }
+  // round to nearest, ties to even
+  uint32_t rounded = sig >> shift;
+  const uint32_t rem = sig & ((1u << shift) - 1u);
+  const uint32_t half = 1u << (shift - 1);
+  if (rem > half || (rem == half && (rounded & 1u))) rounded++;
+  if (out_exp == 0) {
+    if (rounded >= 8) {  // rounded up into the normal range
+      out_exp = 1;
+      rounded -= 8;
+    }
+  } else {
+    if (rounded >= 16) {  // mantissa carry: exponent bumps, mantissa 0
+      out_exp++;
+      rounded >>= 1;
+    }
+    rounded -= 8;  // strip the implicit bit
+  }
+  if (out_exp > 15 || (out_exp == 15 && rounded >= 7)) {
+    return sign | 0x7E;  // clamp to ±448 (inputs are pre-clipped)
+  }
+  return sign | static_cast<uint8_t>(out_exp << 3) |
+         static_cast<uint8_t>(rounded);
+}
+
 bool validate_payload(uint8_t codec, const char* buf, size_t len, int64_t n) {
   switch (codec) {
     case kCodecRaw:
       return len == static_cast<size_t>(n) * 4;
     case kCodecFP16:
       return len == static_cast<size_t>(n) * 2;
+    case kCodecFP8:
+      return len == 4 + static_cast<size_t>(n);
     case kCodecOnebit:
       return len == 4 + static_cast<size_t>(onebit_words(n)) * 4;
     case kCodecTopk: {
@@ -135,6 +193,13 @@ void decode_sum(uint8_t codec, const char* buf, size_t len, float* dst,
     case kCodecFP16: {
       const uint16_t* src = reinterpret_cast<const uint16_t*>(buf);
       for (int64_t i = 0; i < n; ++i) dst[i] += half_to_float(src[i]);
+      break;
+    }
+    case kCodecFP8: {
+      float scale;
+      std::memcpy(&scale, buf, 4);
+      const uint8_t* src = reinterpret_cast<const uint8_t*>(buf + 4);
+      for (int64_t i = 0; i < n; ++i) dst[i] += fp8_to_float(src[i]) * scale;
       break;
     }
     case kCodecOnebit: {
@@ -203,6 +268,21 @@ std::vector<char> encode(uint8_t codec, const float* src, int64_t n,
       std::vector<char> out(static_cast<size_t>(n) * 2);
       uint16_t* dst = reinterpret_cast<uint16_t*>(out.data());
       for (int64_t i = 0; i < n; ++i) dst[i] = float_to_half(src[i]);
+      return out;
+    }
+    case kCodecFP8: {
+      float absmax = 0.f;
+      for (int64_t i = 0; i < n; ++i)
+        absmax = std::max(absmax, std::fabs(src[i]));
+      const float scale = absmax > 0.f ? absmax / 448.0f : 1.0f;
+      std::vector<char> out(4 + static_cast<size_t>(n));
+      std::memcpy(out.data(), &scale, 4);
+      uint8_t* dst = reinterpret_cast<uint8_t*>(out.data() + 4);
+      for (int64_t i = 0; i < n; ++i) {
+        const float q =
+            std::min(448.0f, std::max(-448.0f, src[i] / scale));
+        dst[i] = float_to_fp8(q);
+      }
       return out;
     }
     case kCodecOnebit: {

@@ -32,6 +32,9 @@ enum Codec : uint8_t {
   kCodecOnebit = 2,
   kCodecTopk = 3,
   kCodecDither = 4,
+  // [f32 scale][n bytes e4m3fn] — quarter of raw fp32 (see
+  // compression/fp8.py; byte-exact twin of the ml_dtypes cast)
+  kCodecFP8 = 5,
 };
 
 constexpr uint8_t kDitherNatural = 0x1;
@@ -66,5 +69,11 @@ std::vector<char> encode(uint8_t codec, const float* src, int64_t n,
 // Portable IEEE half conversions (software; auto-vectorizable loops).
 float half_to_float(uint16_t h);
 uint16_t float_to_half(float f);
+
+// e4m3fn conversions (1-4-3, bias 7, max finite 448, no inf;
+// round-to-nearest-even on encode — matches the ml_dtypes cast the
+// Python wire codec uses, asserted over all 256 bytes in tests).
+float fp8_to_float(uint8_t b);
+uint8_t float_to_fp8(float f);
 
 }  // namespace bps

@@ -32,6 +32,7 @@ WIRE_FP16 = 1
 WIRE_ONEBIT = 2
 WIRE_TOPK = 3
 WIRE_DITHER = 4
+WIRE_FP8 = 5
 
 
 def _build() -> None:
@@ -66,6 +67,10 @@ def load_lib() -> ctypes.CDLL:
         lib.bps_server_wait.argtypes = []
         lib.bps_server_stop.argtypes = []
         lib.bps_server_trace_enable.argtypes = [ctypes.c_int]
+        lib.bps_fp8_to_float.argtypes = [ctypes.c_uint8]
+        lib.bps_fp8_to_float.restype = ctypes.c_float
+        lib.bps_float_to_fp8.argtypes = [ctypes.c_float]
+        lib.bps_float_to_fp8.restype = ctypes.c_uint8
         lib.bps_server_trace_dump.argtypes = [ctypes.c_char_p]
         lib.bps_server_trace_dump.restype = ctypes.c_int
         lib.bps_local_init.argtypes = [ctypes.c_uint64, ctypes.c_uint64]

@@ -261,6 +261,66 @@ def test_server_topk_and_fp16_sum():
         w.shutdown()
 
 
+def test_fp8_wire_bit_exact_twins_and_server_sum():
+    """e4m3 wire: C++ conversions are byte-exact twins of the ml_dtypes
+    cast (all 256 decode values + a dense encode grid), and the server
+    decode→fp32-sum→re-encode round works at quarter-of-raw bytes."""
+    import ml_dtypes
+
+    from byteps_tpu.compression import wire
+    from byteps_tpu.server.native import load_lib
+
+    lib = load_lib()
+    # decode: all 256 byte values
+    for b in range(256):
+        cpp = lib.bps_fp8_to_float(b)
+        py = float(np.frombuffer(bytes([b]), ml_dtypes.float8_e4m3fn)[0]
+                   .astype(np.float32))
+        assert (np.isnan(cpp) and np.isnan(py)) or cpp == py, (b, cpp, py)
+    # encode: random + boundary grid, pre-clamped like the codec does
+    rng = np.random.default_rng(11)
+    xs = np.concatenate([
+        rng.standard_normal(4096).astype(np.float32) * 100,
+        np.linspace(-448, 448, 1001, dtype=np.float32),
+        np.array([0.0, -0.0, 448.0, -448.0, 2 ** -9, 2 ** -10,
+                  1.5 * 2 ** -9], np.float32),
+    ])
+    enc_py = xs.astype(ml_dtypes.float8_e4m3fn).view(np.uint8)
+    enc_cpp = np.array([lib.bps_float_to_fp8(float(v)) for v in xs],
+                       np.uint8)
+    np.testing.assert_array_equal(enc_py, enc_cpp)
+
+    # numpy wire round trip: e4m3 has 3 mantissa bits -> <= 2^-4
+    # relative on normals, plus half a subnormal step absolute
+    f8 = wire.Fp8Wire()
+    x = rng.standard_normal(257).astype(np.float32)
+    dec = f8.decode(f8.encode(x), x.size)
+    np.testing.assert_allclose(dec, x, rtol=2 ** -4,
+                               atol=float(np.abs(x).max()) / 448)
+    assert f8.encode(x).nbytes == 4 + x.size
+
+    # server: two fp8 pushes sum in fp32; raw and fp8 pulls agree
+    port = BASE_PORT + 16
+    servers = _serve(port, num_workers=2)
+    n = 128
+    xs2 = [rng.standard_normal(n).astype(np.float32) for _ in range(2)]
+    ws = [PSWorker(servers=servers, worker_id=i) for i in range(2)]
+    for w in ws:
+        w.init_key(0, n * 4)
+    vs = [w.push_bytes(0, f8.encode(x), wire.WIRE_FP8)
+          for w, x in zip(ws, xs2)]
+    want = sum(f8.decode(f8.encode(x), n) for x in xs2)
+    raw = ws[0].pull_bytes(0, n * 4, vs[0], wire.WIRE_RAW)
+    np.testing.assert_allclose(raw.view(np.float32), want, rtol=1e-5,
+                               atol=1e-6)
+    blob = ws[1].pull_bytes(0, f8.wire_bytes(n), vs[1], wire.WIRE_FP8)
+    np.testing.assert_allclose(f8.decode(blob, n), want, rtol=2 ** -4,
+                               atol=float(np.abs(want).max()) / 448)
+    assert ws[0].bytes_pushed == 4 + n  # quarter of raw fp32
+    for w in ws:
+        w.shutdown()
+
+
 def test_init_size_mismatch_rejected():
     port = BASE_PORT + 8
     servers = _serve(port)
