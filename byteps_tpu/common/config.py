@@ -107,6 +107,7 @@ class Config:
     trace_dir: str = "./traces"
     trace_start_step: int = 1
     trace_end_step: int = 30
+    trace_xprof: bool = False
 
     # --- auto-tuner (ByteScheduler, SURVEY §2.6) ---------------------------
     auto_tune: bool = False
@@ -151,6 +152,7 @@ class Config:
             trace_dir=_env_str("BYTEPS_TRACE_DIR", "./traces"),
             trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 1),
             trace_end_step=_env_int("BYTEPS_TRACE_END_STEP", 30),
+            trace_xprof=_env_bool("BYTEPS_TRACE_XPROF"),
             auto_tune=_env_bool("BYTEPS_AUTO_TUNE"),
             dp_axis=_env_str("BYTEPS_DP_AXIS", "dp"),
             reduce_dtype=_env_str("BYTEPS_REDUCE_DTYPE", "float32"),

@@ -37,12 +37,19 @@ class TraceRecorder:
         start_step: int = 1,
         end_step: int = 30,
         rank: int = 0,
+        xprof: bool = False,
     ) -> None:
         self.enabled = enabled
         self.trace_dir = trace_dir
         self.start_step = start_step
         self.end_step = end_step
         self.rank = rank
+        # BYTEPS_TRACE_XPROF=1: capture a jax.profiler (XLA/xprof) trace
+        # over the SAME [start_step, end_step] window as the chrome
+        # trace — device-side kernel/fusion attribution beside the
+        # framework's stage spans (view with tensorboard or xprof)
+        self.xprof = xprof and enabled
+        self._xprof_running = False
         self.metadata: Dict[str, Any] = {}
         self._events: List[Dict[str, Any]] = []
         self._lock = threading.Lock()
@@ -60,6 +67,7 @@ class TraceRecorder:
     def step(self) -> None:
         """Advance the step counter; auto-dump once past end_step."""
         self._step += 1
+        self._maybe_xprof()
         if self.enabled and self._step > self.end_step:
             self.dump()
 
@@ -74,8 +82,37 @@ class TraceRecorder:
                 return
             self._step = step_no
             dump = self.enabled and self._step > self.end_step
+        self._maybe_xprof()
         if dump:
             self.dump()
+
+    def _maybe_xprof(self) -> None:
+        """Start/stop the jax.profiler capture at the window edges.
+        Failures degrade to a warning — the chrome trace still records."""
+        if not self.xprof:
+            return
+        entering = (not self._xprof_running
+                    and self.start_step <= self._step <= self.end_step)
+        leaving = self._xprof_running and self._step > self.end_step
+        if not entering and not leaving:
+            return
+        try:
+            import jax
+
+            if entering:
+                d = os.path.join(self.trace_dir, f"xprof_rank{self.rank}")
+                os.makedirs(d, exist_ok=True)
+                jax.profiler.start_trace(d)
+                self._xprof_running = True
+                log.info("xprof capture started -> %s", d)
+            else:
+                jax.profiler.stop_trace()
+                self._xprof_running = False
+                log.info("xprof capture stopped")
+        except Exception as e:  # noqa: BLE001 — profiler support varies
+            self.xprof = False
+            self._xprof_running = False
+            log.warning("xprof capture unavailable: %s", e)
 
     def fused_step(self, count: int, args: Optional[Dict[str, Any]] = None) -> None:
         """Per-execution marker fired from inside a jitted train step
@@ -89,6 +126,7 @@ class TraceRecorder:
                 self._step = step_no
                 emit = True
         if emit:
+            self._maybe_xprof()
             self.instant(f"step{step_no}", "FUSED_PUSHPULL", args)
             if self.enabled and self._step > self.end_step:
                 self.dump()
@@ -151,6 +189,15 @@ class TraceRecorder:
 
     # -- output -------------------------------------------------------------
     def dump(self, path: Optional[str] = None) -> Optional[str]:
+        if self._xprof_running:
+            # run ended inside the window — close the device capture
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception as e:  # noqa: BLE001
+                log.warning("xprof stop at dump failed: %s", e)
+            self._xprof_running = False
         if self._dumped or not self._events:
             return None
         self._dumped = True
@@ -206,6 +253,7 @@ def get_tracer() -> TraceRecorder:
             start_step=cfg.trace_start_step,
             end_step=cfg.trace_end_step,
             rank=cfg.worker_id,
+            xprof=cfg.trace_xprof,
         )
     return _tracer
 

@@ -1,6 +1,7 @@
 """Chrome-trace recorder (SURVEY §5.1; reference docs/timeline.md)."""
 
 import json
+import os
 
 from byteps_tpu.common.tracing import TraceRecorder
 
@@ -43,3 +44,47 @@ def test_step_window_gating(tmp_path):
     assert rec._dumped
     names = [e["name"] for e in rec._events]
     assert names == ["b"]
+
+
+def test_xprof_window_capture(tmp_path):
+    """BYTEPS_TRACE_XPROF: a jax.profiler capture opens at the window
+    start and closes past the end (or at dump), landing device-trace
+    files under trace_dir/xprof_rank{r}; chrome events still record."""
+    import os
+
+    rec = TraceRecorder(enabled=True, trace_dir=str(tmp_path),
+                        start_step=1, end_step=2, rank=0, xprof=True)
+    import jax
+    import jax.numpy as jnp
+
+    rec.step()                       # enters the window -> capture starts
+    assert rec._xprof_running
+    jnp.ones((8, 8)) @ jnp.ones((8, 8))  # something for the device trace
+    with rec.span("grad.p0", "PUSH"):
+        pass
+    rec.step()                       # step 2, still inside
+    rec.step()                       # step 3 -> capture stops + dump
+    assert not rec._xprof_running
+    xdir = os.path.join(str(tmp_path), "xprof_rank0")
+    assert os.path.isdir(xdir) and any(os.scandir(xdir))
+    data = json.load(open(os.path.join(str(tmp_path), "trace_rank0.json")))
+    assert data["traceEvents"]
+
+
+def test_xprof_failure_degrades_to_chrome_only(tmp_path, monkeypatch):
+    rec = TraceRecorder(enabled=True, trace_dir=str(tmp_path),
+                        start_step=1, end_step=2, rank=0, xprof=True)
+    import jax
+
+    def boom(*a, **k):
+        raise RuntimeError("no profiler here")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    rec.step()
+    assert not rec._xprof_running and not rec.xprof  # disabled, no crash
+    with rec.span("grad.p0", "PUSH"):
+        pass
+    rec.step()
+    rec.step()
+    assert json.load(open(
+        os.path.join(str(tmp_path), "trace_rank0.json")))["traceEvents"]
