@@ -890,15 +890,18 @@ def bench_dcn() -> dict:
         pws = [PSWorker(servers=servers, worker_id=w) for w in range(workers)]
         data = np.random.default_rng(0).standard_normal(nelems).astype(
             np.float32)
-        ob = wire.OnebitWire(scaling=True)
-        key_base = {"raw": 0, "onebit": 1000}[codec_name]
+        codec = {"raw": None,
+                 "onebit": wire.OnebitWire(scaling=True),
+                 "fp8": wire.Fp8Wire()}[codec_name]
+        codec_id = {"raw": wire.WIRE_RAW, "onebit": wire.WIRE_ONEBIT,
+                    "fp8": wire.WIRE_FP8}[codec_name]
+        key_base = {"raw": 0, "onebit": 1000, "fp8": 2000}[codec_name]
         for w in pws:
             for t in range(threads):
                 for k in range(keys_per_thread):
                     key = key_base + t * keys_per_thread + k
-                    store = nbytes if codec_name == "raw" else nelems * 4
-                    w.init_key(key, store)
-        payload = ob.encode(data) if codec_name == "onebit" else None
+                    w.init_key(key, nelems * 4)
+        payload = codec.encode(data) if codec is not None else None
         barrier = threading.Barrier(workers * threads)
 
         def body(w, t):
@@ -907,16 +910,16 @@ def bench_dcn() -> dict:
                        for k in range(keys_per_thread)]
             barrier.wait()
             for _ in range(rounds):
-                if codec_name == "raw":
+                if codec is None:
                     vs = [psw.push(k, data) for k in my_keys]
                     for k, v in zip(my_keys, vs):
                         psw.pull(k, nelems, v)
                 else:
-                    vs = [psw.push_bytes(k, payload, wire.WIRE_ONEBIT)
+                    vs = [psw.push_bytes(k, payload, codec_id)
                           for k in my_keys]
                     for k, v in zip(my_keys, vs):
-                        psw.pull_bytes(k, ob.wire_bytes(nelems), v,
-                                       wire.WIRE_ONEBIT)
+                        psw.pull_bytes(k, codec.wire_bytes(nelems), v,
+                                       codec_id)
 
         ts = [threading.Thread(target=body, args=(w, t))
               for w in range(workers) for t in range(threads)]
@@ -946,6 +949,15 @@ def bench_dcn() -> dict:
     _log(f"dcn onebit: wire {ob_wire_gbps:.3f} GB/s/worker, effective "
          f"{ob_eff_gbps:.2f} GB/s/worker (x{db_ob/wb_ob:.0f} compression)")
     stop_server()
+    start_server(port=port + 2, num_workers=workers, engine_threads=4,
+                 async_mode=False)
+    servers[0] = ("127.0.0.1", port + 2)
+    el_f8, wb_f8, db_f8 = run_config("fp8")
+    f8_wire_gbps = wb_f8 / workers / el_f8 / 1e9
+    f8_eff_gbps = db_f8 / workers / el_f8 / 1e9
+    _log(f"dcn fp8: wire {f8_wire_gbps:.3f} GB/s/worker, effective "
+         f"{f8_eff_gbps:.2f} GB/s/worker (x{db_f8/wb_f8:.0f} compression)")
+    stop_server()
     return {
         "metric": "DCN push_pull goodput (2 workers + 1 server, localhost)",
         "value": round(raw_gbps, 3),
@@ -953,6 +965,8 @@ def bench_dcn() -> dict:
         "vs_baseline": round(raw_gbps / 0.165, 2),  # vs pre-rewrite server
         "onebit_wire_gbps": round(ob_wire_gbps, 4),
         "onebit_effective_gbps": round(ob_eff_gbps, 2),
+        "fp8_wire_gbps": round(f8_wire_gbps, 4),
+        "fp8_effective_gbps": round(f8_eff_gbps, 2),
     }
 
 

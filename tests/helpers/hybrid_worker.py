@@ -69,6 +69,14 @@ def compressed_main():
     np.testing.assert_allclose(np.asarray(out), 28.0, rtol=1e-6)
     assert psw.bytes_pushed - p0 == n * 2
 
+    # fp8 wire: constant rows sit exactly on the e4m3 grid (absmax
+    # scaling maps the max slot to 448 = representable), quarter bytes
+    p0 = psw.bytes_pushed
+    out = bps.push_pull(x, average=False, name="c4",
+                        compression_params={"compressor": "fp8"})
+    np.testing.assert_allclose(np.asarray(out), 28.0, rtol=2 ** -4)
+    assert psw.bytes_pushed - p0 == 4 + n
+
     bps.shutdown()
     print(f"HYBRID_WORKER_{wid}_OK", flush=True)
 

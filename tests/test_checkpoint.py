@@ -42,6 +42,7 @@ def _shardings(tree):
     return [x.sharding for x in jax.tree.leaves(tree)]
 
 
+@pytest.mark.slow
 def test_roundtrip_preserves_values_and_layout(tmp_path):
     mesh = make_mesh(MeshAxes(dp=2, tp=2), devices=jax.devices()[:4])
     step, params, opt_state, bsh = make_gpt_train_step(
@@ -77,6 +78,7 @@ def test_restore_onto_different_topology(tmp_path):
     assert _shardings(restored["opt"]) == _shardings(opt_b)
 
 
+@pytest.mark.slow
 def test_resume_is_bitwise_exact(tmp_path):
     """ckpt@2 + 2 more steps == 4 uninterrupted steps, state included."""
     mesh = make_mesh(MeshAxes(dp=2), devices=jax.devices()[:2])

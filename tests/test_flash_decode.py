@@ -95,6 +95,7 @@ def test_guards():
 
 
 # ---- end-to-end: the kernel inside the scanned sampler ---------------------
+@pytest.mark.slow
 @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
 def test_generation_pinned_across_backends(monkeypatch, dtype):
     """Forced-pallas decode (kernel, interpret) must generate the SAME

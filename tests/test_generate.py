@@ -88,6 +88,7 @@ def test_generate_sampling_is_deterministic_and_in_vocab(setup):
     assert np.asarray(a)[:, -8:].min() >= 0
 
 
+@pytest.mark.slow
 def test_generate_under_tensor_parallelism(setup):
     """tp-sharded generation (heads + cache sharded, row-parallel psums)
     equals the single-device tokens exactly."""
@@ -215,6 +216,7 @@ def test_moe_generate_under_ep_and_tp():
     np.testing.assert_array_equal(np.asarray(sharded), np.asarray(single))
 
 
+@pytest.mark.slow
 def test_top_k_one_equals_greedy(setup):
     params, prompt = setup
     greedy = make_generate_fn(CFG, max_new=6)(
@@ -224,6 +226,7 @@ def test_top_k_one_equals_greedy(setup):
     np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))
 
 
+@pytest.mark.slow
 def test_tiny_nucleus_equals_greedy(setup):
     params, prompt = setup
     greedy = make_generate_fn(CFG, max_new=6)(
@@ -233,6 +236,7 @@ def test_tiny_nucleus_equals_greedy(setup):
     np.testing.assert_array_equal(np.asarray(p0), np.asarray(greedy))
 
 
+@pytest.mark.slow
 def test_top_p_full_equals_unrestricted(setup):
     params, prompt = setup
     a = make_generate_fn(CFG, max_new=6)(
@@ -289,6 +293,7 @@ def test_quantize_block_exact_on_grid():
     assert (err <= np.asarray(sy)[..., None] / 2 + 1e-7).all()
 
 
+@pytest.mark.slow
 def test_quant_cache_prefill_close_and_greedy_matches(setup):
     """int8 cache: prefill logits stay close to the dense-cache logits
     and greedy generation reproduces the dense-cache tokens on the tiny

@@ -139,6 +139,7 @@ def test_llama_greedy_decode_matches_hf_generate():
                                   hf_out[:, prompt.shape[1]:])
 
 
+@pytest.mark.slow
 def test_train_step_from_imported_weights(mesh8):
     """make_gpt_train_step(init_params=imported) — the switching path:
     bring an HF checkpoint, train it under the framework's dp
@@ -193,6 +194,7 @@ def test_llama_tree_is_lean_and_max_seq_overrides():
         _hf_logits(model, toks), atol=3e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_moe_rmsnorm_train_decode_consistent():
     """cfg.norm threads through the MoE train path AND the shared decode
     path — prefill logits through gpt_apply_cached must match what the

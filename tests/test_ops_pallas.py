@@ -51,6 +51,7 @@ def test_unpack_sum_backends_agree(xs):
     np.testing.assert_allclose(np.asarray(a), want, rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_unpack_sum_grid_at_pod_scale_K():
     """K=256 (pod-scale worker count) takes the grid-over-K kernel: the
     program size is constant in K — tracing/compiling stays bounded where

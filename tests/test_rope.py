@@ -100,6 +100,7 @@ def test_rope_generate_matches_naive_loop(setup):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
 
 
+@pytest.mark.slow
 def test_rope_train_step_converges():
     import optax
 

@@ -25,6 +25,7 @@ def test_swiglu_params_and_forward():
     assert np.isfinite(np.asarray(logits)).all()
 
 
+@pytest.mark.slow
 def test_swiglu_generate_matches_naive_loop():
     params = gpt_init(jax.random.PRNGKey(2), SW)
     prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 10), 0,

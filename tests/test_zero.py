@@ -53,6 +53,7 @@ def test_zero1_matches_replicated_adamw():
     assert mu.sharding.spec == P("dp")
 
 
+@pytest.mark.slow
 def test_zero1_composes_with_compression():
     tokens, targets = synthetic_batch(jax.random.PRNGKey(1), CFG, 8, 32)
     mesh = make_mesh(MeshAxes(dp=4), devices=jax.devices()[:4])
@@ -110,6 +111,7 @@ def test_accum_steps_matches_full_batch():
     np.testing.assert_allclose(acc, base, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_accum_steps_with_zero_and_compression():
     tokens, targets = synthetic_batch(jax.random.PRNGKey(5), CFG, 8, 32)
     mesh = make_mesh(MeshAxes(dp=4), devices=jax.devices()[:4])
