@@ -789,6 +789,29 @@ def bench_generate() -> dict:
     t3 = _time_it(run_cached(3), warmup=0, iters=s_iters)
     t_slope = (t3 - t1) / 2 if t3 > t1 else None
 
+    # speculative decoding, prompt-lookup draft (model-free): proposes
+    # the continuation of the current bigram's most recent earlier
+    # occurrence, verified in one target forward per round. Greedy
+    # output is EXACT at any accept rate (tests/test_speculative.py);
+    # the measured speedup is data-dependent — random-weight greedy
+    # falls into repetitive attractors, a favorable-but-real case the
+    # accept_rounds field quantifies (rounds/max_new = verify forwards
+    # per token; 1.0 = no acceptance).
+    from byteps_tpu.models.speculative import make_lookup_generate_fn
+
+    spec_len = 4
+    gen_s = make_lookup_generate_fn(cfg, max_new, spec_len=spec_len)
+
+    def run_spec():
+        toks, rounds = gen_s(params, prompt)
+        return _fence(toks), rounds
+
+    spec_rounds = int(jax.device_get(run_spec()[1]))
+    t_spec, t_plain2 = _time_pair(
+        lambda: run_spec()[0], run_cached(), warmup=1,
+        iters=3 if on_cpu else 5)
+    spec_speedup = t_plain2 / t_spec    # >1 = speculation wins
+
     # forward-only FLOPs: ~2 per matmul param per token; attention fwd
     # ~4·L·B·S·d per query token against S keys
     d, L = cfg.d_model, cfg.n_layers
@@ -801,7 +824,9 @@ def bench_generate() -> dict:
          f"{t_recompute*1e3:.1f}ms, speedup {speedup:.2f}x"
          + (f", slope/call {t_slope*1e3:.1f}ms" if t_slope else "")
          + f"; int8-cache {t_quant*1e3:.1f}ms "
-         f"({quant_ratio:.2f}x vs dense cache)")
+         f"({quant_ratio:.2f}x vs dense cache)"
+         + f"; speculative(lookup) {spec_speedup:.2f}x "
+         f"(K={spec_len}, {spec_rounds} verify fwds / {max_new} tokens)")
     return {
         "metric": f"GPT d{d}/L{L} cached decode, {max_new} new tokens "
                   f"(B={B}, prompt {T0}) vs full recompute",
@@ -813,6 +838,10 @@ def bench_generate() -> dict:
         "call_ms_slope": round(t_slope * 1e3, 3) if t_slope else None,
         "call_ms_quant_cache": round(t_quant * 1e3, 3),
         "quant_vs_dense_cache": round(quant_ratio, 3),
+        "call_ms_speculative": round(t_spec * 1e3, 3),
+        "speculative_speedup": round(spec_speedup, 3),
+        "speculative_verify_fwds": spec_rounds,
+        "spec_len": spec_len,
         "device_kind": kind,
         "peak_tflops_bf16": peak,
         "flops_per_call": flops,
