@@ -47,20 +47,16 @@ class MoEGPTConfig(GPTConfig):
 
 
 def moe_block_init(rng, cfg: MoEGPTConfig):
-    """Attention half of a dense block + expert-stacked MoE FFN."""
-    if cfg.mlp != "gelu":
-        raise NotImplementedError(
-            f"mlp={cfg.mlp!r} does not apply to the MoE family — the "
-            "dense MLP is replaced by the expert FFN (gelu experts); "
-            "gated experts are future work")
+    """Attention half of a dense block + expert-stacked MoE FFN
+    (``cfg.mlp="swiglu"`` = llama-style gated experts)."""
     b = block_init(rng, cfg.d_model, cfg.d_ff,
                    cfg.n_heads * cfg.head_dim, cfg.n_layers,
                    kv_hd=cfg.kv_heads * cfg.head_dim,
-                   use_bias=cfg.use_bias, norm=cfg.norm)
-    for k in ("w1", "b1", "w2", "b2"):
+                   mlp=cfg.mlp, use_bias=cfg.use_bias, norm=cfg.norm)
+    for k in ("w1", "b1", "w2", "b2", "w3", "b3"):
         b.pop(k, None)   # bias keys absent under use_bias=False
     b["moe"] = moe_init(jax.random.fold_in(rng, 99), cfg.d_model,
-                        cfg.d_ff, cfg.n_experts)
+                        cfg.d_ff, cfg.n_experts, mlp=cfg.mlp)
     return b
 
 
@@ -82,13 +78,14 @@ def moe_gpt_init(rng, cfg: MoEGPTConfig) -> Dict[str, Any]:
 
 
 def moe_block_specs(ep_axis: Optional[str], tp_axis: Optional[str] = None,
-                    use_bias: bool = True, norm: str = "layernorm"):
+                    use_bias: bool = True, norm: str = "layernorm",
+                    mlp: str = "gelu"):
     # derive from the dense family's specs exactly like moe_block_init
     # derives from block_init, so new attention params cannot diverge
-    s = block_specs(tp_axis, use_bias=use_bias, norm=norm)
-    for k in ("w1", "b1", "w2", "b2"):
+    s = block_specs(tp_axis, mlp=mlp, use_bias=use_bias, norm=norm)
+    for k in ("w1", "b1", "w2", "b2", "w3", "b3"):
         s.pop(k, None)
-    s["moe"] = moe_specs(ep_axis, tp_axis)
+    s["moe"] = moe_specs(ep_axis, tp_axis, mlp=mlp)
     return s
 
 
@@ -99,7 +96,8 @@ def moe_gpt_param_specs(cfg: MoEGPTConfig, ep_axis: Optional[str],
         **({"wpe": P()} if cfg.pos_embedding == "learned" else {}),
         **({"lnf_b": P()} if cfg.norm == "layernorm" else {}),
         "blocks": [moe_block_specs(ep_axis, tp_axis,
-                                   use_bias=cfg.use_bias, norm=cfg.norm)
+                                   use_bias=cfg.use_bias, norm=cfg.norm,
+                                   mlp=cfg.mlp)
                    for _ in range(cfg.n_layers)],
     }
 

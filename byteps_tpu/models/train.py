@@ -926,7 +926,8 @@ def make_gpt_moe_pp_train_step(
     params["blocks"] = stack_blocks(raw["blocks"])
     pspecs = {k: P() for k in params if k != "blocks"}
     pspecs["blocks"] = stacked_specs(
-        moe_block_specs(ep, tp, use_bias=cfg.use_bias, norm=cfg.norm), pp)
+        moe_block_specs(ep, tp, use_bias=cfg.use_bias, norm=cfg.norm,
+                        mlp=cfg.mlp), pp)
     state_axes, tx_kw, zero_numel = _dist_state_setup(
         mesh, params, pspecs, dp, zero_1)
     params, opt_state, ospecs = _shard_params_state(

@@ -148,7 +148,15 @@ def moe_ffn(
         # the local-expert axis out front for the expert matmuls
         slots = slots.transpose(1, 0, 2, 3).reshape(e_loc, ep * cap, d)
     h = jnp.einsum("ecd,edf->ecf", slots, params["w1"].astype(x.dtype))
-    h = activation(h + params["b1"][:, None, :].astype(x.dtype))
+    h = h + params["b1"][:, None, :].astype(x.dtype)
+    if "w3" in params:
+        # gated experts (structural dispatch, like the dense _mlp):
+        # silu(slots·w1) ∘ (slots·w3), per expert
+        g = jnp.einsum("ecd,edf->ecf", slots, params["w3"].astype(x.dtype))
+        g = g + params["b3"][:, None, :].astype(x.dtype)
+        h = jax.nn.silu(h) * g
+    else:
+        h = activation(h)
     y = jnp.einsum("ecf,efd->ecd", h, params["w2"].astype(x.dtype))
     if tp_axis is not None:
         # row-parallel: each tp shard computed a partial over its ff slice
@@ -165,19 +173,32 @@ def moe_ffn(
     return out.reshape(*lead, d).astype(x.dtype), aux.astype(jnp.float32)
 
 
-def moe_init(rng, d: int, ff: int, n_experts: int, std: float = 0.02):
-    """Expert-stacked MoE FFN params (shard w1/b1/w2/b2 ``P('ep')``)."""
-    k = jax.random.split(rng, 3)
-    return {
+def moe_init(rng, d: int, ff: int, n_experts: int, std: float = 0.02,
+             mlp: str = "gelu"):
+    """Expert-stacked MoE FFN params (shard w1/b1/w2/b2 ``P('ep')``).
+    ``mlp="swiglu"`` adds the per-expert gate stack ``w3/b3`` (llama-
+    style gated experts — the FFN mirrors the dense family's
+    ``_mlp`` structural dispatch)."""
+    if mlp not in ("gelu", "swiglu"):
+        raise ValueError(f"unknown mlp {mlp!r} — expected 'gelu' or "
+                         "'swiglu'")
+    k = jax.random.split(rng, 4)
+    p = {
         "wg": jax.random.normal(k[0], (d, n_experts), jnp.float32) * std,
         "w1": jax.random.normal(k[1], (n_experts, d, ff), jnp.float32) * std,
         "b1": jnp.zeros((n_experts, ff), jnp.float32),
         "w2": jax.random.normal(k[2], (n_experts, ff, d), jnp.float32) * std,
         "b2": jnp.zeros((n_experts, d), jnp.float32),
     }
+    if mlp == "swiglu":
+        p["w3"] = jax.random.normal(k[3], (n_experts, d, ff),
+                                    jnp.float32) * std
+        p["b3"] = jnp.zeros((n_experts, ff), jnp.float32)
+    return p
 
 
-def moe_specs(ep_axis: Optional[str], tp_axis: Optional[str] = None):
+def moe_specs(ep_axis: Optional[str], tp_axis: Optional[str] = None,
+              mlp: str = "gelu"):
     """PartitionSpec dict for :func:`moe_init` output: experts over ep,
     and (optionally) Megatron col/row sharding of each expert's ff dim
     over tp."""
@@ -188,4 +209,6 @@ def moe_specs(ep_axis: Optional[str], tp_axis: Optional[str] = None):
         "wg": P(),
         "w1": P(e, None, t), "b1": P(e, t),
         "w2": P(e, t, None), "b2": P(e),
+        **({"w3": P(e, None, t), "b3": P(e, t)} if mlp == "swiglu"
+           else {}),
     }

@@ -420,3 +420,55 @@ def test_moe_pp_zigzag_runs_and_converges():
         loss, params, opt_state = step(params, opt_state, tok, tgt)
         losses.append(float(loss))
     assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+
+
+@pytest.mark.slow
+def test_moe_swiglu_experts_ep_matches_dense_training():
+    """Gated (SwiGLU) experts: (dp=2, ep=2) tracks (dp=4) step-for-step
+    — the per-expert gate stack w3/b3 rides the same ep sharding and
+    all_to_all dispatch as the gelu experts."""
+    import dataclasses
+
+    from byteps_tpu.models.moe_gpt import MoEGPTConfig
+
+    cfg = dataclasses.replace(MoEGPTConfig.tiny(), mlp="swiglu")
+    _assert_moe_steps_match(cfg, (2, 2), ("dp", "ep"), (4,), ("dp",),
+                            seed=11, steps=4)
+
+
+def test_moe_swiglu_experts_differ_from_gelu_and_decode_agrees():
+    """Gated experts change the numerics (the gate path is live), and
+    the shared cached-decode block applies the same gated FFN — prefill
+    logits equal the training forward's."""
+    import dataclasses
+
+    from byteps_tpu.models.generate import gpt_apply_cached, init_cache
+    from byteps_tpu.models.moe_gpt import (
+        MoEGPTConfig, moe_gpt_init, moe_gpt_loss)
+
+    cfg = dataclasses.replace(MoEGPTConfig.tiny(), mlp="swiglu")
+    params = moe_gpt_init(jax.random.PRNGKey(4), cfg)
+    assert "w3" in params["blocks"][0]["moe"]
+    toks = np.random.RandomState(6).randint(0, cfg.vocab_size, (2, 16))
+    tgts = np.roll(toks, -1, axis=1)
+
+    loss = float(moe_gpt_loss(params, jnp.asarray(toks), jnp.asarray(tgts),
+                              cfg))
+    assert np.isfinite(loss)
+
+    # decode-path agreement: cached prefill nll == training loss - aux
+    cache = init_cache(cfg, 2)
+    logits, _ = gpt_apply_cached(params, jnp.asarray(toks), cache, cfg)
+    logp = jax.nn.log_softmax(jnp.asarray(logits), axis=-1)
+    nll = float(-jnp.take_along_axis(
+        logp, jnp.asarray(tgts)[..., None], axis=-1).mean())
+    aux = loss - nll
+    assert 0.0 <= aux < 1.0, (loss, nll)
+
+    # and the gate is live: zeroing w3 must change the loss
+    z = jax.tree_util.tree_map(lambda x: x, params)
+    z["blocks"] = [dict(b, moe=dict(b["moe"], w3=b["moe"]["w3"] * 0))
+                   for b in params["blocks"]]
+    loss_z = float(moe_gpt_loss(z, jnp.asarray(toks), jnp.asarray(tgts),
+                                cfg))
+    assert abs(loss_z - loss) > 1e-4
