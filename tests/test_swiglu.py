@@ -110,9 +110,16 @@ def test_swiglu_pipeline_factory():
     assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
 
 
-def test_moe_rejects_mlp_option():
+def test_moe_mlp_options():
+    """mlp="swiglu" now builds gated experts (per-expert w3/b3 stack —
+    see tests/test_moe.py for the numerics pins); unknown mlp values
+    still fail loudly at init."""
     from byteps_tpu.models import MoEGPTConfig, moe_gpt_init
 
-    bad = dataclasses.replace(MoEGPTConfig.tiny(), mlp="swiglu")
-    with pytest.raises(NotImplementedError, match="MoE"):
+    cfg = dataclasses.replace(MoEGPTConfig.tiny(), mlp="swiglu")
+    params = moe_gpt_init(jax.random.PRNGKey(0), cfg)
+    moe = params["blocks"][0]["moe"]
+    assert "w3" in moe and moe["w3"].shape == moe["w1"].shape
+    bad = dataclasses.replace(MoEGPTConfig.tiny(), mlp="nope")
+    with pytest.raises(ValueError, match="mlp"):
         moe_gpt_init(jax.random.PRNGKey(0), bad)
