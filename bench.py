@@ -224,6 +224,9 @@ _COMPRESSORS = {
     # TPU-shaped variant (see compression/topk.py)
     "topk-block": {"compressor": "topk", "k": 0.01, "ef": "vanilla",
                    "selection": "block"},
+    # scaled-e4m3 wire (quarter of raw fp32): one hardware cast per
+    # chunk — the cheapest compressed path
+    "fp8": {"compressor": "fp8", "ef": "vanilla"},
 }
 
 
