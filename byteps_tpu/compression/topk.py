@@ -45,6 +45,17 @@ def resolve_k(k: Union[int, float], n: int) -> int:
     return max(1, min(int(k), n))
 
 
+def block_shape(k: Union[int, float], n: int) -> tuple:
+    """(rows, block) with rows*block >= n covering n with ~k winner rows.
+    The single source of the block layout — the fused TPU path
+    (``TopkCompressor``) and the host wire codec (``TopkWire``) must
+    agree on it or their supports/byte counts drift."""
+    kk = resolve_k(k, n)
+    block = -(-n // kk)         # ceil: block size per winner
+    rows = -(-n // block)       # rows actually needed to cover n
+    return rows, block
+
+
 @register_compressor("topk")
 class TopkCompressor(Compressor):
     name = "topk"
@@ -67,11 +78,7 @@ class TopkCompressor(Compressor):
 
     # -- block layout -------------------------------------------------
     def _block_shape(self, n: int) -> tuple:
-        """(rows, block) with rows*block >= n covering n with k rows."""
-        k = resolve_k(self.k, n)
-        block = -(-n // k)          # ceil: block size per winner
-        rows = -(-n // block)       # rows actually needed to cover n
-        return rows, block
+        return block_shape(self.k, n)
 
     def compress(self, x: jnp.ndarray, rng: Optional[jnp.ndarray] = None) -> Payload:
         n = x.shape[0]

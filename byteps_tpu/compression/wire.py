@@ -152,23 +152,57 @@ class OnebitWire(WireCodec):
 
 
 class TopkWire(WireCodec):
-    """[u32 k][k u32 indices][k f32 values]; server scatter-adds."""
+    """[u32 count][count u32 indices][count f32 values]; server
+    scatter-adds. The count header makes the format self-describing, so
+    every selection strategy shares one decode and one server path:
+
+    * ``selection="exact"`` (default) — argpartition, count = k pairs.
+    * ``selection="block"`` — blockwise top-1 (the fused TPU path's
+      selection, ``topk.py``): count = rows (can be < k on ragged
+      chunks), keeping wire bytes consistent with
+      ``TopkCompressor.compressed_bytes``.
+    * ``selection="approx"`` — TPU-only selection strategy
+      (``lax.approx_max_k`` has no host analog); the wire uses exact
+      selection at the identical k-pair budget, which can only improve
+      recall.
+    """
 
     codec_id = WIRE_TOPK
 
-    def __init__(self, k=0.01):
+    def __init__(self, k=0.01, selection: str = "exact"):
+        if selection not in ("exact", "block", "approx"):
+            raise ValueError(f"unknown wire selection {selection!r} — "
+                             "expected 'exact', 'block', or 'approx'")
         self.k = k
+        # approx is TPU-only; on the host wire it aliases exact (same
+        # k-pair budget, strictly better recall)
+        self.selection = "exact" if selection == "approx" else selection
 
     def _k(self, n: int) -> int:
         from byteps_tpu.compression.topk import resolve_k
 
         return resolve_k(self.k, n)
 
+    def _block_shape(self, n: int):
+        from byteps_tpu.compression.topk import block_shape
+
+        return block_shape(self.k, n)
+
     def encode(self, x: np.ndarray, seed: int = 0) -> np.ndarray:
         xf = np.ascontiguousarray(x, np.float32)
         n = xf.size
-        k = self._k(n)
-        idx = np.argpartition(np.abs(xf), n - k)[n - k:].astype(np.uint32)
+        if self.selection == "block":
+            rows, block = self._block_shape(n)
+            pad = rows * block - n
+            xa = np.abs(xf)
+            if pad:
+                xa = np.concatenate([xa, np.full(pad, -1.0, np.float32)])
+            local = np.argmax(xa.reshape(rows, block), axis=1)
+            idx = (np.arange(rows) * block + local).astype(np.uint32)
+            k = rows
+        else:
+            k = self._k(n)
+            idx = np.argpartition(np.abs(xf), n - k)[n - k:].astype(np.uint32)
         out = np.empty(4 + k * 8, np.uint8)
         out[:4] = np.frombuffer(np.uint32(k).tobytes(), np.uint8)
         out[4:4 + k * 4] = idx.view(np.uint8)
@@ -185,6 +219,8 @@ class TopkWire(WireCodec):
         return dense
 
     def wire_bytes(self, n: int) -> int:
+        if self.selection == "block":
+            return 4 + self._block_shape(n)[0] * 8
         return 4 + self._k(n) * 8
 
 
@@ -350,7 +386,8 @@ def make_wire_codec(spec: CompressionSpec) -> Optional[WireCodec]:
     if name == "onebit":
         return OnebitWire(scaling=getattr(c, "scaling", True))
     if name == "topk":
-        return TopkWire(k=getattr(c, "k", 0.01))
+        return TopkWire(k=getattr(c, "k", 0.01),
+                        selection=getattr(c, "selection", "exact"))
     if name == "randomk":
         return RandomkWire(
             k=getattr(c, "k", 0.01), scale=getattr(c, "scale", True)

@@ -156,11 +156,19 @@ def _attn_cached_half(x, p, cache_k, cache_v, pos0, head_dim, tp_axis,
     the T5 decoder (models/t5.py t5_decode_cached) share this one
     cache-append path.
     """
+    from byteps_tpu.models.lora import lora_delta
+
     B, T = x.shape[:2]
     h = norm_fn(x, p["ln1_g"], p.get("ln1_b"), norm_eps)
     q = col_parallel_matmul(h, p["wq"].astype(x.dtype), _bias(p, "bq", x, use_bias))
     k = col_parallel_matmul(h, p["wk"].astype(x.dtype), _bias(p, "bk", x, use_bias))
     v = col_parallel_matmul(h, p["wv"].astype(x.dtype), _bias(p, "bv", x, use_bias))
+    if "lora" in p:
+        # keep grafted (unmerged) trees decode-exact with gpt_forward —
+        # without this the cached path silently ran the frozen base
+        q = q + lora_delta(h, p, "wq")
+        k = k + lora_delta(h, p, "wk")
+        v = v + lora_delta(h, p, "wv")
     h_loc = q.shape[-1] // head_dim
     kv_loc = k.shape[-1] // head_dim    # GQA: the cache stores kv heads only
     q = q.reshape(B, T, h_loc, head_dim)
@@ -193,9 +201,11 @@ def _attn_cached_half(x, p, cache_k, cache_v, pos0, head_dim, tp_axis,
         o = _cached_attention(q, _cache_read(cache_k, x.dtype),
                               _cache_read(cache_v, x.dtype), pos0)
     o = o.reshape(B, T, h_loc * head_dim)
-    x = x + row_parallel_matmul(o, p["wo"].astype(x.dtype), tp_axis,
-                                _bias(p, "bo", x, use_bias))
-    return x, cache_k, cache_v
+    attn_out = row_parallel_matmul(o, p["wo"].astype(x.dtype), tp_axis,
+                                   _bias(p, "bo", x, use_bias))
+    if "lora" in p:
+        attn_out = attn_out + lora_delta(o, p, "wo", tp_axis)
+    return x + attn_out, cache_k, cache_v
 
 
 def _block_step(x, p, cache_k, cache_v, pos0, cfg, tp_axis, ep_axis,

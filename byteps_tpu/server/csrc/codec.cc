@@ -141,7 +141,12 @@ uint8_t float_to_fp8(float f) {
     rounded -= 8;  // strip the implicit bit
   }
   if (out_exp > 15 || (out_exp == 15 && rounded >= 7)) {
-    return sign | 0x7E;  // clamp to ±448 (inputs are pre-clipped)
+    // e4m3fn has no inf and S.1111.111 is NaN: anything rounding past
+    // ±448 (i.e. |x| > 464 after RNE) becomes NaN, matching the
+    // ml_dtypes cast bit-for-bit on ALL inputs. The scaled wire path
+    // pre-clips to ±448 before this function, so production encodes
+    // never take this branch.
+    return sign | 0x7F;
   }
   return sign | static_cast<uint8_t>(out_exp << 3) |
          static_cast<uint8_t>(rounded);

@@ -115,6 +115,13 @@ def main() -> None:
     fresh = type(hf_model)(hf_cfg).eval()
     missing, unexpected = fresh.load_state_dict(sd, strict=False)
     assert not unexpected, unexpected
+    # a partial export would leave `fresh` half-initialized — the only
+    # tolerable misses are non-persistent buffers (e.g. GPT-2's causal
+    # `attn.bias` masks), mirroring tests/test_import_hf.py
+    persistent_missing = [k for k in missing
+                          if not k.endswith((".attn.bias",
+                                             ".attn.masked_bias"))]
+    assert not persistent_missing, persistent_missing
     print("exported back to HF:", type(fresh).__name__,
           f"({sum(v.numel() for v in sd.values())} params)")
 

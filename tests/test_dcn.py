@@ -183,6 +183,27 @@ def test_wire_codecs_roundtrip():
     assert kept.size == 10
     top = np.argsort(np.abs(x))[-10:]
     assert set(kept) == set(top)
+    # topk block selection: wire twin of the fused TPU path — same
+    # support and values as TopkCompressor(selection="block"), wire
+    # bytes consistent with compressed_bytes (header + rows pairs)
+    from byteps_tpu.compression.topk import TopkCompressor
+
+    import jax.numpy as jnp
+
+    tb = wire.TopkWire(k=10, selection="block")
+    comp = TopkCompressor(k=10, selection="block")
+    dec = tb.decode(tb.encode(x), x.size)
+    want = np.asarray(comp.decompress(comp.compress(jnp.asarray(x)),
+                                      x.size))
+    np.testing.assert_allclose(dec, want, rtol=1e-6)
+    assert tb.wire_bytes(x.size) == 4 + comp.compressed_bytes(x.size)
+    # spec plumbing: selection="block" reaches the wire codec
+    from byteps_tpu.compression.base import from_params
+
+    blk = wire.make_wire_codec(
+        from_params({"compressor": "topk", "k": 10,
+                     "selection": "block"}))
+    assert isinstance(blk, wire.TopkWire) and blk.selection == "block"
     # randomk: same seed -> same support; values survive (scaled n/k)
     rk = wire.RandomkWire(k=16, scale=False)
     payload = rk.encode(x, seed=42)
@@ -277,13 +298,19 @@ def test_fp8_wire_bit_exact_twins_and_server_sum():
         py = float(np.frombuffer(bytes([b]), ml_dtypes.float8_e4m3fn)[0]
                    .astype(np.float32))
         assert (np.isnan(cpp) and np.isnan(py)) or cpp == py, (b, cpp, py)
-    # encode: random + boundary grid, pre-clamped like the codec does
+    # encode: random + boundary grid — UNclipped on purpose, including
+    # the overflow region past |x| = 464 where e4m3fn (no inf) goes NaN:
+    # the twin must agree with ml_dtypes on all inputs, not just the
+    # pre-clipped contract the scaled wire path feeds it
     rng = np.random.default_rng(11)
     xs = np.concatenate([
         rng.standard_normal(4096).astype(np.float32) * 100,
         np.linspace(-448, 448, 1001, dtype=np.float32),
+        np.linspace(-2000, 2000, 257, dtype=np.float32),
         np.array([0.0, -0.0, 448.0, -448.0, 2 ** -9, 2 ** -10,
-                  1.5 * 2 ** -9], np.float32),
+                  1.5 * 2 ** -9, 464.0, -464.0, np.nextafter(
+                      np.float32(464.0), np.float32(1e9)), 465.0, -465.0,
+                  480.0, 512.0, 1e30, -1e30], np.float32),
     ])
     enc_py = xs.astype(ml_dtypes.float8_e4m3fn).view(np.uint8)
     enc_cpp = np.array([lib.bps_float_to_fp8(float(v)) for v in xs],

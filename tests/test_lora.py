@@ -188,6 +188,31 @@ def test_merge_equals_graft():
     assert "lora" not in merged["blocks"][0]
 
 
+def test_grafted_tree_decodes_exactly():
+    """The KV-cache path applies unmerged adapters too: prefill logits
+    on a grafted tree match gpt_forward on the same tree (which matches
+    the merged tree by test_merge_equals_graft) — previously the cached
+    attention silently ran the frozen base for unmerged trees."""
+    from byteps_tpu.models import gpt_forward
+    from byteps_tpu.models.generate import gpt_apply_cached, init_cache
+
+    tokens, targets = synthetic_batch(jax.random.PRNGKey(6), CFG, 2, 24)
+    mesh = _mesh((1,), ("dp",))
+    step, adapters, opt, base, bsh = make_gpt_lora_train_step(
+        CFG, mesh, optax.adam(1e-2), rank=RANK, alpha=ALPHA,
+        targets=("wq", "wk", "wv", "wo", "w1", "w2"))
+    _, adapters = _run(step, adapters, opt, base, bsh, tokens, targets,
+                       steps=3)
+    grafted = graft_lora(jax.device_get(base), jax.device_get(adapters),
+                         SCALE)
+    want = gpt_forward(grafted, tokens, CFG)
+    cache = init_cache(CFG, batch=tokens.shape[0], max_seq=tokens.shape[1])
+    got, cache = gpt_apply_cached(grafted, jnp.asarray(tokens), cache, CFG)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+    assert int(cache.length) == tokens.shape[1]
+
+
 def test_llama_lean_tree_supports_lora():
     """Adapters graft onto the bias-free rmsnorm tree (the HF-bridge
     import target) — fine-tune an imported llama with LoRA."""
