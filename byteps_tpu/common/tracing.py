@@ -131,6 +131,16 @@ class TraceRecorder:
             if self.enabled and self._step > self.end_step:
                 self.dump()
 
+    def host_step(self, args: Optional[Dict[str, Any]] = None) -> None:
+        """Host-side per-call step marker — the fallback for backends
+        whose PJRT plugin rejects host callbacks (the axon TPU tunnel),
+        where the fused path's in-program ``jax.debug.callback`` marker
+        cannot run. Fired by the train-step wrapper installed in
+        ``models/train.py _finalize_step``; advances the window by one
+        per dispatched step (dispatch-time, not completion-time — step
+        numbering for the [start, end] window, not a latency probe)."""
+        self.fused_step(self._step, args or {"marker": "host"})
+
     @property
     def active(self) -> bool:
         return (

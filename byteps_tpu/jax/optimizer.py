@@ -488,19 +488,34 @@ def DistributedOptimizer(
 def _host_callbacks_supported() -> bool:
     """Some PJRT plugins (the axon TPU tunnel) reject host send/recv
     callbacks outright; tracing must degrade to eager-path events there
-    instead of crashing every traced step."""
-    backend = jax.default_backend()
-    if backend not in ("cpu", "gpu", "tpu"):
-        if not getattr(_host_callbacks_supported, "_warned", False):
-            from byteps_tpu.common.logging import get_logger
+    instead of crashing every traced step. Backend names lie (the tunnel
+    registers as "tpu" while its plugin refuses callbacks), so the only
+    reliable test is a one-time probe: run a tiny jitted debug.callback
+    and see whether the runtime accepts it. Probed once per process,
+    only on tracing sessions (the caller gates on cfg.trace_on)."""
+    cached = getattr(_host_callbacks_supported, "_cached", None)
+    if cached is not None:
+        return cached
 
-            get_logger("jax.optimizer").warning(
-                "fused-path trace markers disabled: backend %r does not "
-                "support host callbacks", backend,
-            )
-            _host_callbacks_supported._warned = True  # type: ignore[attr-defined]
-        return False
-    return True
+    ok = True
+    try:
+        @jax.jit
+        def _probe(x):
+            jax.debug.callback(lambda _v: None, x)
+            return x + 1
+
+        _probe(jnp.zeros(())).block_until_ready()
+    except Exception as e:  # noqa: BLE001 — any refusal means unsupported
+        ok = False
+        from byteps_tpu.common.logging import get_logger
+
+        get_logger("jax.optimizer").warning(
+            "fused-path trace markers disabled: this backend rejects "
+            "host callbacks (%s) — step advance falls back to the "
+            "host-side wrapper/eager events", type(e).__name__,
+        )
+    _host_callbacks_supported._cached = ok  # type: ignore[attr-defined]
+    return ok
 
 
 def _fused_trace_callback(count, total_elems: int, chunks: int) -> None:

@@ -22,6 +22,7 @@ from byteps_tpu.models.gpt import (
     _layernorm,
     block_init,
     block_specs,
+    head_dot,
     transformer_block,
 )
 
@@ -111,9 +112,12 @@ def bert_forward(params, tokens: jnp.ndarray, cfg: BertConfig,
     apply_block = maybe_remat(apply_block, remat)
     for p in params["blocks"]:
         x = apply_block(x, p)
-    h = jax.nn.gelu(x.astype(jnp.float32) @ params["mlm_w"] + params["mlm_b"])
+    # MLM head via head_dot: activation-dtype operands, f32 accumulation
+    # — bit-identical at f32 (default/test configs), MXU-native at bf16
+    h = jax.nn.gelu(head_dot(x, params["mlm_w"]) + params["mlm_b"])
     h = _layernorm(h, params["mlm_ln_g"], params["mlm_ln_b"])
-    return h @ params["wte"].T.astype(jnp.float32) + params["mlm_bias"]
+    return (head_dot(h.astype(x.dtype), params["wte"].T)
+            + params["mlm_bias"])
 
 
 def bert_mlm_loss(params, tokens, targets, mask, cfg: BertConfig,

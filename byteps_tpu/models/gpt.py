@@ -401,15 +401,79 @@ def _embed(params, tokens: jnp.ndarray, cfg: GPTConfig,
             + jnp.take(params["wpe"], pos, axis=0)).astype(cfg.dtype)
 
 
+@jax.custom_vjp
+def head_dot(h: jnp.ndarray, head: jnp.ndarray) -> jnp.ndarray:
+    """Readout matmul in the ACTIVATION dtype with f32 accumulation.
+
+    ``h (..., d) @ head (d, V) → f32 logits``. The head weight casts to
+    ``h.dtype`` for the dot — the same per-op cast every block matmul
+    does (``p["wq"].astype(x.dtype)``); the readout was the one op that
+    upcast to f32 instead, and the round-5 xprof attribution measured
+    those f32 MXU passes at ~3× the cost (flagship: 2.4 ms of a 14 ms
+    step; gpt2m: 4.0 ms) for no numerics the f32 *accumulation* doesn't
+    already provide. With f32 activations (every test/parity config)
+    the casts are no-ops and this is bit-identical to the f32 matmul.
+
+    The custom VJP keeps the backward dots in the activation dtype too
+    (cotangent rounds to ``h.dtype``, matching what the block weight
+    grads already do through their bf16 dot outputs) while the head
+    gradient accumulates — and is returned — in f32, so the optimizer
+    update on the fp32 master weight loses nothing.
+    """
+    from byteps_tpu.ops.flash_attention import _unify_vma
+
+    hu, hd = _unify_vma(h, head.astype(h.dtype))
+    return jax.lax.dot_general(
+        hu, hd, (((h.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _head_dot_fwd(h, head):
+    return head_dot(h, head), (h, head)
+
+
+def _head_dot_bwd(res, g):
+    # Cotangent vma must match the primals' (shard_map check_vma): the
+    # activation grad keeps h's varying axes; the head grad psums over
+    # every axis h varies on that head doesn't — exactly the
+    # pvary-transpose adjoint plain AD inserts for a replicated weight
+    # used in a varying context (cf. the _novma_collective_fix note in
+    # jax/optimizer.py).
+    from byteps_tpu.ops.flash_attention import _unify_vma
+
+    h, head = res
+    gc = g.astype(h.dtype)
+    gcu, hd, hu = _unify_vma(gc, head.astype(h.dtype), h)
+    dh = jax.lax.dot_general(
+        gcu, hd, (((g.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(h.dtype)
+    lead = tuple(range(h.ndim - 1))
+    dhead = jax.lax.dot_general(
+        hu, gcu, ((lead, lead), ((), ())),
+        preferred_element_type=jnp.float32).astype(head.dtype)
+    try:
+        extra = tuple(jax.typeof(h).vma - jax.typeof(head).vma)
+    except (AttributeError, TypeError):
+        extra = ()
+    if extra:
+        dhead = jax.lax.psum(dhead, extra)
+    return dh, dhead
+
+
+head_dot.defvjp(_head_dot_fwd, _head_dot_bwd)
+
+
 def _readout(params, h: jnp.ndarray, norm_fn=_layernorm,
              norm_eps: float = 1e-5) -> jnp.ndarray:
-    """Final norm → fp32 readout (weight-tied ``wte.T`` unless the tree
-    carries an untied ``lm_head``), shared by the dense and pipelined
-    paths so their numerics cannot diverge."""
+    """Final norm → f32-accumulated readout in the activation dtype
+    (weight-tied ``wte.T`` unless the tree carries an untied
+    ``lm_head``), shared by the dense and pipelined paths so their
+    numerics cannot diverge. f32 activations (the default config, every
+    parity test, the HF bridge) keep the exact f32 matmul."""
     h = norm_fn(h, params["lnf_g"], params.get("lnf_b"), norm_eps)
     head = (params["lm_head"] if "lm_head" in params
             else params["wte"].T)
-    return h.astype(jnp.float32) @ head.astype(jnp.float32)
+    return head_dot(h, head.astype(jnp.float32))
 
 
 def _nll(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:

@@ -345,8 +345,26 @@ def _finalize_step(build_jit, partition_bytes, dp, tunable=True):
     if cfg.auto_tune and dp is not None and tunable:
         from byteps_tpu.jax.tuned_step import AutoTunedStep
 
-        return AutoTunedStep(build_jit, partition_bytes or cfg.partition_bytes)
-    return build_jit(partition_bytes)
+        step = AutoTunedStep(build_jit, partition_bytes or cfg.partition_bytes)
+    else:
+        step = build_jit(partition_bytes)
+    if cfg.trace_on:
+        from byteps_tpu.jax.optimizer import _host_callbacks_supported
+
+        if not _host_callbacks_supported():
+            # the in-program debug-callback step marker cannot run on
+            # this backend (axon tunnel) — advance the trace window from
+            # the host per dispatched step instead, so BYTEPS_TRACE_ON /
+            # BYTEPS_TRACE_XPROF work everywhere
+            from byteps_tpu.common.tracing import get_tracer
+
+            inner = step
+
+            def step(*a, **k):  # noqa: F811 — deliberate rebind
+                out = inner(*a, **k)
+                get_tracer().host_step()
+                return out
+    return step
 
 
 def _collapse_vma(x):

@@ -61,12 +61,71 @@ _NEG = -1e30
 _MAX_HEAD_DIM = 256     # D beyond this spills VMEM tile budgets → fallback
 
 
-def _pick_block(S: int) -> Optional[int]:
-    """Largest MXU-friendly tile dividing S (None → jnp fallback)."""
-    for b in (256, 128, 64, 32, 16, 8):
+def _pick_block(S: int, prefer: Tuple[int, ...] = ()) -> Optional[int]:
+    """Largest tile from ``prefer + (256..8)`` dividing S (None → jnp
+    fallback). The default list keeps the 8..256 contract that
+    ``supported()``/``flash_decode`` are documented and tuned against;
+    the train kernels pass explicit larger preferences (below)."""
+    cands = prefer + (256, 128, 64, 32, 16, 8)
+    for b in cands:
         if S % b == 0 and S >= b:
             return b
     return None
+
+
+# Block size is the dominant throughput knob on this kernel family —
+# per-tile pipeline overhead (the sequential online-softmax revisit
+# chain through VMEM scratch) swamps the VPU/MXU work at 256² tiles.
+# Measured on v5e, gpt2m shapes (BH=32, S=1024, D=64, bf16), fwd+bwd
+# via the train-loss path: 256-tiles 24.0 ms, 512 14.6 ms, 1024
+# 14.9 ms (fwd alone: 8.2 / 4.6 / 3.7 ms) — the forward prefers
+# whole-sequence k-tiles, the backward 512. BYTEPS_FLASH_BLOCK=N,...
+# prepends experiment overrides (train kernels only).
+_FWD_PREFER = (1024, 512)
+_BWD_PREFER = (512,)
+_VMEM_BUDGET = 12 * 1024 * 1024   # leave headroom under the ~16MB VMEM
+
+
+def _env_prefer() -> Tuple[int, ...]:
+    force = os.environ.get("BYTEPS_FLASH_BLOCK")
+    return tuple(int(x) for x in force.split(",")) if force else ()
+
+
+def _train_blocks(Sq: int, Sk: int, D: int, itemsize: int,
+                  prefer: Tuple[int, ...],
+                  n_inter: int = 2) -> Tuple[int, int]:
+    """(bq, bk) for the train kernels: the preferred large tiles, walked
+    back down the candidate list until the tile set fits VMEM — the
+    big-tile retune was measured at bf16/D=64; f32 or D→256 shapes must
+    degrade gracefully instead of blowing the Mosaic budget.
+
+    ``n_inter`` models the kernel's live (bq, bk) f32 intermediates:
+    2 for the forward (s, p), 4 for the backwards (s, p, dp, ds) — the
+    backward call sites pass 4, which is what steers them to 512 tiles
+    while the forward keeps whole-sequence k-tiles."""
+    def fits(bq: int, bk: int) -> bool:
+        inter = n_inter * bq * bk * 4
+        # q,(k,v)(,do) blocks double-buffered by the pallas pipeline
+        io = 2 * 2 * (2 * bq + 2 * bk) * D * itemsize
+        scratch = (bq + 2 * bk) * D * 4             # f32 accumulators
+        return inter + io + scratch <= _VMEM_BUDGET
+
+    prefer = _env_prefer() + prefer
+    bq = _pick_block(Sq, prefer)
+    bk = _pick_block(Sk, prefer)
+    while not fits(bq, bk):
+        # shrink the larger tile first (s/p cost is the bq·bk product)
+        nxt_q = _pick_block(Sq, tuple(p for p in prefer if p < bq))
+        nxt_k = _pick_block(Sk, tuple(p for p in prefer if p < bk))
+        if bq >= bk and nxt_q is not None and nxt_q < bq:
+            bq = nxt_q
+        elif nxt_k is not None and nxt_k < bk:
+            bk = nxt_k
+        elif nxt_q is not None and nxt_q < bq:
+            bq = nxt_q
+        else:
+            break   # smallest divisible tiles; let Mosaic have it
+    return bq, bk
 
 
 from byteps_tpu.ops.backend import use_pallas  # noqa: E402 (re-export)
@@ -152,36 +211,54 @@ def _fwd_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     q_start, k_start = qi * bq, ki * bk
     q_off, k_off = _read_offsets(qoff_ref, koff_ref)
 
-    def _tile():
-        q = q_ref[0].astype(jnp.float32)                     # (bq, D)
-        k = k_ref[0].astype(jnp.float32)                     # (bk, D)
-        v = v_ref[0].astype(jnp.float32)                     # (bk, D)
+    def _tile(masked: bool):
+        # operands stay in the INPUT dtype (bf16 in → MXU-native bf16
+        # matmuls); preferred_element_type=f32 keeps the accumulation
+        # exact, so s is bit-identical to an f32-operand dot for bf16
+        # inputs (bf16→f32 casts are exact, the MXU multiplies bf16
+        # pairs into an f32 accumulator either way)
+        q = q_ref[0]                                         # (bq, D)
+        k = k_ref[0]                                         # (bk, D)
+        v = v_ref[0]                                         # (bk, D)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale      # (bq, bk)
-        if causal:
+        if masked:
             s = _mask_tile(s, q_off, k_off, q_start, k_start, bq, bk)
         m_prev = m_scr[:]                                    # (bq, 1)
         m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)                               # (bq, bk)
-        if causal:
+        if masked:
             # exp(_NEG - m) underflows to 0 except when the whole row is
             # masked (m == _NEG) — zero those lanes explicitly
             p = jnp.where(s > _NEG / 2, p, 0.0)
         l_scr[:] = l_scr[:] * alpha + p.sum(axis=-1, keepdims=True)
+        # p rounds to the input dtype for the MXU (standard flash-on-TPU
+        # practice; p ∈ [0,1] so bf16 rounding is ≤ 2⁻⁸ relative — the
+        # same order as the bf16 output rounding); f32 inputs keep f32 p
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)              # (bq, D)
         m_scr[:] = m_new
 
     if causal:
-        # tile live iff some global q_pos >= some global k_pos
-        @pl.when(q_off + q_start + bq - 1 >= k_off + k_start)
+        # tile live iff some global q_pos >= some global k_pos; INTERIOR
+        # (min q_pos ≥ max k_pos, every pair live) skips the mask iotas
+        # and the underflow where() — with big tiles the diagonal is a
+        # 1/nk fraction, so most tiles take the cheap path
+        live = q_off + q_start + bq - 1 >= k_off + k_start
+        interior = q_off + q_start >= k_off + k_start + bk - 1
+
+        @pl.when(live & interior)
         def _():
-            _tile()
+            _tile(False)
+
+        @pl.when(live & jnp.logical_not(interior))
+        def _():
+            _tile(True)
     else:
-        _tile()
+        _tile(False)
 
     @pl.when(ki == nk - 1)
     def _finish():
@@ -210,7 +287,7 @@ def _fwd(q3, k3, v3, qoff, koff, causal: bool, interpret: bool,
     (o (B·H, Sq, D), lse (B·H, Sq, 1) f32)."""
     BH, Sq, D = q3.shape
     Sk = k3.shape[1]
-    bq, bk = _pick_block(Sq), _pick_block(Sk)
+    bq, bk = _train_blocks(Sq, Sk, D, q3.dtype.itemsize, _FWD_PREFER)
     nq, nk = Sq // bq, Sk // bk
     scale = 1.0 / (D ** 0.5)
     kv = _kv_index(heads, kv_heads)
@@ -261,36 +338,46 @@ def _dq_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     q_start, k_start = qi * bq, ki * bk
     q_off, k_off = _read_offsets(qoff_ref, koff_ref)
 
-    def _tile():
-        q = q_ref[0].astype(jnp.float32)                     # (bq, D)
-        k = k_ref[0].astype(jnp.float32)                     # (bk, D)
-        v = v_ref[0].astype(jnp.float32)                     # (bk, D)
-        do = do_ref[0].astype(jnp.float32)                   # (bq, D)
+    def _tile(masked: bool):
+        # input-dtype operands on every MXU dot (see _fwd_kernel note);
+        # s/p/ds math stays f32, ds rounds to the input dtype only at
+        # the dq GEMM boundary
+        q = q_ref[0]                                         # (bq, D)
+        k = k_ref[0]                                         # (bk, D)
+        v = v_ref[0]                                         # (bk, D)
+        do = do_ref[0]                                       # (bq, D)
         lse = lse_ref[0]                                     # (bq, 1)
         delta = dl_ref[0]                                    # (bq, 1)
         dlse = dlse_ref[0]                                   # (bq, 1)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
+        if masked:
             s = _mask_tile(s, q_off, k_off, q_start, k_start, bq, bk)
         p = jnp.exp(s - lse)                                  # (bq, bk)
-        if causal:
+        if masked:
             p = jnp.where(s > _NEG / 2, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)               # (bq, bk)
-        ds = p * (dp - delta + dlse)
+        ds = (p * (dp - delta + dlse)).astype(k_ref.dtype)
         dq_scr[:] += scale * jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # (bq, D)
 
     if causal:
-        @pl.when(q_off + q_start + bq - 1 >= k_off + k_start)
+        live = q_off + q_start + bq - 1 >= k_off + k_start
+        interior = q_off + q_start >= k_off + k_start + bk - 1
+
+        @pl.when(live & interior)
         def _():
-            _tile()
+            _tile(False)
+
+        @pl.when(live & jnp.logical_not(interior))
+        def _():
+            _tile(True)
     else:
-        _tile()
+        _tile(False)
 
     @pl.when(ki == nk - 1)
     def _finish():
@@ -312,39 +399,47 @@ def _dkv_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     q_start, k_start = qi * bq, ki * bk
     q_off, k_off = _read_offsets(qoff_ref, koff_ref)
 
-    def _tile():
-        q = q_ref[0].astype(jnp.float32)                     # (bq, D)
-        k = k_ref[0].astype(jnp.float32)                     # (bk, D)
-        v = v_ref[0].astype(jnp.float32)                     # (bk, D)
-        do = do_ref[0].astype(jnp.float32)                   # (bq, D)
+    def _tile(masked: bool):
+        # input-dtype operands on every MXU dot (see _fwd_kernel note)
+        q = q_ref[0]                                         # (bq, D)
+        k = k_ref[0]                                         # (bk, D)
+        v = v_ref[0]                                         # (bk, D)
+        do = do_ref[0]                                       # (bq, D)
         lse = lse_ref[0]                                     # (bq, 1)
         delta = dl_ref[0]                                    # (bq, 1)
         dlse = dlse_ref[0]                                   # (bq, 1)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
+        if masked:
             s = _mask_tile(s, q_off, k_off, q_start, k_start, bq, bk)
         p = jnp.exp(s - lse)                                  # (bq, bk)
-        if causal:
+        if masked:
             p = jnp.where(s > _NEG / 2, p, 0.0)
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do_ref.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # (bk, D)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)               # (bq, bk)
-        ds = p * (dp - delta + dlse)
+        ds = (p * (dp - delta + dlse)).astype(q_ref.dtype)
         dk_scr[:] += scale * jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # (bk, D)
 
     if causal:
-        @pl.when(q_off + q_start + bq - 1 >= k_off + k_start)
+        live = q_off + q_start + bq - 1 >= k_off + k_start
+        interior = q_off + q_start >= k_off + k_start + bk - 1
+
+        @pl.when(live & interior)
         def _():
-            _tile()
+            _tile(False)
+
+        @pl.when(live & jnp.logical_not(interior))
+        def _():
+            _tile(True)
     else:
-        _tile()
+        _tile(False)
 
     @pl.when(j == nq * group - 1)
     def _finish():
@@ -358,7 +453,8 @@ def _bwd(q3, k3, v3, o3, lse, qoff, koff, do3, dlse,
          causal: bool, interpret: bool, heads: int, kv_heads: int):
     BH, Sq, D = q3.shape
     BHkv, Sk = k3.shape[0], k3.shape[1]
-    bq, bk = _pick_block(Sq), _pick_block(Sk)
+    bq, bk = _train_blocks(Sq, Sk, D, q3.dtype.itemsize, _BWD_PREFER,
+                           n_inter=4)
     nq, nk = Sq // bq, Sk // bk
     group = heads // kv_heads
     kv = _kv_index(heads, kv_heads)
