@@ -117,6 +117,7 @@ def compressed_allreduce_local(
     average: bool = True,
     two_way: bool = True,
     ef_residual: Optional[jnp.ndarray] = None,
+    return_residual: bool = False,
 ):
     """Per-device body of the compressed all-reduce.
 
@@ -129,9 +130,26 @@ def compressed_allreduce_local(
     If ``ef_residual`` is given, error feedback is applied: the compressed
     input is ``g + ef_residual`` and the return value is a tuple
     ``(out, new_residual)`` with ``new_residual = input − D(C(input))``.
+    ``return_residual=True`` with ``ef_residual=None`` returns the same
+    tuple for a PRE-ADDED input (the fused path hoists the whole-flat
+    EF add out of the per-chunk bodies so the chunk views stay pure
+    reshapes): the input is taken as-is and the residual is
+    ``g − D(C(g))``.
     """
     L = g.shape[0]
     g = g.astype(jnp.float32)
+    if n == 1:
+        # single-worker fast path (reference single-machine mode): no
+        # exchange exists, so the whole body is one codec round trip —
+        # EF add included — fusable into a single kernel pass by the
+        # compressor (TopkCompressor's tiled layout does; see
+        # ops/topk_kernels.py block_roundtrip). Key matches the n>1
+        # path's own-segment key (fold_in(rng, 0)).
+        dense, resid = compressor.roundtrip(
+            g, jax.random.fold_in(rng, 0), e=ef_residual)
+        if ef_residual is None and not return_residual:
+            return dense
+        return dense, resid
     if ef_residual is not None:
         g = g + ef_residual
     payload, seg_keys, recv, seg = _compress_push(g, rng, compressor, axis, n)
@@ -166,7 +184,7 @@ def compressed_allreduce_local(
         out_segs = gathered["dense"]
     out = out_segs.reshape(-1)[:L]
     out = out / n if average else out
-    if ef_residual is None:
+    if ef_residual is None and not return_residual:
         return out
     return out, _ef_residual(g, payload, seg_keys, compressor, seg, L)
 

@@ -78,6 +78,24 @@ class Compressor:
             )(payloads, rng_keys)
         return dec.sum(axis=0)
 
+    def roundtrip(self, x: jnp.ndarray,
+                  rng: Optional[jnp.ndarray] = None,
+                  e: Optional[jnp.ndarray] = None):
+        """With ``xin = x + e`` (or just ``x``): ``(D(C(xin)),
+        xin − D(C(xin)))`` — the single-worker aggregation body
+        (reference single-machine mode: compress, "sum" of one,
+        decompress) plus the EF add and residual, in one call so
+        subclasses can fuse the whole round trip — EF included — into a
+        single kernel pass. The default composes the generic methods;
+        semantics match the n == 1 collective exactly for deterministic
+        codecs (D∘C is idempotent for sign/topk/randomk codecs, so
+        skipping the two_way re-compression of an already-compressed
+        value changes nothing)."""
+        xin = x if e is None else x + e
+        dense = self.decompress(self.compress(xin, rng), x.shape[0],
+                                jnp.float32, rng)
+        return dense, xin - dense
+
     def compressed_bytes(self, n: int, itemsize: int = 4) -> int:
         return n * itemsize
 

@@ -56,6 +56,25 @@ def block_shape(k: Union[int, float], n: int) -> tuple:
     return rows, block
 
 
+def tiled_shape(k: Union[int, float], n: int):
+    """(J, g) for the tiling-native block layout, or None.
+
+    Chunk views as ``(J, g, 128)`` with the last axis the flat array's
+    native 128-lane tiling (the reshape is a layout no-op on TPU);
+    winner (j, lane) covers ``{(j·g + i)·128 + lane : i < g}`` and
+    winners number exactly ``resolve_k``. Shared by the fused path and
+    the numpy wire twin — both must pick the same layout for the same
+    (k, n) or their supports drift. None → the strided (block, rows)
+    fallback layout."""
+    kk = resolve_k(k, n)
+    if kk % 128 or n % 128 or kk >= n:
+        return None
+    J, M = kk // 128, n // 128
+    if M % J:
+        return None
+    return J, M // J
+
+
 @register_compressor("topk")
 class TopkCompressor(Compressor):
     name = "topk"
@@ -80,25 +99,72 @@ class TopkCompressor(Compressor):
     def _block_shape(self, n: int) -> tuple:
         return block_shape(self.k, n)
 
+    def _tiled_shape(self, n: int):
+        """See :func:`tiled_shape` — the default 4 MB ratio-k partitions
+        always qualify; ragged tails and odd absolute-k configs fall
+        back to the strided (block, rows) layout."""
+        return tiled_shape(self.k, n)
+
     def compress(self, x: jnp.ndarray, rng: Optional[jnp.ndarray] = None) -> Payload:
         n = x.shape[0]
         k = resolve_k(self.k, n)
         xf = x.astype(jnp.float32)
         if self.selection == "block" and k < n:
+            tiled = self._tiled_shape(n)
+            if tiled is not None:
+                # tiling-native fast path: (J, g, 128) view is a layout
+                # no-op on the flat chunk (last axis = the native lane
+                # tiling), so selection runs with ZERO relayout — the
+                # round-5 xprof showed the 2D-reshape relayouts costing
+                # ~22 ms/step on GPT-2-medium before this
+                J, g = tiled
+                x3 = xf.reshape(J, g, 128)
+                xa = jnp.abs(x3)
+                am = xa.max(axis=1, keepdims=True)             # (J,1,128)
+                ii = jax.lax.broadcasted_iota(jnp.int32, (J, g, 128), 1)
+                # first-max tie-break == jnp.argmax
+                local = jnp.where(xa == am, ii, g).min(axis=1)  # (J,128)
+                vals = jnp.where(ii == local[:, None, :], x3,
+                                 0.0).sum(axis=1)               # (J,128)
+                lane = jnp.arange(128, dtype=jnp.int32)[None, :]
+                jj = jnp.arange(J, dtype=jnp.int32)[:, None]
+                idx = ((jj * g + local) * 128 + lane)
+                return {"indices": idx.reshape(-1),
+                        "values": vals.reshape(-1)}
             rows, block = self._block_shape(n)
             pad = rows * block - n
-            xa = jnp.abs(xf)
-            if pad:
-                # padding is -1 < 0 <= |x|: a padded slot can never win
-                # unless the whole row is padding (sliced away below)
-                xa = jnp.concatenate([xa, jnp.full((pad,), -1.0)])
-                xv = jnp.concatenate([xf, jnp.zeros((pad,))])
+            # STRIDED block layout, (block, rows): winner lanes live on
+            # the MINOR axis (rows ≈ k, typically 128-aligned at real
+            # partition sizes) and the argmax runs over the short major
+            # axis — every op vectorizes at full VPU lane width. The
+            # round-4 contiguous layout put `block` (= ceil(n/k), e.g.
+            # 100 at 4 MB/k=1%) on the minor axis, misaligning every
+            # compare/reduce against the 128-lane registers. Each
+            # winner's block is now the strided set {c, c+rows, ...} —
+            # same budget, same disjoint-cover semantics, same wire
+            # format. Value extraction is compare+where+sum everywhere —
+            # not the TPU-hostile x[arange, local] gather the round-5
+            # xprof caught as the hottest op of the compressed step.
+            if pad == 0:
+                # full chunks (the production partition path) run the
+                # fused Pallas selection (ops/topk_kernels.py; its jnp
+                # twin is the golden and the off-TPU fallback)
+                from byteps_tpu.ops.topk_kernels import block_select
+
+                local, vals = block_select(xf.reshape(block, rows))
             else:
-                xv = xf
-            xa = xa.reshape(rows, block)
-            local = jnp.argmax(xa, axis=1)                     # (rows,)
-            idx = (jnp.arange(rows) * block + local).astype(jnp.int32)
-            vals = xv.reshape(rows, block)[jnp.arange(rows), local]
+                # ragged tail: padding is -1 < 0 <= |x| so a padded slot
+                # can never win (every lane has >= 1 real slot: lane c's
+                # first member is flat position c < rows <= n)
+                xa = jnp.concatenate([jnp.abs(xf), jnp.full((pad,), -1.0)])
+                xv = jnp.concatenate([xf, jnp.zeros((pad,))])
+                xa = xa.reshape(block, rows)
+                local = jnp.argmax(xa, axis=0)                 # (rows,)
+                rr = jax.lax.broadcasted_iota(jnp.int32, (block, rows), 0)
+                vals = jnp.where(rr == local[None, :],
+                                 xv.reshape(block, rows), 0.0).sum(axis=0)
+            idx = (local.astype(jnp.int32) * rows
+                   + jnp.arange(rows, dtype=jnp.int32))
             return {"indices": idx, "values": vals}
         if self.selection == "approx" and k < n:
             _, idx = jax.lax.approx_max_k(
@@ -116,18 +182,88 @@ class TopkCompressor(Compressor):
         rng: Optional[jnp.ndarray] = None,
     ) -> jnp.ndarray:
         idx, vals = payload["indices"], payload["values"]
+        tiled = self._tiled_shape(n)
+        if (self.selection == "block" and tiled is not None
+                and idx.shape[0] == resolve_k(self.k, n)):
+            # tiling-native inverse: zero-relayout reconstruction
+            J, g = tiled
+            local = (idx.reshape(J, 128) // 128
+                     - jnp.arange(J, dtype=idx.dtype)[:, None] * g)
+            ii = jax.lax.broadcasted_iota(jnp.int32, (J, g, 128), 1)
+            dense = jnp.where(
+                ii == local[:, None, :],
+                vals.reshape(J, 1, 128).astype(jnp.float32), 0.0)
+            return dense.reshape(-1).astype(dtype)
         rows, block = self._block_shape(n)
         if self.selection == "block" and idx.shape[0] == rows and block > 1:
-            # scatter-free reconstruction: indices follow the per-row
-            # pattern (row*block + local), so a one-hot multiply rebuilds
-            # the dense chunk — the TPU win over .at[].add
-            local = idx - jnp.arange(rows, dtype=idx.dtype) * block
-            dense = (jax.nn.one_hot(local, block, dtype=jnp.float32)
-                     * vals[:, None]).reshape(rows * block)
+            # scatter-free reconstruction on the strided layout: winner
+            # lane c holds index local·rows + c, so an iota compare over
+            # the (block, rows) grid rebuilds the dense chunk — minor
+            # axis aligned, no scatter, no gather; fused Pallas pass on
+            # TPU via the K=1 reconstruct-sum kernel
+            from byteps_tpu.ops.topk_kernels import block_reconstruct_sum
+
+            local = (idx - jnp.arange(rows, dtype=idx.dtype)) // rows
+            dense = block_reconstruct_sum(
+                local[None], payload["values"].astype(jnp.float32)[None],
+                block).reshape(block * rows)
             return dense[:n].astype(dtype)
         dense = jnp.zeros((n,), jnp.float32)
         dense = dense.at[idx].add(vals)
         return dense.astype(dtype)
+
+    def roundtrip(self, x: jnp.ndarray, rng=None, e=None):
+        """Single-worker aggregation body as ONE fused kernel pass when
+        the tiled layout applies (ops/topk_kernels.py block_roundtrip):
+        EF add + select + reconstruct + new residual with zero payload
+        materialization — the round-5 remedy for BASELINE config 4's
+        single-chip ratio. Falls back to the generic compose. Winner
+        ties (equal |x| within a group) keep all tied elements here
+        (measure-zero for continuous gradients); the payload-producing
+        compress path keeps strict first-max."""
+        n = x.shape[0]
+        tiled = (self._tiled_shape(n)
+                 if self.selection == "block" else None)
+        if tiled is None:
+            return super().roundtrip(x, rng, e)
+        from byteps_tpu.ops.topk_kernels import block_roundtrip
+
+        J, g = tiled
+        return block_roundtrip(x, J, g, e=e)
+
+    def decompress_sum(self, payloads, n: int, dtype=jnp.float32,
+                       rng_keys=None):
+        """Fused decompress-then-sum over K stacked payloads — the
+        aggregation tier's inner loop (reference server ``SumRecvBuff``)
+        as ONE kernel pass on the block layout, no K dense temporaries."""
+        idx = payloads["indices"]
+        tiled = self._tiled_shape(n)
+        if (self.selection == "block" and tiled is not None
+                and idx.ndim == 2
+                and idx.shape[1] == resolve_k(self.k, n)):
+            J, g = tiled
+            K = idx.shape[0]
+            vals = payloads["values"].astype(jnp.float32)
+            ii = jax.lax.broadcasted_iota(jnp.int32, (J, g, 128), 1)
+            acc = jnp.zeros((J, g, 128), jnp.float32)
+            for ki in range(K):
+                local = (idx[ki].reshape(J, 128) // 128
+                         - jnp.arange(J, dtype=idx.dtype)[:, None] * g)
+                acc = acc + jnp.where(ii == local[:, None, :],
+                                      vals[ki].reshape(J, 1, 128), 0.0)
+            return acc.reshape(-1).astype(dtype)
+        rows, block = self._block_shape(n)
+        if (self.selection == "block" and idx.ndim == 2
+                and idx.shape[1] == rows and block > 1):
+            from byteps_tpu.ops.topk_kernels import block_reconstruct_sum
+
+            lane = jnp.arange(rows, dtype=idx.dtype)[None, :]
+            locals_ = (idx - lane) // rows
+            dense = block_reconstruct_sum(
+                locals_, payloads["values"].astype(jnp.float32),
+                block).reshape(block * rows)
+            return dense[:n].astype(dtype)
+        return super().decompress_sum(payloads, n, dtype, rng_keys)
 
     def compressed_bytes(self, n: int, itemsize: int = 4) -> int:
         if self.selection == "block":

@@ -192,14 +192,32 @@ class TopkWire(WireCodec):
         xf = np.ascontiguousarray(x, np.float32)
         n = xf.size
         if self.selection == "block":
-            rows, block = self._block_shape(n)
-            pad = rows * block - n
-            xa = np.abs(xf)
-            if pad:
-                xa = np.concatenate([xa, np.full(pad, -1.0, np.float32)])
-            local = np.argmax(xa.reshape(rows, block), axis=1)
-            idx = (np.arange(rows) * block + local).astype(np.uint32)
-            k = rows
+            # must mirror TopkCompressor's TPU-shaped selection exactly:
+            # tiling-native (J, g, 128) when (k, n) qualify, else the
+            # strided (block, rows) layout — see topk.py
+            from byteps_tpu.compression.topk import tiled_shape
+
+            tiled = tiled_shape(self.k, n)
+            if tiled is not None:
+                J, g = tiled
+                x3 = np.abs(xf).reshape(J, g, 128)
+                local = np.argmax(x3, axis=1)                 # (J, 128)
+                jj = np.arange(J, dtype=np.uint32)[:, None]
+                lane = np.arange(128, dtype=np.uint32)[None, :]
+                idx = ((jj * np.uint32(g) + local.astype(np.uint32))
+                       * np.uint32(128) + lane).reshape(-1)
+                k = idx.size
+            else:
+                rows, block = self._block_shape(n)
+                pad = rows * block - n
+                xa = np.abs(xf)
+                if pad:
+                    xa = np.concatenate(
+                        [xa, np.full(pad, -1.0, np.float32)])
+                local = np.argmax(xa.reshape(block, rows), axis=0)
+                idx = (local.astype(np.uint32) * np.uint32(rows)
+                       + np.arange(rows, dtype=np.uint32))
+                k = rows
         else:
             k = self._k(n)
             idx = np.argpartition(np.abs(xf), n - k)[n - k:].astype(np.uint32)

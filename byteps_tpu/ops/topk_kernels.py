@@ -1,0 +1,233 @@
+"""Block-topk selection/reconstruction kernels (Pallas TPU + jnp twins).
+
+Reference analog: the select/pack loops of
+``byteps/common/compressor/impl/topk.cc`` — but TPU-shaped: the round-5
+xprof attribution showed the XLA form of blockwise selection (argmax +
+value gather + one-hot reconstruct, chunked per partition) costing ~60 ms
+of a 111 ms GPT-2-medium compressed step in mid-size elementwise ops and
+layout changes. These kernels collapse that to three streaming passes.
+
+Layout: a chunk of ``n = block·rows`` elements is viewed as
+``(block, rows)`` — winner LANES on the minor axis (``rows ≈ k``, lane
+aligned at real partition sizes), one winner per lane's strided element
+set ``{c, c+rows, ...}`` (``compression/topk.py`` round-5 contract):
+
+* ``block_select``: per lane, the first-max-|x| row index and its signed
+  value — max/min reduces over the short sublane axis, no gather.
+* ``block_reconstruct_sum``: Σ_k of K payloads rebuilt dense — an iota
+  compare against each payload's winner rows, accumulated in VMEM; the
+  aggregation tier's decompress-then-sum inner loop (reference server
+  ``SumRecvBuff``) without materializing K dense arrays.
+
+Tie-break matches ``jnp.argmax`` (first max) exactly: the kernel computes
+``min(row where |x| == rowmax)``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from byteps_tpu.ops.backend import kernel_backend as _backend
+
+_LANES = 128
+
+
+def _lane_block(rows: int) -> int:
+    for bl in (1024, 512, 256, _LANES):
+        if rows % bl == 0:
+            return bl
+    return rows
+
+
+def kernels_supported(block: int, rows: int) -> bool:
+    """The kernels want a lane-aligned winner axis; anything else (tiny
+    test chunks, ragged tails) takes the jnp twin."""
+    return rows % _LANES == 0 and block > 1
+
+
+# --- jnp twins (the pre-round-5 XLA forms; also the goldens) -----------------
+def _select_jnp(x2d: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    block, rows = x2d.shape
+    xa = jnp.abs(x2d)
+    local = jnp.argmax(xa, axis=0)                           # (rows,) int32
+    rr = jax.lax.broadcasted_iota(jnp.int32, (block, rows), 0)
+    vals = jnp.where(rr == local[None, :], x2d, 0.0).sum(axis=0)
+    return local.astype(jnp.int32), vals
+
+
+def _reconstruct_sum_jnp(locals_: jnp.ndarray, vals: jnp.ndarray,
+                         block: int) -> jnp.ndarray:
+    K, rows = locals_.shape
+    rr = jax.lax.broadcasted_iota(jnp.int32, (block, rows), 0)
+    acc = jnp.zeros((block, rows), jnp.float32)
+    for k in range(K):
+        acc = acc + jnp.where(rr == locals_[k][None, :], vals[k][None, :],
+                              0.0)
+    return acc
+
+
+# --- pallas kernels ----------------------------------------------------------
+def _select_kernel(x_ref, local_ref, vals_ref, *, block: int, bl: int):
+    x = x_ref[...].astype(jnp.float32)                       # (block, bl)
+    xa = jnp.abs(x)
+    am = xa.max(axis=0, keepdims=True)                       # (1, bl)
+    rr = jax.lax.broadcasted_iota(jnp.int32, (block, bl), 0)
+    # first-max row per lane == jnp.argmax tie-break
+    local = jnp.where(xa == am, rr, block).min(
+        axis=0, keepdims=True)                               # (1, bl)
+    vals = jnp.where(rr == local, x, 0.0).sum(
+        axis=0, keepdims=True)                               # (1, bl)
+    local_ref[...] = local
+    vals_ref[...] = vals
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _select_pallas(x2d: jnp.ndarray, interpret: bool = False):
+    block, rows = x2d.shape
+    bl = _lane_block(rows)
+    return pl.pallas_call(
+        functools.partial(_select_kernel, block=block, bl=bl),
+        grid=(rows // bl,),
+        in_specs=[pl.BlockSpec((block, bl), lambda i: (0, i))],
+        out_specs=[
+            pl.BlockSpec((1, bl), lambda i: (0, i)),
+            pl.BlockSpec((1, bl), lambda i: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, rows), jnp.int32),
+            jax.ShapeDtypeStruct((1, rows), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2d)
+
+
+def _reconstruct_kernel(local_ref, vals_ref, out_ref, *, K: int, block: int,
+                        bl: int):
+    rr = jax.lax.broadcasted_iota(jnp.int32, (block, bl), 0)
+    acc = jnp.zeros((block, bl), jnp.float32)
+    for k in range(K):
+        lo = jnp.broadcast_to(local_ref[k:k + 1, :], (block, bl))
+        va = jnp.broadcast_to(vals_ref[k:k + 1, :], (block, bl))
+        acc = acc + jnp.where(rr == lo, va, 0.0)
+    out_ref[...] = acc
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def _reconstruct_pallas(locals_: jnp.ndarray, vals: jnp.ndarray, block: int,
+                        interpret: bool = False) -> jnp.ndarray:
+    K, rows = locals_.shape
+    bl = _lane_block(rows)
+    return pl.pallas_call(
+        functools.partial(_reconstruct_kernel, K=K, block=block, bl=bl),
+        grid=(rows // bl,),
+        in_specs=[
+            pl.BlockSpec((K, bl), lambda i: (0, i)),
+            pl.BlockSpec((K, bl), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((block, bl), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((block, rows), jnp.float32),
+        interpret=interpret,
+    )(locals_, vals)
+
+
+def _roundtrip_kernel(x_ref, *rest, jt: int, g: int, with_e: bool):
+    """One streaming pass of the single-worker block-topk round trip,
+    optionally with the EF add fused in: tiles → dense D(C(x[+e])) and
+    residual (x[+e]) − D(C(x[+e])). Winner rule: elements equal to
+    their group's max |x| survive — ties keep ALL tied elements
+    (measure-zero for continuous gradients; the wire/payload paths keep
+    strict first-max, this kernel carries no payload)."""
+    if with_e:
+        e_ref, out_ref, res_ref = rest
+        x = (x_ref[...].astype(jnp.float32)
+             + e_ref[...].astype(jnp.float32)).reshape(jt, g, 128)
+    else:
+        out_ref, res_ref = rest
+        x = x_ref[...].astype(jnp.float32).reshape(jt, g, 128)
+    xa = jnp.abs(x)
+    am = xa.max(axis=1, keepdims=True)                       # (jt,1,128)
+    dense = jnp.where(xa == am, x, 0.0)
+    out_ref[...] = dense.reshape(jt * g, 128)
+    res_ref[...] = (x - dense).reshape(jt * g, 128)
+
+
+@functools.partial(jax.jit, static_argnames=("J", "g", "interpret"))
+def _roundtrip_pallas(x2d: jnp.ndarray, e2d, J: int, g: int,
+                      interpret: bool = False):
+    M = x2d.shape[0]                                         # = J * g
+    jt = 1
+    for c in (16, 8, 4, 2):                                  # rows ≤ ~2k
+        if J % c == 0 and c * g <= 2048:
+            jt = c
+            break
+    with_e = e2d is not None
+    spec = pl.BlockSpec((jt * g, 128), lambda i: (i, 0))
+    return pl.pallas_call(
+        functools.partial(_roundtrip_kernel, jt=jt, g=g, with_e=with_e),
+        grid=(M // (jt * g),),
+        in_specs=[spec, spec] if with_e else [spec],
+        out_specs=[spec, spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((M, 128), jnp.float32),
+            jax.ShapeDtypeStruct((M, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*((x2d, e2d) if with_e else (x2d,)))
+
+
+def block_roundtrip(x: jnp.ndarray, J: int, g: int,
+                    e: Optional[jnp.ndarray] = None,
+                    backend: Optional[str] = None):
+    """Flat (n = J·g·128,) f32 (+ optional EF residual e, added in-VMEM)
+    → (D(C(x+e)), (x+e) − D(C(x+e))) flat, in ONE fused streaming pass.
+    The single-worker compressed aggregation body — EF add, selection,
+    reconstruction, and the new residual — with no payload
+    materialization, no intermediate dense arrays, and no layout
+    changes (1-D in, 1-D out)."""
+    backend = backend or _backend()
+    xf = x.astype(jnp.float32)
+    if backend == "jnp":
+        # same all-ties winner rule as the kernel (see _roundtrip_kernel)
+        x3 = (xf if e is None
+              else xf + e.astype(jnp.float32)).reshape(J, g, 128)
+        xa = jnp.abs(x3)
+        am = xa.max(axis=1, keepdims=True)
+        dense = jnp.where(xa == am, x3, 0.0)
+        return dense.reshape(-1), (x3 - dense).reshape(-1)
+    out, res = _roundtrip_pallas(
+        xf.reshape(J * g, 128),
+        None if e is None else e.astype(jnp.float32).reshape(J * g, 128),
+        J, g, interpret=jax.default_backend() != "tpu")
+    return out.reshape(-1), res.reshape(-1)
+
+
+# --- public API --------------------------------------------------------------
+def block_select(x2d: jnp.ndarray,
+                 backend: Optional[str] = None
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(block, rows) f32 → per-lane (local row (rows,) i32, value (rows,))."""
+    backend = backend or _backend()
+    block, rows = x2d.shape
+    if backend == "jnp" or not kernels_supported(block, rows):
+        return _select_jnp(x2d)
+    lo, va = _select_pallas(x2d, interpret=jax.default_backend() != "tpu")
+    return lo[0], va[0]
+
+
+def block_reconstruct_sum(locals_: jnp.ndarray, vals: jnp.ndarray,
+                          block: int,
+                          backend: Optional[str] = None) -> jnp.ndarray:
+    """(K, rows) winner rows + values → Σ_k dense (block, rows) f32."""
+    backend = backend or _backend()
+    K, rows = locals_.shape
+    if backend == "jnp" or not kernels_supported(block, rows):
+        return _reconstruct_sum_jnp(locals_, vals, block)
+    return _reconstruct_pallas(
+        locals_.astype(jnp.int32), vals.astype(jnp.float32), block,
+        interpret=jax.default_backend() != "tpu")

@@ -133,12 +133,14 @@ def test_topk_block_selection(n, k):
     vals = np.asarray(p["values"])
     rows, block = c._block_shape(n)
     assert idx.shape == (rows,) and abs(rows - k) <= 1
-    # each winner is its block's max-|x| element, value preserved
-    for r in range(rows):
-        lo, hi = r * block, min((r + 1) * block, n)
-        assert lo <= idx[r] < hi
-        assert abs(xn[idx[r]]) == np.abs(xn[lo:hi]).max()
-        assert vals[r] == xn[idx[r]]
+    # STRIDED blocks (round 5, TPU lane alignment): winner lane c covers
+    # {c, c+rows, c+2·rows, ...} ∩ [0, n) — each winner is its strided
+    # block's max-|x| element, value preserved
+    for c_ in range(rows):
+        members = np.arange(c_, n, rows)
+        assert idx[c_] in members
+        assert abs(xn[idx[c_]]) == np.abs(xn[members]).max()
+        assert vals[c_] == xn[idx[c_]]
     # one-hot reconstruction == scatter reconstruction
     dense = np.asarray(c.decompress(p, n))
     golden = np.zeros(n, np.float32)
