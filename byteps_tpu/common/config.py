@@ -40,6 +40,13 @@ def _env_str(name: str, default: str) -> str:
     return os.environ.get(name, default)
 
 
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return float(v)
+
+
 # Partition size default mirrors the reference's BYTEPS_PARTITION_BYTES
 # default of 4096000 bytes (byteps/common/global.cc).
 DEFAULT_PARTITION_BYTES = 4096000
@@ -101,6 +108,12 @@ class Config:
     # compression: compress only partitions >= this many bytes (reference
     # BYTEPS_MIN_COMPRESS_BYTES semantics: tiny tensors aren't worth it).
     min_compress_bytes: int = 65536
+    # Application-level DCN bandwidth emulation (no reference analog):
+    # > 0 paces every PSWorker's wire payload bytes through per-direction
+    # token buckets at this many megabits/s, so loopback behaves like a
+    # slow cross-pod link (the regime gradient compression exists for).
+    # 0 disables. See server/pacer.py and bench.py --mode throttled.
+    dcn_throttle_mbps: float = 0.0
 
     # --- tracing (SURVEY §5.1) ---------------------------------------------
     trace_on: bool = False
@@ -148,6 +161,7 @@ class Config:
             pull_timeout_ms=_env_int("BYTEPS_SERVER_PULL_TIMEOUT_MS", 60000),
             log_level=_env_str("BYTEPS_LOG_LEVEL", "INFO").upper(),
             min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES", 65536),
+            dcn_throttle_mbps=_env_float("BYTEPS_DCN_THROTTLE_MBPS", 0.0),
             trace_on=_env_bool("BYTEPS_TRACE_ON"),
             trace_dir=_env_str("BYTEPS_TRACE_DIR", "./traces"),
             trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 1),

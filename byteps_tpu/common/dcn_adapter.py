@@ -44,17 +44,33 @@ def wire_codec_for(compression: Optional[str]) -> Optional[WireCodec]:
 
 
 class DcnCore:
-    """One per process; drives flat fp32 buffers through PUSH/PULL."""
+    """One per process; drives flat fp32 buffers through the DCN pipeline.
 
-    def __init__(self) -> None:
+    Stages mirror the reference queue list around the wire
+    (``core_loops.cc`` COMPRESS → PUSH → PULL → DECOMPRESS): codec work
+    runs on its own pool so chunk i+1 compresses WHILE chunk i is on the
+    wire — on a throttled/slow DCN the codec time hides entirely behind
+    transmission instead of serializing with it. The credit is acquired
+    at COMPRESS and released when the chunk leaves PUSH
+    (``releases_credit`` wire scope): at most ``credit`` encoded
+    payloads exist at once — a slow link cannot make the compress pool
+    buffer every partition's encoded bytes — overlap survives whenever
+    credit ≥ 2 (default 4), and slow pulls never starve later pushes.
+    """
+
+    def __init__(self, servers=None, worker_id=None) -> None:
         cfg = get_config()
         self.cfg = cfg
-        self.worker = PSWorker()
+        self.worker = PSWorker(servers=servers, worker_id=worker_id)
         self.registry = TensorRegistry()
         self.scheduler = PipelineScheduler(
             stages=[
-                Stage("PUSH", self._push_stage, credited=True, pool_size=4),
+                Stage("COMPRESS", self._compress_stage, credited=True,
+                      pool_size=2),
+                Stage("PUSH", self._push_stage, credited=True, pool_size=4,
+                      releases_credit=True),
                 Stage("PULL", self._pull_stage, pool_size=4),
+                Stage("DECOMPRESS", self._decompress_stage, pool_size=2),
             ],
             credit=cfg.scheduling_credit,
             tracer=get_tracer(),
@@ -72,10 +88,27 @@ class DcnCore:
         return (base * 1000003 + version * 8191 + part_idx) % (2 ** 63)
 
     # -- stages -------------------------------------------------------------
-    def _push_stage(self, task: PartitionTask):
+    def _compress_stage(self, task: PartitionTask):
+        """Wire encode on the codec pool (reference COMPRESS stage) —
+        decoupled from PUSH so the encode of chunk i+1 overlaps the wire
+        time of chunk i."""
         p = task.partition
         flat: np.ndarray = task.context["flat"]
-        chunk = np.ascontiguousarray(flat[p.offset:p.offset + p.length])
+        # fp32 coercion here, not at push: the registry declared float32
+        # and the store was sized at length*4 — a float64/int caller
+        # must be converted, never byte-viewed at the wrong width
+        chunk = np.ascontiguousarray(
+            flat[p.offset:p.offset + p.length], np.float32)
+        plan: Optional[WirePlan] = task.context["plans"][p.part_idx]
+        if plan is None:
+            return chunk.view(np.uint8).ravel()
+        return plan.codec.encode(
+            chunk,
+            self._wire_seed(task.name, task.context["version"], p.part_idx),
+        )
+
+    def _push_stage(self, task: PartitionTask):
+        p = task.partition
         plan: Optional[WirePlan] = task.context["plans"][p.part_idx]
         store_bytes = (
             plan.codec.store_elems(p.length) * 4 if plan is not None
@@ -90,25 +123,27 @@ class DcnCore:
             # and never resets an existing store, so only THIS worker's init
             # must precede its own push (serial on this connection)
             self.worker.init_key(p.key, store_bytes)
-        if plan is None:
-            return self.worker.push(p.key, chunk)
-        payload = plan.codec.encode(
-            chunk,
-            self._wire_seed(task.name, task.context["version"], p.part_idx),
-        )
-        return self.worker.push_bytes(p.key, payload, plan.codec.codec_id)
+        codec_id = plan.codec.codec_id if plan is not None else 0
+        return self.worker.push_bytes(p.key, task.payload, codec_id)
 
     def _pull_stage(self, task: PartitionTask):
         p = task.partition
         plan: Optional[WirePlan] = task.context["plans"][p.part_idx]
+        capacity = (plan.pull_capacity(p.length) if plan is not None
+                    else p.length * 4)
+        codec_id = plan.pull_codec_id if plan is not None else 0
+        return self.worker.pull_bytes(p.key, capacity, task.payload, codec_id)
+
+    def _decompress_stage(self, task: PartitionTask):
+        """Wire decode of the pulled round result (reference DECOMPRESS),
+        again off the wire pool so decodes overlap later chunks' pulls."""
+        p = task.partition
+        plan: Optional[WirePlan] = task.context["plans"][p.part_idx]
+        buf = np.ascontiguousarray(task.payload)
         if plan is None:
-            return self.worker.pull(p.key, p.length, task.payload)
-        buf = self.worker.pull_bytes(
-            p.key, plan.pull_capacity(p.length), task.payload,
-            plan.pull_codec_id,
-        )
+            return buf.view(np.float32)
         return plan.decode_pull(
-            np.ascontiguousarray(buf), p.length,
+            buf, p.length,
             self._wire_seed(task.name, task.context["version"], p.part_idx),
         )
 
@@ -127,6 +162,10 @@ class DcnCore:
         with self._key_lock:
             version = self._versions.get(name, 0)
             self._versions[name] = version + 1
+        # auto step detection, as on the jax eager path: the highest round
+        # any tensor reached IS the training step — BYTEPS_TRACE_ON=1
+        # alone records the host adapters' stage spans, no user code
+        get_tracer().advance_to(version + 1)
         plans = [
             None
             if codec is None or p.length * 4 < self.cfg.min_compress_bytes

@@ -86,12 +86,24 @@ class Stage:
     from the scheduler's credit budget while the task occupies it (the
     reference applies credits at PUSH). ``pool_size`` > 1 lets slow blocking
     stages (e.g. DCN push/pull waiting on sockets) overlap across partitions.
+
+    ``releases_credit`` scopes the credit to the WIRE, not the pipeline:
+    a task's credit frees when it exits this stage instead of at pipeline
+    completion. The DCN pipelines set it on PUSH so that — on a slow
+    (throttled) link where PULL is as expensive as PUSH — partition i+credit
+    can start pushing while partition i is still pulling/decompressing;
+    credit then bounds concurrent *push occupancy* (the reference's
+    BYTEPS_SCHEDULING_CREDIT bounds bytes in the push queue the same way).
+    Default False keeps the hold-until-completion scope (the eager ICI
+    pipeline's SYNC stage relies on it: the credit must outlive device-side
+    completion, which is what bounds in-flight collectives).
     """
 
     name: str
     fn: Callable[["PartitionTask"], Any]
     credited: bool = False
     pool_size: int = 1
+    releases_credit: bool = False
 
 
 @dataclasses.dataclass
@@ -104,6 +116,11 @@ class PartitionTask:
     payload: Any = None        # stage functions read/replace this
     stage_idx: int = 0
     context: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Credit ownership is PER-TASK state and must never live in
+    # ``context``: the production pipelines share one context dict across
+    # every partition of a tensor, which would let partition 0's credit
+    # cover its siblings (and a release refund a credit a sibling holds).
+    holds_credit: bool = False
 
     @property
     def sort_key(self):
@@ -213,15 +230,13 @@ class PipelineScheduler:
                     # partition completes); one already holding a credit
                     # passes later credited stages freely.
                     head = q.peek()
-                    needs_credit = (
-                        stage.credited and not head.context.get("_holds_credit")
-                    )
+                    needs_credit = stage.credited and not head.holds_credit
                     if needs_credit and self._credits <= 0:
                         continue
                     task = q.pop()
                     if needs_credit:
                         self._credits -= 1
-                        task.context["_holds_credit"] = True
+                        task.holds_credit = True
                     self._busy[si] += 1
                     issued = (si, task)
                     break
@@ -255,6 +270,13 @@ class PipelineScheduler:
             )
         with self._lock:
             self._busy[si] -= 1
+            if (failed is None and stage.releases_credit
+                    and task.holds_credit):
+                # wire-scoped credit: frees on stage exit so the next
+                # partition's push can start while this one drains the
+                # rest of the pipeline (_finish's release is then a no-op)
+                task.holds_credit = False
+                self._credits = min(self._credits + 1, self._credit_total)
         if failed is not None:
             self._finish(task, error=failed)
         elif si + 1 < len(self.stages):
@@ -268,7 +290,8 @@ class PipelineScheduler:
     def _finish(self, task: PartitionTask, error: Optional[BaseException] = None) -> None:
         """Reference analog: FinishOrProceed's terminal arm."""
         with self._lock:
-            if task.context.pop("_holds_credit", False):
+            if task.holds_credit:
+                task.holds_credit = False
                 self._credits = min(self._credits + 1, self._credit_total)
             self._inflight -= 1
         if error is not None:

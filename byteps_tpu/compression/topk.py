@@ -218,9 +218,9 @@ class TopkCompressor(Compressor):
         EF add + select + reconstruct + new residual with zero payload
         materialization — the round-5 remedy for BASELINE config 4's
         single-chip ratio. Falls back to the generic compose. Winner
-        ties (equal |x| within a group) keep all tied elements here
-        (measure-zero for continuous gradients); the payload-producing
-        compress path keeps strict first-max."""
+        ties break strict first-max (min group index at the group max),
+        identical to the payload-producing compress path, so the fused
+        n==1 body and the n>1 wire path select the same support."""
         n = x.shape[0]
         tiled = (self._tiled_shape(n)
                  if self.selection == "block" else None)

@@ -31,6 +31,10 @@ Two aggregation paths (SURVEY §7 phase 2/3):
 
 from __future__ import annotations
 
+from byteps_tpu.common.jax_compat import ensure as _ensure_jax_compat
+
+_ensure_jax_compat()
+
 import threading
 from typing import Any, Dict, Optional
 
@@ -138,12 +142,21 @@ def init(
                 }
             except Exception as e:  # noqa: BLE001 - tracing is best-effort
                 log.warning("clock-offset probe failed: %s", e)
+        # The credit is acquired at COMPRESS and released at PUSH exit
+        # (releases_credit wire scope): on a slow/throttled DCN the PULL
+        # direction costs as much as PUSH, and a completion-scoped
+        # credit would let draining pulls starve later pushes — with
+        # wire scope, COMPRESS of chunk i+1 runs while chunk i is on the
+        # wire (credit ≥ 2) and at most ``credit`` encoded payloads are
+        # ever buffered ahead of the wire.
         _state.scheduler = PipelineScheduler(
             stages=[
                 Stage("REDUCE", _reduce_stage, pool_size=1),
                 Stage("COPYD2H", _d2h_stage, pool_size=2),
-                Stage("COMPRESS", _compress_stage, pool_size=2),
-                Stage("PUSH", _dcn_push_stage, credited=True, pool_size=4),
+                Stage("COMPRESS", _compress_stage, credited=True,
+                      pool_size=2),
+                Stage("PUSH", _dcn_push_stage, credited=True, pool_size=4,
+                      releases_credit=True),
                 Stage("PULL", _dcn_pull_stage, pool_size=4),
                 Stage("DECOMPRESS", _decompress_stage, pool_size=2),
                 Stage("COPYH2D", _h2d_stage, pool_size=2),
@@ -165,12 +178,27 @@ def init(
             tracer=tracer,
         )
     if cfg.auto_tune and cfg.is_distributed:
-        log.warning(
-            "BYTEPS_AUTO_TUNE ignored in distributed mode: per-worker "
-            "tuners would repartition at different times, pushing "
-            "mismatched partition sizes under the same keys"
+        # Credit-ONLY tuner in hybrid mode: credit is a purely local knob
+        # (it changes this worker's issue parallelism, never the keys or
+        # partition sizes the servers see), so per-worker moves are safe.
+        # The partition knob stays off — per-worker tuners would
+        # repartition at different times, pushing mismatched partition
+        # sizes under the same keys. With wire-scoped credits (above),
+        # credit is exactly the knob that trades pipeline overlap against
+        # wire contention on a slow DCN.
+        from byteps_tpu.common.tuner import AutoTuner
+
+        log.info(
+            "BYTEPS_AUTO_TUNE in distributed mode: tuning credit only "
+            "(partition moves are not coordinated across workers)"
         )
-    if cfg.auto_tune and not cfg.is_distributed:
+        _state.tuner = AutoTuner(
+            apply=lambda pb, cr: _state.scheduler.set_credit(cr),
+            partition_bytes=cfg.partition_bytes,
+            credit=cfg.scheduling_credit,
+            knobs=("credit",),
+        )
+    elif cfg.auto_tune and not cfg.is_distributed:
         # ByteScheduler auto-tuner (BYTEPS_AUTO_TUNE=1): online hill-climb
         # of (partition_bytes, credit) on the eager path. Single-controller
         # only — all devices see one scheduler, so moves are consistent.

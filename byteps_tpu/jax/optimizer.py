@@ -102,59 +102,59 @@ def _aggregate_flat(
         )
         return res if e is not None else (res, None)
 
-    # Full chunks run BATCHED, `group` at a time, through one
-    # lax.scan-of-vmap: per-chunk semantics are unchanged (same fold_in
+    # BYTEPS_COMPRESS_BATCH_CHUNKS > 1 runs full chunks in vmapped
+    # groups of that size (an UNROLLED loop of vmap calls — see the
+    # scan note below): per-chunk semantics are unchanged (same fold_in
     # key per chunk id, selection/EF still per chunk_elems partition —
-    # the wire contract), but the codec runs as (group, chunk_elems)
-    # array ops instead of per-chunk sequential op-chains. The round-5
-    # xprof attribution measured the sequential form at ~0.3 ms of
-    # small-op overhead per chunk (GPT-2-medium: 341 chunks, ~100 ms of
-    # a 154 ms compressed step). Grouping (BYTEPS_COMPRESS_BATCH_CHUNKS,
-    # default 16 ≈ 64 MB of gradient per group at 4 MB partitions)
-    # bounds the live f32 intermediates — an all-chunks vmap OOMs a v5e
-    # next to the model+opt state — while the scan keeps ONE compiled
-    # body for every group. Remainder full chunks take one smaller
-    # vmap; the ragged tail keeps the scalar path (its k resolves
-    # against the true tail length, exactly as before).
-    # default 1: with the fused n==1 roundtrip (and the Pallas codec
-    # kernels) per-chunk bodies are single big ops already, and vmap
-    # batching only adds slicing/stacking glue — measured on v5e, both
-    # gpt2m+topk-block (80.4 vs 92.2 ms at groups of 16) and
-    # bert+onebit (43.3 vs 68.4). >1 batches chunk bodies through vmap,
-    # which can help codecs that still run many small XLA ops per chunk.
+    # the wire contract), but each group's codec runs as
+    # (group, chunk_elems) array ops instead of per-chunk sequential
+    # op-chains, and the group size bounds the live f32 intermediates
+    # (an all-chunks vmap OOMs a v5e next to the model+opt state).
+    # Remainder full chunks take one smaller vmap; the ragged tail
+    # keeps the scalar path (its k resolves against the true tail
+    # length, exactly as before). Default 1 = OFF, and deliberately so:
+    # with the fused n==1 roundtrip and the Pallas codec kernels each
+    # per-chunk body is already a few big ops, and vmap batching only
+    # adds slicing/stacking glue — measured on v5e, gpt2m+topk-block
+    # 80.4 ms (off) vs 92.2 ms (groups of 16) and bert+onebit 43.3 vs
+    # 68.4. Raise it only for codecs that still emit many small XLA ops
+    # per chunk, and re-measure (docs/env.md).
     group = int(os.environ.get("BYTEPS_COMPRESS_BATCH_CHUNKS", "1"))
     nfull = total // chunk_elems
+    pre_added = False
     if spec.enabled and nfull > 1 and group > 1:
-        # The EF add is hoisted to ONE whole-flat pass and the chunk
-        # views are chosen so every reshape is a layout no-op: a 1-D
-        # f32 array tiles as 1024 consecutive elements, and any
-        # (..., m, 128) view with m % 8 == 0 preserves that physical
-        # order — whereas the naive (nchunks, chunk_elems) 2-D stacking
-        # interleaves 8 CHUNKS per tile and forced a full relayout of
-        # the gradient in each direction (round-5 xprof: ~22 ms/step of
-        # 'data formatting' on GPT-2-medium, on top of per-chunk
-        # small-op overhead the batching already removes).
+        # The EF add IS hoisted to ONE whole-flat pass here (the tail
+        # chunks below then slice the pre-added flat and ask only for
+        # the residual back — compressed_allreduce_local's documented
+        # return_residual contract), and the chunk views are chosen so
+        # every reshape is a layout no-op: a 1-D f32 array tiles as
+        # 1024 consecutive elements, and any (..., m, 128) view with
+        # m % 8 == 0 preserves that physical order — whereas the naive
+        # (nchunks, chunk_elems) 2-D stacking interleaves 8 CHUNKS per
+        # tile and forced a full relayout of the gradient in each
+        # direction (round-5 xprof: ~22 ms/step of 'data formatting' on
+        # GPT-2-medium, on top of per-chunk small-op overhead the
+        # batching already removes).
         lanes = 128 if chunk_elems % 128 == 0 else 1
         m = chunk_elems // lanes
         want_res = ef_flat is not None
+        if want_res:
+            flat = flat + ef_flat          # the single whole-flat EF add
+            pre_added = True
 
-        def body(g, k, e):
+        def body(g, k):
             r = compressed_allreduce_local(
                 g.reshape(-1), k, spec.compressor, axis, n,
                 average=average, two_way=two_way,
-                ef_residual=(None if e is None else e.reshape(-1)),
                 return_residual=want_res,
             )
             return r if want_res else (r, jnp.zeros((), jnp.float32))
 
-        def vchunk(gs, ids, es):
+        def vchunk(gs, ids):
             keys = jax.vmap(
                 lambda i: jax.random.fold_in(rng, chunk_id_offset + i)
             )(ids)
-            if es is None:
-                return jax.vmap(
-                    lambda g, k: body(g, k, None))(gs, keys)
-            return jax.vmap(body)(gs, keys, es)
+            return jax.vmap(body)(gs, keys)
 
         # unrolled loop of vmapped groups — NOT a lax.scan: scan stacks
         # its per-iteration outputs with full-array dynamic-update-slice
@@ -167,11 +167,7 @@ def _aggregate_flat(
             gs = jax.lax.slice_in_dim(
                 flat, g0 * chunk_elems,
                 g1 * chunk_elems).reshape(g1 - g0, m, lanes)
-            es = (jax.lax.slice_in_dim(
-                ef_flat, g0 * chunk_elems,
-                g1 * chunk_elems).reshape(g1 - g0, m, lanes)
-                if ef_flat is not None else None)
-            out_g, ne_g = vchunk(gs, jnp.arange(g0, g1), es)
+            out_g, ne_g = vchunk(gs, jnp.arange(g0, g1))
             out_chunks.append(out_g.reshape(-1))
             if ef_flat is not None:
                 new_e_chunks.append(ne_g.reshape(-1))
@@ -184,14 +180,23 @@ def _aggregate_flat(
         g = jax.lax.slice_in_dim(flat, off, off + ln)
         if spec.enabled:
             crng = jax.random.fold_in(rng, chunk_id_offset + ci)
-            e = (
-                jax.lax.slice_in_dim(ef_flat, off, off + ln)
-                if ef_flat is not None
-                else None
-            )
-            out, ne = one_chunk(g, crng, e)
-            if e is not None:
+            if pre_added:
+                # flat already carries the residual (hoisted add above)
+                out, ne = compressed_allreduce_local(
+                    g, crng, spec.compressor, axis, n,
+                    average=average, two_way=two_way,
+                    return_residual=True,
+                )
                 new_e_chunks.append(ne)
+            else:
+                e = (
+                    jax.lax.slice_in_dim(ef_flat, off, off + ln)
+                    if ef_flat is not None
+                    else None
+                )
+                out, ne = one_chunk(g, crng, e)
+                if e is not None:
+                    new_e_chunks.append(ne)
         else:
             s = jax.lax.psum(g, axis)
             out = s / n if average else s
@@ -589,7 +594,32 @@ def _host_callbacks_supported() -> bool:
             jax.debug.callback(lambda _v: None, x)
             return x + 1
 
-        _probe(jnp.zeros(())).block_until_ready()
+        def _run_probe():
+            res = _probe(jnp.zeros(()))
+            if not hasattr(res, "block_until_ready"):
+                # the nested jit staged into an ambient trace we could
+                # not escape (no eval_context on this jax): the probe is
+                # INCONCLUSIVE — degrade SAFE (markers off for this
+                # trace; an unproven callback baked into the step would
+                # crash every step on a callback-rejecting backend) but
+                # don't cache, so a later out-of-trace call can upgrade
+                return False
+            res.block_until_ready()
+            return True
+
+        # The caller is usually mid-trace (update_fn under the user's
+        # jit): on jax versions where a nested jit call stages into the
+        # ambient trace, the probe result would be a Tracer — probe
+        # under eval_context so it always executes concretely.
+        clean = getattr(jax.core, "trace_state_clean", lambda: True)()
+        ectx = getattr(jax.core, "eval_context", None)
+        if not clean and ectx is not None:
+            with ectx():
+                conclusive = _run_probe()
+        else:
+            conclusive = _run_probe()
+        if not conclusive:
+            return False
     except Exception as e:  # noqa: BLE001 — any refusal means unsupported
         ok = False
         from byteps_tpu.common.logging import get_logger

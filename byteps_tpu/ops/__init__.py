@@ -8,6 +8,10 @@ Backend selection: Pallas on TPU, jnp elsewhere; override with
 ``BYTEPS_KERNEL_BACKEND=pallas|jnp``.
 """
 
+from byteps_tpu.common.jax_compat import ensure as _ensure_jax_compat
+
+_ensure_jax_compat()
+
 from byteps_tpu.ops.flash_attention import (
     attention_jnp,
     flash_attention,

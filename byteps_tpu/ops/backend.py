@@ -23,3 +23,22 @@ def kernel_backend() -> str:
 
 def use_pallas() -> bool:
     return kernel_backend() == "pallas"
+
+
+# --- pallas-TPU API compat (jax renamed TPUCompilerParams →
+# CompilerParams and TPUMemorySpace → MemorySpace): resolve whichever
+# name this jax ships so the kernels run on both sides of the rename.
+def tpu_compiler_params(**kwargs):
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams")
+    return cls(**kwargs)
+
+
+def tpu_smem():
+    from jax.experimental.pallas import tpu as pltpu
+
+    ms = getattr(pltpu, "MemorySpace", None) or getattr(
+        pltpu, "TPUMemorySpace")
+    return ms.SMEM

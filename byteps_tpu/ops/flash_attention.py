@@ -93,11 +93,15 @@ def _env_prefer() -> Tuple[int, ...]:
 
 def _train_blocks(Sq: int, Sk: int, D: int, itemsize: int,
                   prefer: Tuple[int, ...],
-                  n_inter: int = 2) -> Tuple[int, int]:
-    """(bq, bk) for the train kernels: the preferred large tiles, walked
-    back down the candidate list until the tile set fits VMEM — the
-    big-tile retune was measured at bf16/D=64; f32 or D→256 shapes must
-    degrade gracefully instead of blowing the Mosaic budget.
+                  n_inter: int = 2) -> Optional[Tuple[int, int]]:
+    """(bq, bk) for the train kernels — or None when either sequence has
+    no dividing tile (the documented None→jnp-fallback contract that
+    ``_pick_block``/``supported()`` establish; callers not pre-gated by
+    ``supported()`` must get the same None, not a TypeError). Otherwise:
+    the preferred large tiles, walked back down the candidate list until
+    the tile set fits VMEM — the big-tile retune was measured at
+    bf16/D=64; f32 or D→256 shapes must degrade gracefully instead of
+    blowing the Mosaic budget.
 
     ``n_inter`` models the kernel's live (bq, bk) f32 intermediates:
     2 for the forward (s, p), 4 for the backwards (s, p, dp, ds) — the
@@ -113,6 +117,8 @@ def _train_blocks(Sq: int, Sk: int, D: int, itemsize: int,
     prefer = _env_prefer() + prefer
     bq = _pick_block(Sq, prefer)
     bk = _pick_block(Sk, prefer)
+    if bq is None or bk is None:
+        return None
     while not fits(bq, bk):
         # shrink the larger tile first (s/p cost is the bq·bk product)
         nxt_q = _pick_block(Sq, tuple(p for p in prefer if p < bq))
@@ -129,6 +135,7 @@ def _train_blocks(Sq: int, Sk: int, D: int, itemsize: int,
 
 
 from byteps_tpu.ops.backend import use_pallas  # noqa: E402 (re-export)
+from byteps_tpu.ops.backend import tpu_compiler_params as _compiler_params  # noqa: E402
 
 
 def supported(Sq: int, Sk: int, D: int) -> bool:
@@ -287,7 +294,12 @@ def _fwd(q3, k3, v3, qoff, koff, causal: bool, interpret: bool,
     (o (B·H, Sq, D), lse (B·H, Sq, 1) f32)."""
     BH, Sq, D = q3.shape
     Sk = k3.shape[1]
-    bq, bk = _train_blocks(Sq, Sk, D, q3.dtype.itemsize, _FWD_PREFER)
+    blocks = _train_blocks(Sq, Sk, D, q3.dtype.itemsize, _FWD_PREFER)
+    if blocks is None:
+        raise ValueError(
+            f"flash forward kernel has no dividing tile for Sq={Sq}, "
+            f"Sk={Sk} — gate call sites with supported() (jnp fallback)")
+    bq, bk = blocks
     nq, nk = Sq // bq, Sk // bk
     scale = 1.0 / (D ** 0.5)
     kv = _kv_index(heads, kv_heads)
@@ -316,7 +328,7 @@ def _fwd(q3, k3, v3, qoff, koff, causal: bool, interpret: bool,
             pltpu.VMEM((bq, 1), jnp.float32),    # l (row sum)
             pltpu.VMEM((bq, D), jnp.float32),    # acc
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qoff, koff, q3, k3, v3)
@@ -453,8 +465,13 @@ def _bwd(q3, k3, v3, o3, lse, qoff, koff, do3, dlse,
          causal: bool, interpret: bool, heads: int, kv_heads: int):
     BH, Sq, D = q3.shape
     BHkv, Sk = k3.shape[0], k3.shape[1]
-    bq, bk = _train_blocks(Sq, Sk, D, q3.dtype.itemsize, _BWD_PREFER,
+    blocks = _train_blocks(Sq, Sk, D, q3.dtype.itemsize, _BWD_PREFER,
                            n_inter=4)
+    if blocks is None:
+        raise ValueError(
+            f"flash backward kernel has no dividing tile for Sq={Sq}, "
+            f"Sk={Sk} — gate call sites with supported() (jnp fallback)")
+    bq, bk = blocks
     nq, nk = Sq // bq, Sk // bk
     group = heads // kv_heads
     kv = _kv_index(heads, kv_heads)
@@ -484,7 +501,7 @@ def _bwd(q3, k3, v3, o3, lse, qoff, koff, do3, dlse,
         out_shape=_out_struct((BH, Sq, D), q3.dtype,
                               q3, k3, v3, do3, lse, delta, dlse, qoff, koff),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qoff, koff, q3, k3, v3, do3, lse, delta, dlse)
@@ -525,7 +542,7 @@ def _bwd(q3, k3, v3, o3, lse, qoff, koff, do3, dlse,
             pltpu.VMEM((bk, D), jnp.float32),
             pltpu.VMEM((bk, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qoff, koff, q3, k3, v3, do3, lse, delta, dlse)

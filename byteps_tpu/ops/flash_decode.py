@@ -54,6 +54,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from byteps_tpu.ops.backend import use_pallas  # noqa: F401 (re-export)
+from byteps_tpu.ops.backend import tpu_compiler_params as _compiler_params
 from byteps_tpu.ops.flash_attention import (
     _MAX_HEAD_DIM,
     _NEG,
@@ -176,7 +177,7 @@ def _decode(q4, k4, v4, ks, vs, pos, interpret: bool):
             pltpu.VMEM((Hkv, G, 1), jnp.float32),    # l
             pltpu.VMEM((Hkv, G, D), jnp.float32),    # acc
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(*operands)

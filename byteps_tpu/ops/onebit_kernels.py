@@ -39,7 +39,8 @@ def _block(L: int) -> int:
     return L
 
 
-from byteps_tpu.ops.backend import kernel_backend as _backend  # noqa: E402
+from byteps_tpu.ops.backend import kernel_backend as _backend
+from byteps_tpu.ops.backend import tpu_smem as _smem  # noqa: E402
 
 
 def packed_words(n: int) -> int:
@@ -164,7 +165,7 @@ def _unpack_sum_pallas(words: jnp.ndarray, scales: jnp.ndarray,
             in_specs=[
                 pl.BlockSpec((K, bl), lambda i: (0, i)),
                 pl.BlockSpec((K, 1), lambda i: (0, 0),
-                             memory_space=pltpu.MemorySpace.SMEM),
+                             memory_space=_smem()),
             ],
             out_specs=pl.BlockSpec((_BITS, bl), lambda i: (0, i)),
             out_shape=jax.ShapeDtypeStruct((_BITS, L), jnp.float32),
@@ -185,7 +186,7 @@ def _unpack_sum_pallas(words: jnp.ndarray, scales: jnp.ndarray,
         in_specs=[
             pl.BlockSpec((_GRID_K_BLOCK, bl), lambda j, k: (k, j)),
             pl.BlockSpec((_GRID_K_BLOCK, 1), lambda j, k: (k, 0),
-                         memory_space=pltpu.MemorySpace.SMEM),
+                         memory_space=_smem()),
         ],
         out_specs=pl.BlockSpec((_BITS, bl), lambda j, k: (0, j)),
         out_shape=jax.ShapeDtypeStruct((_BITS, L), jnp.float32),

@@ -139,10 +139,11 @@ def _reconstruct_pallas(locals_: jnp.ndarray, vals: jnp.ndarray, block: int,
 def _roundtrip_kernel(x_ref, *rest, jt: int, g: int, with_e: bool):
     """One streaming pass of the single-worker block-topk round trip,
     optionally with the EF add fused in: tiles → dense D(C(x[+e])) and
-    residual (x[+e]) − D(C(x[+e])). Winner rule: elements equal to
-    their group's max |x| survive — ties keep ALL tied elements
-    (measure-zero for continuous gradients; the wire/payload paths keep
-    strict first-max, this kernel carries no payload)."""
+    residual (x[+e]) − D(C(x[+e])). Winner rule: strict FIRST-max per
+    group — min group index where |x| equals the group max, exactly
+    ``jnp.argmax``'s tie-break and what ``_select_kernel``/the wire
+    payload path keep — so the fused n==1 path retains exactly one
+    element per group even when bf16-derived gradients tie routinely."""
     if with_e:
         e_ref, out_ref, res_ref = rest
         x = (x_ref[...].astype(jnp.float32)
@@ -152,7 +153,10 @@ def _roundtrip_kernel(x_ref, *rest, jt: int, g: int, with_e: bool):
         x = x_ref[...].astype(jnp.float32).reshape(jt, g, 128)
     xa = jnp.abs(x)
     am = xa.max(axis=1, keepdims=True)                       # (jt,1,128)
-    dense = jnp.where(xa == am, x, 0.0)
+    ii = jax.lax.broadcasted_iota(jnp.int32, (jt, g, 128), 1)
+    local = jnp.where(xa == am, ii, g).min(
+        axis=1, keepdims=True)                               # (jt,1,128)
+    dense = jnp.where(ii == local, x, 0.0)
     out_ref[...] = dense.reshape(jt * g, 128)
     res_ref[...] = (x - dense).reshape(jt * g, 128)
 
@@ -189,16 +193,21 @@ def block_roundtrip(x: jnp.ndarray, J: int, g: int,
     The single-worker compressed aggregation body — EF add, selection,
     reconstruction, and the new residual — with no payload
     materialization, no intermediate dense arrays, and no layout
-    changes (1-D in, 1-D out)."""
+    changes (1-D in, 1-D out). Tie-break is strict first-max (min group
+    index at the group max |x|), matching the payload/wire paths
+    exactly, so n==1 and n>1 select identical supports."""
     backend = backend or _backend()
     xf = x.astype(jnp.float32)
     if backend == "jnp":
-        # same all-ties winner rule as the kernel (see _roundtrip_kernel)
+        # same strict first-max winner rule as the kernel (see
+        # _roundtrip_kernel) — the twin may never diverge on ties
         x3 = (xf if e is None
               else xf + e.astype(jnp.float32)).reshape(J, g, 128)
         xa = jnp.abs(x3)
         am = xa.max(axis=1, keepdims=True)
-        dense = jnp.where(xa == am, x3, 0.0)
+        ii = jax.lax.broadcasted_iota(jnp.int32, (J, g, 128), 1)
+        local = jnp.where(xa == am, ii, g).min(axis=1, keepdims=True)
+        dense = jnp.where(ii == local, x3, 0.0)
         return dense.reshape(-1), (x3 - dense).reshape(-1)
     out, res = _roundtrip_pallas(
         xf.reshape(J * g, 128),

@@ -11,6 +11,10 @@ Everything here is shard_map-first: functions take axis *names* and are
 called inside ``jax.shard_map`` over a mesh built by :func:`make_mesh`.
 """
 
+from byteps_tpu.common.jax_compat import ensure as _ensure_jax_compat
+
+_ensure_jax_compat()
+
 from byteps_tpu.parallel.mesh import MeshAxes, make_mesh, factor_devices
 from byteps_tpu.parallel.moe import (moe_ffn, moe_init, moe_specs,
                                      top1_dispatch, topk_dispatch)

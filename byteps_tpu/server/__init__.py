@@ -33,12 +33,13 @@ from byteps_tpu.server.native import (
     load_lib,
     reduce_sum_f32,
 )
+from byteps_tpu.server.pacer import DcnPacer, pacer_from_mbps
 
 log = get_logger("server")
 
 __all__ = [
     "start_server", "stop_server", "serve_forever", "server_addresses",
-    "PSWorker", "reduce_sum_f32",
+    "PSWorker", "reduce_sum_f32", "DcnPacer",
 ]
 
 
@@ -139,6 +140,12 @@ class PSWorker:
     process (joint role), pushes/pulls for locally-owned keys skip TCP and
     access the store directly (the reference's colocated shared-memory
     fast path, ps-lite ``BYTEPS_ENABLE_IPC``).
+
+    With ``BYTEPS_DCN_THROTTLE_MBPS`` > 0 (or ``throttle_mbps=``), this
+    worker's payload bytes are paced through an emulated full-duplex NIC
+    of that speed (``server/pacer.py``) — the bandwidth-throttled bench
+    and the compression fast-lane regime. The pacer is per-PSWorker, so
+    several workers emulated in one process each get their own NIC.
     """
 
     def __init__(
@@ -148,6 +155,7 @@ class PSWorker:
         recv_timeout_ms: int = 120000,
         worker_id: Optional[int] = None,
         use_ipc: Optional[bool] = None,
+        throttle_mbps: Optional[float] = None,
     ):
         cfg = get_config()
         self._servers = list(servers) if servers else server_addresses()
@@ -168,6 +176,10 @@ class PSWorker:
         self._ipc = (
             use_ipc if use_ipc is not None else cfg.enable_ipc
         ) and _INPROC_SERVER_ID is not None
+        self.pacer: Optional[DcnPacer] = pacer_from_mbps(
+            throttle_mbps if throttle_mbps is not None
+            else cfg.dcn_throttle_mbps
+        )
 
     # -- connection management ----------------------------------------------
     def _conn(self, sidx: int) -> NativeClient:
@@ -226,6 +238,12 @@ class PSWorker:
         with self._vlock:
             version = self._versions.get(key, 0) + 1
             self._versions[key] = version
+        if self.pacer is not None:
+            # book the payload's transmission time on the emulated NIC
+            # BEFORE the wire op — upstream bandwidth leaves this worker
+            # at the paced rate (applies to the IPC path too: colocated
+            # deployments being modeled still cross a NIC pod-to-pod)
+            self.pacer.throttle_send(int(np.asarray(buf).nbytes))
         sidx = self.server_for(key)
         if self._is_local(sidx):
             b = np.ascontiguousarray(buf)
@@ -255,6 +273,9 @@ class PSWorker:
                 raise RuntimeError(f"local pull failed (rc={got})")
         else:
             got = self._conn(sidx).pull(key, out, version, codec)
+        if self.pacer is not None:
+            # book the response's transmission time (downstream direction)
+            self.pacer.throttle_recv(int(got))
         with self._vlock:
             self.bytes_pulled += int(got)
         return out[:got]

@@ -159,6 +159,76 @@ def test_two_credited_stages_no_credit_leak():
     sched.shutdown()
 
 
+def test_credit_is_per_task_even_with_shared_context():
+    """Regression: the production pipelines pass ONE shared context dict
+    to every partition of a tensor — credit ownership must be per-TASK
+    (PartitionTask.holds_credit), or partition 0's credit would cover
+    all its siblings and the budget would not bound in-flight pushes."""
+    inflight = 0
+    max_inflight = 0
+    lock = threading.Lock()
+
+    def fn(task):
+        nonlocal inflight, max_inflight
+        with lock:
+            inflight += 1
+            max_inflight = max(max_inflight, inflight)
+        time.sleep(0.01)
+        with lock:
+            inflight -= 1
+
+    sched = PipelineScheduler(
+        [Stage("PUSH", fn, credited=True, pool_size=8)], credit=2)
+    h = Handle("t", 8)
+    tasks = _tasks_for(0, 8, "t", h)
+    shared = {"plans": None}
+    for t in tasks:
+        t.context = shared  # same dict object, as DcnCore/jax do
+    sched.enqueue(tasks)
+    h.wait(5)
+    assert max_inflight <= 2, max_inflight
+    assert sched._credits == sched._credit_total
+    sched.shutdown()
+
+
+def test_releases_credit_frees_at_stage_exit():
+    """Wire-scoped credits: with releases_credit on the credited stage,
+    the credit bounds concurrent PUSH occupancy only — tasks draining a
+    slow downstream stage (PULL on a throttled link) exceed the credit
+    without blocking later pushes, and no credit is leaked or double
+    refunded across the stage-exit/_finish pair."""
+    in_pull = 0
+    max_in_pull = 0
+    lock = threading.Lock()
+
+    def push(task):
+        time.sleep(0.001)
+
+    def pull(task):
+        nonlocal in_pull, max_in_pull
+        with lock:
+            in_pull += 1
+            max_in_pull = max(max_in_pull, in_pull)
+        time.sleep(0.03)
+        with lock:
+            in_pull -= 1
+
+    sched = PipelineScheduler(
+        [Stage("PUSH", push, credited=True, pool_size=4,
+               releases_credit=True),
+         Stage("PULL", pull, pool_size=8)],
+        credit=1,
+    )
+    h = Handle("t", 6)
+    sched.enqueue(_tasks_for(0, 6, "t", h))
+    h.wait(10)
+    # completion-scoped credit=1 would serialize pulls (max 1); wire
+    # scope lets them pile up while pushes continue one at a time
+    assert max_in_pull >= 2, max_in_pull
+    assert sched._credits == sched._credit_total
+    sched.shutdown()
+
+
 def test_enqueue_after_shutdown_raises():
     sched = PipelineScheduler([Stage("A", lambda t: None)], credit=1)
     sched.shutdown()
