@@ -138,13 +138,19 @@ def compressed_allreduce_local(
     """
     L = g.shape[0]
     g = g.astype(jnp.float32)
-    if n == 1:
+    if n == 1 and not compressor.stochastic:
         # single-worker fast path (reference single-machine mode): no
         # exchange exists, so the whole body is one codec round trip —
         # EF add included — fusable into a single kernel pass by the
         # compressor (TopkCompressor's tiled layout does; see
         # ops/topk_kernels.py block_roundtrip). Key matches the n>1
-        # path's own-segment key (fold_in(rng, 0)).
+        # path's own-segment key (fold_in(rng, 0)). DETERMINISTIC codecs
+        # only: their D∘C is idempotent, so collapsing the general
+        # path's two codec round trips (two_way recompression of the
+        # "sum") into one changes nothing — pinned per codec in
+        # tests/test_ici.py::test_n1_fast_path_*. Stochastic codecs
+        # (dithering re-rounds every pass) fall through to the general
+        # body, whose collectives are identities over a size-1 axis.
         dense, resid = compressor.roundtrip(
             g, jax.random.fold_in(rng, 0), e=ef_residual)
         if ef_residual is None and not return_residual:

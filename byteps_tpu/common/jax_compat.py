@@ -20,6 +20,32 @@ from __future__ import annotations
 _installed = False
 
 
+def native_vma() -> bool:
+    """True when this jax ships the real VMA type system (native
+    ``jax.shard_map`` with ``check_vma``), False when :func:`ensure` is
+    bridging the old ``jax.experimental.shard_map``/``check_rep`` API.
+
+    The distinction matters for AD through in-shard_map collectives:
+    under real VMA, ``psum`` of a varying value yields an INVARIANT type
+    whose transpose seeds ONE cotangent; pre-VMA jax transposes psum to
+    psum, so grads of a psum'd replicated objective come out n× (the
+    train factories' explicit no-VMA grad assembly compensates — see
+    models/train.py — but tests pinning the VMA-typed property itself
+    must skip here)."""
+    import inspect
+
+    import jax
+
+    if getattr(ensure, "_bridged", False) or not hasattr(jax, "shard_map"):
+        return False
+    try:
+        # a top-level shard_map WITHOUT the check_vma parameter is the
+        # pre-VMA export band — same psum-to-psum transpose as old jax
+        return "check_vma" in inspect.signature(jax.shard_map).parameters
+    except (TypeError, ValueError):
+        return False
+
+
 def ensure() -> None:
     """Install the name aliases once per process; no-op on current jax."""
     global _installed
@@ -29,6 +55,7 @@ def ensure() -> None:
     import jax
 
     if not hasattr(jax, "shard_map"):
+        ensure._bridged = True      # pre-VMA jax (see native_vma)
         from jax.experimental.shard_map import shard_map as _shard_map
 
         def shard_map(f, mesh=None, in_specs=None, out_specs=None,

@@ -12,13 +12,14 @@ from byteps_tpu.common.jax_compat import ensure as _ensure_jax_compat
 _ensure_jax_compat()
 
 from byteps_tpu.models.gpt import (GPTConfig, gpt_init, gpt_forward,
-                                   gpt_loss, gpt_pp_loss)
+                                   gpt_hidden, gpt_loss, gpt_pp_loss)
 from byteps_tpu.models.gpt import gpt_param_specs
 from byteps_tpu.models.generate import (
     KVCache, gpt_apply_cached, init_cache, make_generate_fn,
 )
 from byteps_tpu.models.bert import (
-    BertConfig, bert_init, bert_forward, bert_mlm_loss, bert_param_specs,
+    BertConfig, bert_init, bert_forward, bert_hidden, bert_mlm_loss,
+    bert_param_specs,
 )
 from byteps_tpu.models.moe_gpt import (
     MoEGPTConfig, moe_gpt_init, moe_gpt_loss, moe_gpt_param_specs,
@@ -40,11 +41,11 @@ from byteps_tpu.models.resnet import (
 )
 
 __all__ = [
-    "GPTConfig", "gpt_init", "gpt_forward", "gpt_loss", "gpt_pp_loss",
-    "gpt_param_specs",
+    "GPTConfig", "gpt_init", "gpt_forward", "gpt_hidden", "gpt_loss",
+    "gpt_pp_loss", "gpt_param_specs",
     "KVCache", "gpt_apply_cached", "init_cache", "make_generate_fn",
-    "BertConfig", "bert_init", "bert_forward", "bert_mlm_loss",
-    "bert_param_specs",
+    "BertConfig", "bert_init", "bert_forward", "bert_hidden",
+    "bert_mlm_loss", "bert_param_specs",
     "MoEGPTConfig", "moe_gpt_init", "moe_gpt_loss", "moe_gpt_param_specs",
     "moe_gpt_pp_loss",
     "ResNetConfig", "resnet_init", "resnet_forward", "resnet_loss",

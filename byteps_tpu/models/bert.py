@@ -91,12 +91,15 @@ def bert_param_specs(cfg: BertConfig, tp_axis: Optional[str]) -> Dict[str, Any]:
     }
 
 
-def bert_forward(params, tokens: jnp.ndarray, cfg: BertConfig,
-                 type_ids: Optional[jnp.ndarray] = None,
-                 tp_axis: Optional[str] = None,
-                 sp_axis: Optional[str] = None,
-                 remat: bool = False) -> jnp.ndarray:
-    """(B, S_local) tokens → f32 MLM logits (B, S_local, V)."""
+def bert_hidden(params, tokens: jnp.ndarray, cfg: BertConfig,
+                type_ids: Optional[jnp.ndarray] = None,
+                tp_axis: Optional[str] = None,
+                sp_axis: Optional[str] = None,
+                remat: bool = False) -> jnp.ndarray:
+    """Embeddings → blocks → MLM dense+LN, STOPPING before the tied
+    vocab readout: the shared trunk of :func:`bert_forward` (dense
+    logits) and the fused readout+CE path in :func:`bert_mlm_loss`.
+    Returns the pre-readout hidden in the activation dtype."""
     B, S_loc = tokens.shape
     off = jax.lax.axis_index(sp_axis) * S_loc if sp_axis is not None else 0
     pos = off + jnp.arange(S_loc)
@@ -116,26 +119,51 @@ def bert_forward(params, tokens: jnp.ndarray, cfg: BertConfig,
     # — bit-identical at f32 (default/test configs), MXU-native at bf16
     h = jax.nn.gelu(head_dot(x, params["mlm_w"]) + params["mlm_b"])
     h = _layernorm(h, params["mlm_ln_g"], params["mlm_ln_b"])
-    return (head_dot(h.astype(x.dtype), params["wte"].T)
-            + params["mlm_bias"])
+    return h.astype(x.dtype)
+
+
+def bert_forward(params, tokens: jnp.ndarray, cfg: BertConfig,
+                 type_ids: Optional[jnp.ndarray] = None,
+                 tp_axis: Optional[str] = None,
+                 sp_axis: Optional[str] = None,
+                 remat: bool = False) -> jnp.ndarray:
+    """(B, S_local) tokens → f32 MLM logits (B, S_local, V)."""
+    h = bert_hidden(params, tokens, cfg, type_ids=type_ids, tp_axis=tp_axis,
+                    sp_axis=sp_axis, remat=remat)
+    return head_dot(h, params["wte"].T) + params["mlm_bias"]
 
 
 def bert_mlm_loss(params, tokens, targets, mask, cfg: BertConfig,
                   dp_axis: Optional[str] = None,
                   tp_axis: Optional[str] = None,
                   sp_axis: Optional[str] = None,
-                  remat: bool = False) -> jnp.ndarray:
+                  remat: bool = False,
+                  chunked_ce=True) -> jnp.ndarray:
     """Masked-LM cross-entropy over ``mask`` positions only.
 
     ``tokens`` are the corrupted inputs, ``targets`` the originals, ``mask``
     a {0,1} (B, S) array of predicted positions. Same replication contract
     as gpt_loss (identical across tp; pmean over sp; dp-local unless
-    dp_axis given).
+    dp_axis given). ``chunked_ce`` is the tri-state fused readout+CE
+    knob (see ``gpt_loss``): truthy fuses the tied vocab readout +
+    ``mlm_bias`` + CE so the f32 (B, S, V) logits never materialize
+    (``ops/chunked_ce.py``; ``"vocab_parallel"`` opts into the tp vocab
+    split); ``False`` is the dense golden path.
     """
-    logits = bert_forward(params, tokens, cfg, tp_axis=tp_axis,
-                          sp_axis=sp_axis, remat=remat)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if chunked_ce:
+        from byteps_tpu.ops.chunked_ce import chunked_ce_nll
+
+        h = bert_hidden(params, tokens, cfg, tp_axis=tp_axis,
+                        sp_axis=sp_axis, remat=remat)
+        nll = chunked_ce_nll(
+            h, params["wte"].T.astype(jnp.float32), targets,
+            bias=params["mlm_bias"],
+            tp_axis=tp_axis if chunked_ce == "vocab_parallel" else None)
+    else:
+        logits = bert_forward(params, tokens, cfg, tp_axis=tp_axis,
+                              sp_axis=sp_axis, remat=remat)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     m = mask.astype(jnp.float32)
     axes = tuple(a for a in (dp_axis, sp_axis) if a is not None)
     num = (nll * m).sum()

@@ -482,22 +482,42 @@ def _nll(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
 
 
 def _readout_nll(params, h: jnp.ndarray, targets: jnp.ndarray,
-                 norm_fn=_layernorm, norm_eps: float = 1e-5) -> jnp.ndarray:
-    return _nll(_readout(params, h, norm_fn, norm_eps), targets)
+                 norm_fn=_layernorm, norm_eps: float = 1e-5,
+                 tp_axis: Optional[str] = None,
+                 chunked=True) -> jnp.ndarray:
+    """Final norm → per-token next-token NLL, shared by every
+    logits-bearing family (GPT dense/pipelined, MoE, T5 decoder).
+
+    ``chunked`` is the tri-state ``chunked_ce`` knob (see
+    :func:`gpt_loss`): truthy routes through the fused readout+CE path
+    (``ops/chunked_ce.py``) — the f32 (..., V) logits never materialize
+    — with ``"vocab_parallel"`` additionally splitting the vocab over
+    ``tp_axis`` (V/ntp per device, stats psum'd before the
+    log-partition). ``False`` is the dense escape hatch — the
+    ``head_dot`` + ``log_softmax`` chain, bit-identical to the chunked
+    path on single-device f32 configs and the golden it is pinned
+    against."""
+    h = norm_fn(h, params["lnf_g"], params.get("lnf_b"), norm_eps)
+    head = (params["lm_head"] if "lm_head" in params
+            else params["wte"].T).astype(jnp.float32)
+    if chunked:
+        from byteps_tpu.ops.chunked_ce import chunked_ce_nll
+
+        return chunked_ce_nll(
+            h, head, targets,
+            tp_axis=tp_axis if chunked == "vocab_parallel" else None)
+    return _nll(head_dot(h, head), targets)
 
 
-def gpt_forward(params, tokens: jnp.ndarray, cfg: GPTConfig,
-                tp_axis: Optional[str] = None,
-                sp_axis: Optional[str] = None,
-                remat: bool = False,
-                seq_layout: str = "contiguous") -> jnp.ndarray:
-    """Per-device forward: tokens (B_local, S_local) → logits (f32).
-
-    Single chip: all axes None, tokens are the whole batch/sequence.
-    Inside shard_map: tokens are this device's (dp, sp) block and the
-    weights its tp shard; output logits stay tp/dp/sp-local (replicated
-    over tp by construction).
-    """
+def gpt_hidden(params, tokens: jnp.ndarray, cfg: GPTConfig,
+               tp_axis: Optional[str] = None,
+               sp_axis: Optional[str] = None,
+               remat: bool = False,
+               seq_layout: str = "contiguous") -> jnp.ndarray:
+    """Embeddings → transformer blocks, STOPPING before the final norm +
+    readout: the shared trunk of :func:`gpt_forward` (dense logits) and
+    :func:`gpt_loss`'s fused readout+CE path (which never materializes
+    them)."""
     rope_base = resolve_rope(cfg)
     norm_fn, norm_eps = resolve_norm(cfg)
     x = _embed(params, tokens, cfg, sp_axis, seq_layout)
@@ -514,8 +534,25 @@ def gpt_forward(params, tokens: jnp.ndarray, cfg: GPTConfig,
     apply_block = maybe_remat(apply_block, remat)
     for p in params["blocks"]:
         x = apply_block(x, p)
+    return x
+
+
+def gpt_forward(params, tokens: jnp.ndarray, cfg: GPTConfig,
+                tp_axis: Optional[str] = None,
+                sp_axis: Optional[str] = None,
+                remat: bool = False,
+                seq_layout: str = "contiguous") -> jnp.ndarray:
+    """Per-device forward: tokens (B_local, S_local) → logits (f32).
+
+    Single chip: all axes None, tokens are the whole batch/sequence.
+    Inside shard_map: tokens are this device's (dp, sp) block and the
+    weights its tp shard; output logits stay tp/dp/sp-local (replicated
+    over tp by construction).
+    """
+    x = gpt_hidden(params, tokens, cfg, tp_axis, sp_axis, remat=remat,
+                   seq_layout=seq_layout)
     # f32 logits for a stable softmax/loss
-    return _readout(params, x, norm_fn, norm_eps)
+    return _readout(params, x, *resolve_norm(cfg))
 
 
 def gpt_pp_loss(params, tokens, targets, cfg: GPTConfig,
@@ -524,8 +561,11 @@ def gpt_pp_loss(params, tokens, targets, cfg: GPTConfig,
                 sp_axis: Optional[str] = None,
                 remat: bool = False,
                 vma_axes: tuple = (),
-                seq_layout: str = "contiguous") -> jnp.ndarray:
+                seq_layout: str = "contiguous",
+                chunked_ce=True) -> jnp.ndarray:
     """Pipeline-parallel next-token loss (inside shard_map over pp).
+    ``chunked_ce``: the tri-state fused readout+CE knob — see
+    :func:`gpt_loss`.
 
     ``params["blocks"]`` is THIS stage's stacked layer slab
     ((n_layers/pp, ...) — build with ``stack_blocks`` + ``stacked_specs``);
@@ -561,7 +601,8 @@ def gpt_pp_loss(params, tokens, targets, cfg: GPTConfig,
     y_mb = pipeline_apply(x_mb, params["blocks"], blk, pp_axis,
                           remat=remat, vma_axes=vma_axes)
     y = y_mb.reshape(B, S_loc, -1)
-    nll = _readout_nll(params, y, targets, norm_fn, norm_eps)
+    nll = _readout_nll(params, y, targets, norm_fn, norm_eps,
+                       tp_axis=tp_axis, chunked=chunked_ce)
     loss = nll.mean()
     if sp_axis is not None:
         # mean over the sequence shards (inside the grad — VMA types the
@@ -579,17 +620,31 @@ def gpt_loss(params, tokens, targets, cfg: GPTConfig,
              tp_axis: Optional[str] = None,
              sp_axis: Optional[str] = None,
              remat: bool = False,
-             seq_layout: str = "contiguous") -> jnp.ndarray:
+             seq_layout: str = "contiguous",
+             chunked_ce=True) -> jnp.ndarray:
     """Mean next-token cross-entropy, identical (replicated) on every device.
 
     The replication is what makes per-device ``jax.grad`` correct under
     shard_map: tp-sharded weights then need NO gradient collective, while
     dp/sp-replicated weights need a psum over (dp, sp) — exactly the
     aggregation `DistributedOptimizer` / `sync_grads` provide.
+
+    ``chunked_ce`` is tri-state: ``True`` (default) fuses readout+CE so
+    the f32 (B, S, V) logits never materialize (``ops/chunked_ce.py``),
+    with the vocab replicated over tp — per-device math identical to the
+    single-device path, so every cross-mesh equivalence pin holds
+    bit-tight. ``"vocab_parallel"`` additionally splits the readout's
+    vocab over tp (V/ntp logit columns per device — ntp× less readout
+    GEMM and live logits; the tp stat-combine reassociates the sum-exp,
+    so dp×tp drifts from dp-only by f32 roundoff — opt in where the
+    memory/FLOPs win outweighs cross-mesh bit-parity). ``False`` is the
+    dense golden path.
     """
-    logits = gpt_forward(params, tokens, cfg, tp_axis, sp_axis,
-                         remat=remat, seq_layout=seq_layout)
-    loss = _nll(logits, targets).mean()
+    x = gpt_hidden(params, tokens, cfg, tp_axis, sp_axis, remat=remat,
+                   seq_layout=seq_layout)
+    nll = _readout_nll(params, x, targets, *resolve_norm(cfg),
+                       tp_axis=tp_axis, chunked=chunked_ce)
+    loss = nll.mean()
     axes = tuple(a for a in (dp_axis, sp_axis) if a is not None)
     if axes:
         loss = jax.lax.pmean(loss, axes)

@@ -124,7 +124,8 @@ def moe_gpt_loss(params, tokens, targets, cfg: MoEGPTConfig,
                  tp_axis: Optional[str] = None,
                  sp_axis: Optional[str] = None,
                  remat: bool = False,
-                 seq_layout: str = "contiguous") -> jnp.ndarray:
+                 seq_layout: str = "contiguous",
+                 chunked_ce=True) -> jnp.ndarray:
     """Per-device next-token loss + Switch aux loss (local mean over this
     device's tokens, pmean'd over sequence shards — dp/ep averaging is
     the train step's job)."""
@@ -139,7 +140,8 @@ def moe_gpt_loss(params, tokens, targets, cfg: MoEGPTConfig,
     for p in params["blocks"]:
         x, aux = apply_block(x, p)
         aux_total = aux_total + aux
-    nll = _readout_nll(params, x, targets, *resolve_norm(cfg))
+    nll = _readout_nll(params, x, targets, *resolve_norm(cfg),
+                       tp_axis=tp_axis, chunked=chunked_ce)
     loss = nll.mean() + cfg.aux_coef * aux_total / cfg.n_layers
     if sp_axis is not None:
         loss = jax.lax.pmean(loss, sp_axis)
@@ -153,7 +155,8 @@ def moe_gpt_pp_loss(params, tokens, targets, cfg: MoEGPTConfig,
                     sp_axis: Optional[str] = None,
                     remat: bool = False,
                     vma_axes: tuple = (),
-                    seq_layout: str = "contiguous") -> jnp.ndarray:
+                    seq_layout: str = "contiguous",
+                    chunked_ce=True) -> jnp.ndarray:
     """Pipelined MoE loss (inside shard_map over pp): ``params["blocks"]``
     is THIS stage's stacked MoE-block slab. Same conventions as
     ``gpt_pp_loss`` — the returned scalar is per-device (masked nll on the
@@ -177,7 +180,8 @@ def moe_gpt_pp_loss(params, tokens, targets, cfg: MoEGPTConfig,
         remat=remat, vma_axes=vma_axes, has_aux=True,
     )
     y = y_mb.reshape(B, S_loc, -1)
-    nll = _readout_nll(params, y, targets, *resolve_norm(cfg)).mean()
+    nll = _readout_nll(params, y, targets, *resolve_norm(cfg),
+                       tp_axis=tp_axis, chunked=chunked_ce).mean()
     stage = jax.lax.axis_index(pp_axis)
     nstages = jax.lax.axis_size(pp_axis)
     masked_nll = jnp.where(stage == nstages - 1, nll, 0.0)

@@ -224,12 +224,15 @@ def t5_encode(params, src: jnp.ndarray, cfg: T5Config,
 def t5_decode(params, mem: jnp.ndarray, tgt_in: jnp.ndarray, cfg: T5Config,
               tp_axis: Optional[str] = None,
               sp_axis: Optional[str] = None,
-              remat: bool = False) -> jnp.ndarray:
+              remat: bool = False,
+              readout: bool = True) -> jnp.ndarray:
     """Teacher-forced decode: (B, S_tgt) shifted ids → f32 logits.
 
     With ``sp_axis``, the target side is sequence-sharded too: causal
     ring self-attention + rectangular cross-attention ring over the
-    sp-sharded encoder memory."""
+    sp-sharded encoder memory. ``readout=False`` stops before the final
+    norm + tied readout and returns the decoder hidden states —
+    :func:`t5_loss`'s fused readout+CE path consumes those directly."""
     S = tgt_in.shape[1]
     pos = _sp_positions(S, sp_axis)
     x = (params["wte"][tgt_in]
@@ -241,7 +244,7 @@ def t5_decode(params, mem: jnp.ndarray, tgt_in: jnp.ndarray, cfg: T5Config,
     apply_block = maybe_remat(apply_block, remat)
     for p in params["dec_blocks"]:
         x = apply_block(x, p)
-    return _readout(params, x)
+    return _readout(params, x) if readout else x
 
 
 def t5_forward(params, src: jnp.ndarray, tgt_in: jnp.ndarray, cfg: T5Config,
@@ -258,16 +261,26 @@ def t5_loss(params, src, tgt_in, tgt_out, cfg: T5Config,
             dp_axis: Optional[str] = None,
             tp_axis: Optional[str] = None,
             sp_axis: Optional[str] = None,
-            remat: bool = False) -> jnp.ndarray:
+            remat: bool = False,
+            chunked_ce=True) -> jnp.ndarray:
     """Mean next-token CE over the target side (teacher forcing).
 
     Replication contract mirrors gpt_loss: identical across tp; pmean
     over sp (each device's local target-chunk mean is one summand of the
     global mean — equal chunks, so mean-of-means is exact); dp-local
-    unless ``dp_axis`` is given."""
-    logits = t5_forward(params, src, tgt_in, cfg, tp_axis=tp_axis,
-                        sp_axis=sp_axis, remat=remat)
-    loss = _nll(logits, tgt_out).mean()
+    unless ``dp_axis`` is given. ``chunked_ce`` is the tri-state fused
+    readout+CE knob (see ``gpt_loss``): truthy fuses the tied readout +
+    CE over the decoder hidden states so the f32 (B, S_tgt, V) logits
+    never materialize (``ops/chunked_ce.py``; ``"vocab_parallel"`` opts
+    into the tp vocab split); ``False`` is the dense golden path."""
+    from byteps_tpu.models.gpt import _readout_nll
+
+    mem = t5_encode(params, src, cfg, tp_axis=tp_axis, sp_axis=sp_axis,
+                    remat=remat)
+    x = t5_decode(params, mem, tgt_in, cfg, tp_axis=tp_axis,
+                  sp_axis=sp_axis, remat=remat, readout=False)
+    loss = _readout_nll(params, x, tgt_out, tp_axis=tp_axis,
+                        chunked=chunked_ce).mean()
     axes = tuple(a for a in (dp_axis, sp_axis) if a is not None)
     if axes:
         loss = jax.lax.pmean(loss, axes)

@@ -473,6 +473,7 @@ def make_gpt_train_step(
     accum_steps: int = 1,
     seq_layout: str = "contiguous",
     init_params: Optional[Dict[str, Any]] = None,
+    chunked_ce=True,
 ):
     """Returns ``(step, params, opt_state, batch_sharding)``.
 
@@ -497,6 +498,14 @@ def make_gpt_train_step(
     positions and attention follow the layout — projected ~2x sp
     utilization for causal attention at scale, from the load-balance
     arithmetic; unmeasured, needs real multi-chip sp hardware).
+    ``chunked_ce=True`` (default) fuses readout+CE so the f32 (B, S, V)
+    logits never materialize (``ops/chunked_ce.py``; the flagship MFU
+    lever — docs/performance.md §attribution); ``"vocab_parallel"``
+    additionally splits the readout's vocab over tp (ntp× less readout
+    GEMM/live logits, at f32-roundoff drift from the dp-only trajectory
+    — see gpt_loss); ``False`` is the dense escape hatch the fused path
+    is pinned against. All three accepted by every logits-bearing
+    factory in this module.
     """
     dp, tp, sp = _axis(mesh, "dp"), _axis(mesh, "tp"), _axis(mesh, "sp")
     _check_seq_layout(seq_layout, sp)
@@ -520,7 +529,7 @@ def make_gpt_train_step(
     # pmean inside the loss would double-apply the 1/n_dp.
     loss_fn = functools.partial(
         gpt_loss, cfg=cfg, dp_axis=None, tp_axis=tp, sp_axis=sp,
-        remat=remat, seq_layout=seq_layout,
+        remat=remat, seq_layout=seq_layout, chunked_ce=chunked_ce,
     )
 
     def build_jit(pb):
@@ -573,6 +582,7 @@ def make_gpt_lora_train_step(
     remat: bool = False,
     accum_steps: int = 1,
     seq_layout: str = "contiguous",
+    chunked_ce=True,
 ):
     """LoRA fine-tuning step over a (dp[, tp][, sp]) mesh: the frozen
     base never moves and ONLY the adapter gradients ride the dp
@@ -642,7 +652,7 @@ def make_gpt_lora_train_step(
         grafted = graft_lora(base, adapters, scale)
         return gpt_loss(grafted, tokens, targets_, cfg, dp_axis=None,
                         tp_axis=tp, sp_axis=sp, remat=remat,
-                        seq_layout=seq_layout)
+                        seq_layout=seq_layout, chunked_ce=chunked_ce)
 
     def build_jit(pb):
         tx = _make_tx(mesh, base_tx, compression_params, pb, dp, **tx_kw)
@@ -691,6 +701,7 @@ def make_gpt_pp_train_step(
     zero_1: bool = False,
     seq_layout: str = "contiguous",
     init_params: Optional[Dict[str, Any]] = None,
+    chunked_ce=True,
 ):
     """Pipeline-parallel GPT train step over a (pp, dp[, tp][, sp]) mesh.
 
@@ -756,7 +767,7 @@ def make_gpt_pp_train_step(
         gpt_pp_loss, cfg=cfg, pp_axis=pp, n_micro=n_micro, tp_axis=tp,
         sp_axis=sp, remat=remat,
         vma_axes=tuple(mesh.axis_names) if use_vma else (),
-        seq_layout=seq_layout,
+        seq_layout=seq_layout, chunked_ce=chunked_ce,
     )
 
     def build_jit(pb):
@@ -782,6 +793,7 @@ def make_gpt_moe_train_step(
     remat: bool = False,
     zero_1: bool = False,
     seq_layout: str = "contiguous",
+    chunked_ce=True,
 ):
     """Expert-parallel MoE GPT train step over a (dp, ep[, tp][, sp]) mesh.
 
@@ -842,7 +854,8 @@ def make_gpt_moe_train_step(
     resym = _make_resymmetrize(pspecs, dp)
     loss_fn = functools.partial(moe_gpt_loss, cfg=cfg, ep_axis=ep,
                                 tp_axis=tp, sp_axis=sp, remat=remat,
-                                seq_layout=seq_layout)
+                                seq_layout=seq_layout,
+                                chunked_ce=chunked_ce)
 
     def build_jit(pb):
         tx = _make_tx(mesh, base_tx, compression_params, pb, dp, **tx_kw)
@@ -898,6 +911,7 @@ def make_gpt_moe_pp_train_step(
     remat: bool = False,
     zero_1: bool = False,
     seq_layout: str = "contiguous",
+    chunked_ce=True,
 ):
     """Pipelined MoE GPT over a (pp, dp[, ep][, tp][, sp]) mesh — the full
     composition: GPipe microbatch pipelining whose stages hold MoE blocks
@@ -959,7 +973,7 @@ def make_gpt_moe_pp_train_step(
         moe_gpt_pp_loss, cfg=cfg, pp_axis=pp, n_micro=n_micro,
         ep_axis=ep, tp_axis=tp, sp_axis=sp, remat=remat,
         vma_axes=tuple(mesh.axis_names) if use_vma else (),
-        seq_layout=seq_layout,
+        seq_layout=seq_layout, chunked_ce=chunked_ce,
     )
 
     def build_jit(pb):
@@ -986,10 +1000,11 @@ def make_bert_train_step(
     remat: bool = False,
     zero_1: bool = False,
     accum_steps: int = 1,
+    chunked_ce=True,
 ):
     """``step(params, opt_state, tokens, targets, mask)`` — MLM pretraining
     step (BASELINE config 3 shape), same sharding story as GPT (zero_1 /
-    accum_steps semantics included)."""
+    accum_steps / chunked_ce semantics included)."""
     dp, tp, sp = _axis(mesh, "dp"), _axis(mesh, "tp"), _axis(mesh, "sp")
     use_vma = compression_params is None and not zero_1
     pspecs = bert_param_specs(cfg, tp)
@@ -1006,7 +1021,7 @@ def make_bert_train_step(
     resym = _make_resymmetrize(pspecs, dp)
     loss_fn = functools.partial(
         bert_mlm_loss, cfg=cfg, dp_axis=None, tp_axis=tp, sp_axis=sp,
-        remat=remat,
+        remat=remat, chunked_ce=chunked_ce,
     )
 
     def build_jit(pb):
@@ -1060,6 +1075,7 @@ def make_t5_train_step(
     remat: bool = False,
     zero_1: bool = False,
     accum_steps: int = 1,
+    chunked_ce=True,
 ):
     """``step(params, opt_state, src, tgt_in, tgt_out) -> (loss, params,
     opt_state)`` — encoder-decoder seq2seq over a (dp, tp, sp) mesh;
@@ -1085,6 +1101,7 @@ def make_t5_train_step(
     resym = _make_resymmetrize(pspecs, dp)
     loss_fn = functools.partial(
         t5_loss, cfg=cfg, dp_axis=None, tp_axis=tp, sp_axis=sp, remat=remat,
+        chunked_ce=chunked_ce,
     )
 
     def build_jit(pb):
@@ -1272,12 +1289,14 @@ def synthetic_mlm_batch(rng: jnp.ndarray, cfg: BertConfig, batch: int,
     tokens = jnp.where(mask, mask_id, targets)
     return tokens, targets, mask.astype(jnp.int32)
 
-def make_eval_step(cfg: GPTConfig, mesh: Mesh, seq_layout: str = "contiguous"):
+def make_eval_step(cfg: GPTConfig, mesh: Mesh, seq_layout: str = "contiguous",
+                   chunked_ce=True):
     """Jitted eval step: ``eval_step(params, tokens, targets) -> mean nll``
     over the (dp, sp)-sharded batch — exp() of the running mean is the
     perplexity. Shares gpt_loss (and therefore every config option:
-    rope/GQA/SwiGLU, zigzag layout) with the train factories; no
-    optimizer, no grads, safe to call on training params at any step.
+    rope/GQA/SwiGLU, zigzag layout, chunked readout+CE) with the train
+    factories; no optimizer, no grads, safe to call on training params at
+    any step.
     """
     dp, tp, sp = _axis(mesh, "dp"), _axis(mesh, "tp"), _axis(mesh, "sp")
     _check_seq_layout(seq_layout, sp)
@@ -1286,7 +1305,8 @@ def make_eval_step(cfg: GPTConfig, mesh: Mesh, seq_layout: str = "contiguous"):
 
     def per_device(params, tokens, targets):
         loss = gpt_loss(params, tokens, targets, cfg, dp_axis=dp,
-                        tp_axis=tp, sp_axis=sp, seq_layout=seq_layout)
+                        tp_axis=tp, sp_axis=sp, seq_layout=seq_layout,
+                        chunked_ce=chunked_ce)
         return _collapse_vma(loss)
 
     sharded = jax.shard_map(

@@ -12,6 +12,7 @@ from byteps_tpu.common.jax_compat import ensure as _ensure_jax_compat
 
 _ensure_jax_compat()
 
+from byteps_tpu.ops.chunked_ce import chunked_ce_nll, dense_ce_nll
 from byteps_tpu.ops.flash_attention import (
     attention_jnp,
     flash_attention,
@@ -26,7 +27,7 @@ from byteps_tpu.ops.onebit_kernels import (
 )
 
 __all__ = [
-    "attention_jnp", "flash_attention", "flash_attention_lse",
-    "merge_attention",
+    "attention_jnp", "chunked_ce_nll", "dense_ce_nll", "flash_attention",
+    "flash_attention_lse", "merge_attention",
     "onebit_pack", "onebit_unpack", "onebit_unpack_sum", "packed_words",
 ]

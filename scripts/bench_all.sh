@@ -26,9 +26,12 @@ run --model gpt2m                        # MFU-honest large config (uncompressed
 run --model vit                          # beyond-reference families
 run --model t5
 run --model moe                          # Switch-MoE routing overhead vs dense
+run --ce dense                           # flagship w/o fused CE (A/B attribution)
 run --mode generate                      # KV-cache decode vs full recompute
 run --mode dcn                           # DCN summation tier
 run --mode dcn-profile                   # host component ceilings
+run --mode throttled                     # compression race on emulated slow DCN
+run --mode tune                          # joint (partition, credit) auto-tune
 
 echo "collected $(wc -l < "$OUT") results in $OUT" >&2
 cat "$OUT"

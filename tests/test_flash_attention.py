@@ -299,3 +299,74 @@ def test_mqa_extreme_kernel(causal):
     ow, _ = attention_lse_jnp(q, k, v, 0, 0, causal=causal)
     np.testing.assert_allclose(np.asarray(o), np.asarray(ow),
                                rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# block-choice / VMEM-budget pins (VERDICT r5 #5): the round-5 retune's
+# 1.75× came entirely from these tile choices — a silent edit to
+# _FWD_PREFER/_BWD_PREFER or the walk-down must fail HERE, not resurface
+# as 22 TFLOP/s in a bench three rounds later.
+# ---------------------------------------------------------------------------
+def _vmem_cost(bq, bk, D, itemsize, n_inter):
+    """The same live-set model _train_blocks budgets against."""
+    inter = n_inter * bq * bk * 4
+    io = 2 * 2 * (2 * bq + 2 * bk) * D * itemsize
+    scratch = (bq + 2 * bk) * D * 4
+    return inter + io + scratch
+
+
+def test_train_blocks_retuned_gpt2m_tiles():
+    """The measured-optimal tiles on the retune shapes (v5e, bf16, D=64):
+    forward whole-sequence k-tiles at S=1024, backward 512s."""
+    from byteps_tpu.ops.flash_attention import (
+        _BWD_PREFER, _FWD_PREFER, _train_blocks)
+
+    assert _train_blocks(1024, 1024, 64, 2, _FWD_PREFER, n_inter=2) == \
+        (1024, 1024)
+    assert _train_blocks(1024, 1024, 64, 2, _BWD_PREFER, n_inter=4) == \
+        (512, 512)
+    # flagship S=512: both paths take whole-sequence tiles
+    assert _train_blocks(512, 512, 64, 2, _FWD_PREFER, n_inter=2) == \
+        (512, 512)
+    assert _train_blocks(512, 512, 64, 2, _BWD_PREFER, n_inter=4) == \
+        (512, 512)
+
+
+@pytest.mark.parametrize("itemsize,D,n_inter", [
+    (4, 64, 2), (4, 64, 4),            # f32 activations
+    (2, 256, 2), (2, 256, 4),          # max head_dim
+    (4, 256, 4),                       # both at once (worst case)
+])
+def test_train_blocks_walkdown_respects_vmem_budget(itemsize, D, n_inter):
+    """f32 / wide-head shapes must degrade to smaller tiles that FIT the
+    budget instead of shipping the bf16-measured 1024s to Mosaic."""
+    from byteps_tpu.ops.flash_attention import (
+        _FWD_PREFER, _VMEM_BUDGET, _train_blocks)
+
+    bq, bk = _train_blocks(1024, 1024, D, itemsize, _FWD_PREFER,
+                           n_inter=n_inter)
+    assert 1024 % bq == 0 and 1024 % bk == 0
+    assert _vmem_cost(bq, bk, D, itemsize, n_inter) <= _VMEM_BUDGET
+    # the (greedy) walk-down must not collapse to pipeline-overhead
+    # territory on these shapes — 256² was the measured 22 TFLOP/s
+    # regime the retune escaped, and every shape here still fits ≥256
+    assert min(bq, bk) >= 256
+
+
+def test_train_blocks_none_contract():
+    """Indivisible sequence lengths return None (the documented
+    jnp-fallback signal), never raise."""
+    from byteps_tpu.ops.flash_attention import _FWD_PREFER, _train_blocks
+
+    assert _train_blocks(1023, 1024, 64, 2, _FWD_PREFER) is None
+    assert _train_blocks(1024, 7, 64, 2, _FWD_PREFER) is None
+
+
+def test_train_blocks_env_override(monkeypatch):
+    """BYTEPS_FLASH_BLOCK prepends experiment tiles (still
+    budget-checked)."""
+    from byteps_tpu.ops.flash_attention import _FWD_PREFER, _train_blocks
+
+    monkeypatch.setenv("BYTEPS_FLASH_BLOCK", "256")
+    assert _train_blocks(1024, 1024, 64, 2, _FWD_PREFER, n_inter=2) == \
+        (256, 256)
