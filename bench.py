@@ -1329,6 +1329,140 @@ def bench_throttled(rates_mbps=(64, 200, 800), reps: int = 3,
     }
 
 
+def bench_chaos(payload_mb: int = 8, rounds: int = 4, reps: int = 3) -> dict:
+    """Goodput degradation vs fault rate (docs/robustness.md): the chaos
+    matrix {clean, 5% push-ack loss, one server down} × {raw, onebit}
+    through the full DcnCore pipeline against TWO summation servers
+    (server 0 in-process, server 1 a subprocess). Fault injection is the
+    deterministic application-level layer (``BYTEPS_FAULT_SPEC``,
+    common/faults.py) — same philosophy as the throttled bench's pacer.
+
+    * ``timeouts5``: 5% of push acks are lost; the retry engine re-sends
+      (replay-deduped server-side) — the cost is retries + backoff.
+    * ``server_down``: server 1 is unreachable from the start; the ping
+      health monitor marks it dead and its keys fail over to server 0 —
+      the cost is halved server capacity plus the retry/failover bumps.
+
+    Per-config medians of ``reps`` timed blocks (each ``rounds``
+    push_pulls of a ``payload_mb`` MB gradient) with [min, max] spreads,
+    plus the worker's retry/failover counters — the dPRO-visible
+    evidence that the degradation is fault handling, not noise."""
+    import dataclasses as _dc
+    import subprocess
+    import sys
+    import threading  # noqa: F401  (parity with sibling benches)
+
+    from byteps_tpu.common import config as config_mod
+    from byteps_tpu.common.dcn_adapter import DcnCore
+    from byteps_tpu.compression import wire
+    from byteps_tpu.server import start_server, stop_server
+
+    base_port = 24800
+    nelems = payload_mb * (1 << 20) // 4
+    flat = np.random.default_rng(0).standard_normal(nelems).astype(
+        np.float32)
+    dense_bytes = flat.nbytes
+    base_cfg = config_mod.Config.from_env()
+    configs = [
+        ("clean", ""),
+        ("timeouts5", "push:timeout@p=0.05"),
+        ("server_down", "server1:down"),
+    ]
+    codecs = [("raw", lambda: None),
+              ("onebit", lambda: wire.OnebitWire(scaling=True))]
+    results = {}
+    run_id = 0
+    for fname, spec in configs:
+        results[fname] = {}
+        for cname, mk in codecs:
+            p0 = base_port + run_id * 2
+            p1 = p0 + 1
+            run_id += 1
+            cfg = _dc.replace(
+                base_cfg, num_worker=1, num_server=2,
+                fault_spec=spec, fault_seed=0,
+                retry_limit=8, retry_backoff_ms=10,
+                health_interval_ms=50 if spec else 0, health_miss_limit=3,
+            )
+            config_mod.set_config(cfg)
+            start_server(port=p0, num_workers=1, engine_threads=4,
+                         async_mode=False)
+            proc = subprocess.Popen(
+                [sys.executable, "-c",
+                 "from byteps_tpu.server import start_server;"
+                 "from byteps_tpu.server.native import load_lib;"
+                 "start_server(port=%d, num_workers=1, engine_threads=4,"
+                 "async_mode=False); load_lib().bps_server_wait()" % p1],
+                env={**os.environ,
+                     "PYTHONPATH": os.path.dirname(
+                         os.path.abspath(__file__))},
+            )
+            core = None
+            try:
+                core = DcnCore(
+                    servers=[("127.0.0.1", p0), ("127.0.0.1", p1)])
+                if fname == "server_down":
+                    # let the health monitor finish the failover before
+                    # the timed blocks (its cost shows in the counters)
+                    deadline = time.time() + 20
+                    while (time.time() < deadline
+                           and 1 in core.worker.live_servers()):
+                        time.sleep(0.05)
+                times = []
+                for rep in range(reps + 1):  # rep 0 = warmup/key init
+                    t0 = time.perf_counter()
+                    for r in range(rounds):
+                        h = core.push_pull_async(
+                            flat, name=f"chaos.{fname}.{cname}",
+                            codec=mk())
+                        out = DcnCore.assemble(h, timeout=300.0)
+                    elapsed = time.perf_counter() - t0
+                    if rep > 0:
+                        times.append(elapsed / rounds)
+                assert out.size == nelems
+                counters = core.worker.get_counters()
+            finally:
+                if core is not None:
+                    core.shutdown()
+                stop_server()
+                if proc.poll() is None:
+                    proc.kill()
+                config_mod.reset_config()
+            times.sort()
+            med = float(np.median(times))
+            eff = 2 * dense_bytes / med / 1e9
+            results[fname][cname] = {
+                "sec_per_round_med": round(med, 4),
+                "sec_spread": [round(times[0], 4), round(times[-1], 4)],
+                "dense_gbps_eff": round(eff, 3),
+                "counters": {k: v for k, v in counters.items() if v},
+            }
+            _log(f"chaos {fname:>11} {cname:>6}: {med*1e3:7.1f} ms/round "
+                 f"[{times[0]*1e3:.1f}, {times[-1]*1e3:.1f}], "
+                 f"{eff:.2f} GB/s eff, counters={results[fname][cname]['counters']}")
+        for cname, _ in codecs:
+            clean = results["clean"][cname]["sec_per_round_med"]
+            r = results[fname][cname]
+            r["goodput_vs_clean"] = round(
+                clean / r["sec_per_round_med"], 3)
+    worst = min(results[f][c]["goodput_vs_clean"]
+                for f, _ in configs for c, _ in codecs)
+    return {
+        "metric": ("chaos goodput degradation (DcnCore, 1 worker + 2 "
+                   "servers, fault injection: clean / 5% push-ack loss / "
+                   "one server down)"),
+        "value": worst,
+        "unit": "x of clean goodput (worst chaos config)",
+        "vs_baseline": worst,
+        "payload_mb": payload_mb,
+        "rounds_per_rep": rounds,
+        "reps": reps,
+        "retry_limit": 8,
+        "retry_backoff_ms": 10,
+        "results": results,
+    }
+
+
 def bench_tuner(payload_mb: int = 8, max_moves: int = 40,
                 reps: int = 5) -> dict:
     """Joint (partition, credit) auto-tuning demonstrated on a real
@@ -1476,7 +1610,7 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode",
                     choices=["auto", "dcn", "dcn-profile", "throttled",
-                             "tune", "generate", "profile"],
+                             "tune", "chaos", "generate", "profile"],
                     default="auto")
     ap.add_argument("--rates", default="64,200,800",
                     help="throttled mode: comma-separated emulated link "
@@ -1509,7 +1643,7 @@ def main() -> None:
         _log(f"bench: WARNING --ce has no effect on {args.model} — its "
              "class-count logits are tiny, so there is no chunked-CE path "
              "to toggle (docs/models.md families table)")
-    if args.mode in ("dcn", "dcn-profile", "throttled", "tune"):
+    if args.mode in ("dcn", "dcn-profile", "throttled", "tune", "chaos"):
         if flags_set:
             _log("bench: WARNING --model/--compressor/--ce ignored in "
                  f"{args.mode} mode")
@@ -1520,6 +1654,11 @@ def main() -> None:
             result = bench_dcn()
         elif args.mode == "tune":
             result = bench_tuner()
+        elif args.mode == "chaos":
+            result = bench_chaos()
+            with open("BENCH_chaos.json", "w") as f:
+                json.dump(result, f, indent=1)
+            _log("bench: wrote BENCH_chaos.json")
         else:
             result = bench_dcn_profile()
     elif args.mode == "profile":

@@ -115,6 +115,34 @@ class Config:
     # 0 disables. See server/pacer.py and bench.py --mode throttled.
     dcn_throttle_mbps: float = 0.0
 
+    # --- robustness / chaos (docs/robustness.md) ---------------------------
+    # Deterministic fault injection at the PSWorker wire boundary
+    # (common/faults.py grammar); empty = off. Arming it also turns on
+    # wire CRC so injected corruption is detected, not summed.
+    fault_spec: str = ""
+    fault_seed: int = 0
+    # Worker-side retry engine: retryable wire errors (recv timeout, dead
+    # socket, desync, CRC mismatch) are retried up to this many times per
+    # op with exponential backoff (base below, x2 per attempt, capped at
+    # 2 s) + seeded jitter. Replay-safe: a re-sent push carries the same
+    # (worker, key, version) and the server dedupes it.
+    retry_limit: int = 8
+    retry_backoff_ms: int = 50
+    # CRC32 on wire payloads (frame header crc field): pushes are verified
+    # server-side before summing, pull responses worker-side. Off by
+    # default (a software CRC pass per 4 MB partition is measurable);
+    # forced on while fault injection is armed.
+    wire_crc: bool = False
+    # Health monitor: > 0 pings every server each interval from a
+    # background thread; after `health_miss_limit` consecutive misses the
+    # server is marked dead and its keys fail over to the survivors
+    # (rendezvous hash over the live set). 0 disables.
+    health_interval_ms: int = 0
+    health_miss_limit: int = 3
+    # With no live server left: True degrades push_pull to the pod-local
+    # (pure-ICI) sum with a loud log + counters; False fails the handle.
+    degraded_ok: bool = True
+
     # --- tracing (SURVEY §5.1) ---------------------------------------------
     trace_on: bool = False
     trace_dir: str = "./traces"
@@ -162,6 +190,14 @@ class Config:
             log_level=_env_str("BYTEPS_LOG_LEVEL", "INFO").upper(),
             min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES", 65536),
             dcn_throttle_mbps=_env_float("BYTEPS_DCN_THROTTLE_MBPS", 0.0),
+            fault_spec=_env_str("BYTEPS_FAULT_SPEC", ""),
+            fault_seed=_env_int("BYTEPS_FAULT_SEED", 0),
+            retry_limit=_env_int("BYTEPS_RETRY_LIMIT", 8),
+            retry_backoff_ms=_env_int("BYTEPS_RETRY_BACKOFF_MS", 50),
+            wire_crc=_env_bool("BYTEPS_WIRE_CRC"),
+            health_interval_ms=_env_int("BYTEPS_HEALTH_INTERVAL_MS", 0),
+            health_miss_limit=_env_int("BYTEPS_HEALTH_MISS_LIMIT", 3),
+            degraded_ok=_env_bool("BYTEPS_DEGRADED_OK", True),
             trace_on=_env_bool("BYTEPS_TRACE_ON"),
             trace_dir=_env_str("BYTEPS_TRACE_DIR", "./traces"),
             trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 1),

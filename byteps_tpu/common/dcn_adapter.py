@@ -26,9 +26,59 @@ from byteps_tpu.common.scheduler import (
 )
 from byteps_tpu.common.tracing import get_tracer
 from byteps_tpu.compression.wire import Fp16Wire, WireCodec, WirePlan
-from byteps_tpu.server import PSWorker
+from byteps_tpu.server import NoLiveServersError, PSWorker
 
 log = get_logger("dcn_adapter")
+
+
+class DegradedLocal:
+    """Marker payload riding PULL when the whole DCN tier is dead: carries
+    the encoded LOCAL contribution through the pipeline so DECOMPRESS
+    yields this worker's own sum instead of the cross-worker one —
+    graceful degradation (BYTEPS_DEGRADED_OK) rather than a failed handle.
+    Shared with the jax hybrid pipeline, where the local contribution is
+    the pod's pure-ICI sum."""
+
+    __slots__ = ("payload",)
+
+    def __init__(self, payload):
+        self.payload = payload
+
+
+def degraded_fallback(worker, cfg, task, adapter_log, what: str):
+    """Shared no-live-servers gate for the PUSH stages (DcnCore + jax
+    hybrid): raises fail-fast when BYTEPS_DEGRADED_OK is off, else counts
+    the fallback, warns once, and wraps the task's payload (the encoded
+    LOCAL contribution) in :class:`DegradedLocal`.
+
+    Degradation is recorded PER PARTITION: ``handle.degraded_parts`` maps
+    part_idx -> (offset, length). A handle can be mixed — earlier
+    partitions aggregated globally before the last server died — so
+    averaging consumers (torch/tf synchronize, the jax COPYH2D stage)
+    must scale slice-by-slice: global slices divide by the global size,
+    degraded slices by the LOCAL participant count the fallback could
+    actually reach."""
+    p = task.partition
+    if not cfg.degraded_ok:
+        err = NoLiveServersError(
+            f"push {task.name}.{p.part_idx}: no live summation servers "
+            "(BYTEPS_DEGRADED_OK=0)")
+        # fail-fast: a stage retry cannot help when degrading is forbidden
+        err.retryable = False
+        raise err
+    worker._count("ici_fallbacks")
+    if worker.counters["ici_fallbacks"] == 1:
+        adapter_log.warning(
+            "no live summation servers: degrading push_pull to %s "
+            "(BYTEPS_DEGRADED_OK)", what)
+    task.degraded = True  # DECOMPRESS decodes the PUSH-side encoding
+    with task.handle._lock:
+        parts = getattr(task.handle, "degraded_parts", None)
+        if parts is None:
+            parts = {}
+            task.handle.degraded_parts = parts
+        parts[p.part_idx] = (p.offset, p.length)
+    return DegradedLocal(task.payload)
 
 
 def wire_codec_for(compression: Optional[str]) -> Optional[WireCodec]:
@@ -63,13 +113,18 @@ class DcnCore:
         self.cfg = cfg
         self.worker = PSWorker(servers=servers, worker_id=worker_id)
         self.registry = TensorRegistry()
+        # PUSH/PULL are stage-retryable: the second line of defense above
+        # PSWorker's wire retries — a mid-flight failover (FailedOverError)
+        # re-runs the stage against the new placement with a fresh round
+        # number instead of failing the Handle.
         self.scheduler = PipelineScheduler(
             stages=[
                 Stage("COMPRESS", self._compress_stage, credited=True,
                       pool_size=2),
                 Stage("PUSH", self._push_stage, credited=True, pool_size=4,
-                      releases_credit=True),
-                Stage("PULL", self._pull_stage, pool_size=4),
+                      releases_credit=True, retryable=True),
+                Stage("PULL", self._pull_stage, pool_size=4,
+                      retryable=True),
                 Stage("DECOMPRESS", self._decompress_stage, pool_size=2),
             ],
             credit=cfg.scheduling_credit,
@@ -109,6 +164,11 @@ class DcnCore:
 
     def _push_stage(self, task: PartitionTask):
         p = task.partition
+        if not self.worker.has_live_servers():
+            # total DCN outage: degrade to the local contribution instead
+            # of failing the handle (docs/robustness.md)
+            return degraded_fallback(self.worker, self.cfg, task, log,
+                                     "LOCAL sums")
         plan: Optional[WirePlan] = task.context["plans"][p.part_idx]
         store_bytes = (
             plan.codec.store_elems(p.length) * 4 if plan is not None
@@ -124,10 +184,20 @@ class DcnCore:
             # must precede its own push (serial on this connection)
             self.worker.init_key(p.key, store_bytes)
         codec_id = plan.codec.codec_id if plan is not None else 0
-        return self.worker.push_bytes(p.key, task.payload, codec_id)
+        # pin the round across STAGE retries: a re-run whose first try's
+        # push WAS applied (wire budget exhausted on lost acks) must
+        # re-send the same version for the server dedupe to recognize it;
+        # push_bytes discards a pin that predates a failover reset
+        version = self.worker.push_bytes(
+            p.key, task.payload, codec_id,
+            version=getattr(task, "push_version", None))
+        task.push_version = version
+        return version
 
     def _pull_stage(self, task: PartitionTask):
         p = task.partition
+        if isinstance(task.payload, DegradedLocal):
+            return task.payload.payload  # DECOMPRESS decodes the local sum
         plan: Optional[WirePlan] = task.context["plans"][p.part_idx]
         capacity = (plan.pull_capacity(p.length) if plan is not None
                     else p.length * 4)
@@ -140,12 +210,15 @@ class DcnCore:
         p = task.partition
         plan: Optional[WirePlan] = task.context["plans"][p.part_idx]
         buf = np.ascontiguousarray(task.payload)
+        seed = self._wire_seed(task.name, task.context["version"],
+                               p.part_idx)
         if plan is None:
             return buf.view(np.float32)
-        return plan.decode_pull(
-            buf, p.length,
-            self._wire_seed(task.name, task.context["version"], p.part_idx),
-        )
+        if getattr(task, "degraded", False):
+            # degraded payload is the PUSH-side encoding (the pull wire
+            # format never existed for this round)
+            return plan.codec.decode(buf, p.length, seed)
+        return plan.decode_pull(buf, p.length, seed)
 
     # -- public -------------------------------------------------------------
     def push_pull_async(self, flat: np.ndarray, name: str,

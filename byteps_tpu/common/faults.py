@@ -1,0 +1,257 @@
+"""Deterministic DCN fault injection (``BYTEPS_FAULT_SPEC``).
+
+The reference stack survives real DCN weather — slow servers, dropped
+connections, stragglers — because ps-lite carries retry/resend machinery
+under BytePS. Our port needs the matching *emulated failure surface* so the
+self-healing data plane (PSWorker retries, scheduler stage retries, health
+failover) can be exercised deterministically on loopback: same philosophy
+as the PR-1 bandwidth pacer (``server/pacer.py``) — application-level, no
+root/netem/tc, one plan per PSWorker, reproducible from a seed.
+
+Spec grammar (semicolon-separated rules)::
+
+    BYTEPS_FAULT_SPEC = rule (';' rule)*
+    rule   = scope ':' kind ['@' cond (',' cond)*]
+    scope  = 'push' | 'pull' | 'all' | 'server<N>'
+             # push/pull/all match DATA-PLANE ops only ('all' = push+pull);
+             # server<N> matches every op against that server, including
+             # init and the health monitor's pings
+    kind   = 'timeout' | 'kill' | 'slow' | 'corrupt' | 'down'
+    cond   = 'p=' FLOAT          # per-op Bernoulli (seeded RNG)
+           | 'op=' A ['..' [B]]  # plan-op window, inclusive; open end ok
+           | 'step=' ...         # alias of op=
+           | 'ms=' INT           # slow: injected latency (default 50)
+
+Examples: ``push:timeout@p=0.05`` — 5% of push attempts lose their
+response; ``server1:down@step=40..55`` — every op against server 1 fails
+while the plan step is in [40, 55]; ``pull:corrupt@p=0.01`` — 1% of pull
+responses get a byte flipped (the CRC32 in the wire frame detects it and
+the retry engine re-pulls).
+
+Semantics the consumers rely on:
+
+* **step/op counter** — ticks once per *intercepted wire attempt*
+  (including retries), per plan. This is what makes a transient ``down``
+  window survivable by pure retry/backoff: each failed attempt advances
+  the counter, so a 15-step window expires after at most ~15 attempts
+  even when nothing else makes progress. It is NOT the training step.
+* **timeout** — the op is performed for real and only then reported as a
+  recv timeout (models a lost *response*: the server applied the push).
+  This is the path that proves the server's (worker, key, version) replay
+  dedupe — the retry re-sends a push the server already summed.
+* **kill** — the op never happens (connection dies before the request
+  leaves); the injector kills the live socket so the next attempt
+  reconnects.
+* **corrupt** — a byte of the payload is flipped *after* the CRC was
+  computed (push) or *before* it is verified (pull), so the corruption is
+  always detected, never silently summed.
+* **down** — every op in scope fails with a connection error while the
+  window is active (and the socket is killed), emulating a dead/unreachable
+  server process.
+
+Determinism: one ``random.Random(seed * 1000003 + worker_id)`` per plan,
+advanced only by probability rules, under a lock. Single-threaded
+workloads replay exactly; multi-threaded ones are reproducible up to op
+interleaving (same as the reference's real network, minus the physics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from byteps_tpu.common.logging import get_logger
+
+log = get_logger("faults")
+
+__all__ = [
+    "FaultRule", "FaultPlan", "Injection", "InjectedTimeout",
+    "InjectedConnectionError", "ServerDownError", "parse_fault_spec",
+    "plan_from_env",
+]
+
+KINDS = ("timeout", "kill", "slow", "corrupt", "down")
+
+
+class InjectedTimeout(TimeoutError):
+    """Injected recv timeout — the response (not the request) was lost."""
+
+
+class InjectedConnectionError(ConnectionError):
+    """Injected connection kill — the request never reached the server."""
+
+
+class ServerDownError(ConnectionError):
+    """Injected server-down window: the server is unreachable."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultRule:
+    scope: str                 # 'push' | 'pull' | 'all' | 'server<N>'
+    kind: str                  # one of KINDS
+    p: Optional[float] = None  # per-op probability (None = always/window)
+    window: Optional[Tuple[int, Optional[int]]] = None  # [a, b] op window
+    latency_ms: int = 50       # for kind == 'slow'
+    server: Optional[int] = None  # parsed from 'server<N>' scopes
+
+    def matches(self, op: str, sidx: int, step: int, rng) -> bool:
+        if self.server is not None:
+            # server scopes hit EVERY op against that server — data plane,
+            # init, and the health monitor's pings (that is what lets a
+            # 'down' window trip the monitor)
+            if sidx != self.server:
+                return False
+        else:
+            # push/pull/all scopes are DATA-PLANE only: loss specs must
+            # not make the health monitor count injected ping misses and
+            # fail over perfectly healthy servers
+            if op not in ("push", "pull"):
+                return False
+            if self.scope != "all" and self.scope != op:
+                return False
+        if self.window is not None:
+            a, b = self.window
+            if step < a or (b is not None and step > b):
+                return False
+        if self.p is not None and rng.random() >= self.p:
+            return False
+        return True
+
+
+@dataclasses.dataclass
+class Injection:
+    """What the interceptor decided for one wire attempt."""
+
+    kind: str
+    rule: FaultRule
+    # for 'corrupt': which payload byte to flip (modulo the buffer size)
+    corrupt_at: int = 0
+
+
+def parse_fault_spec(spec: str) -> List[FaultRule]:
+    rules: List[FaultRule] = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            head, _, conds = part.partition("@")
+            scope, _, kind = head.partition(":")
+            scope = scope.strip().lower()
+            kind = kind.strip().lower()
+            if kind not in KINDS:
+                raise ValueError(f"unknown fault kind {kind!r}")
+            server = None
+            if scope.startswith("server"):
+                server = int(scope[len("server"):])
+            elif scope not in ("push", "pull", "all"):
+                raise ValueError(f"unknown fault scope {scope!r}")
+            p = None
+            window = None
+            latency_ms = 50
+            for cond in filter(None, (c.strip() for c in conds.split(","))):
+                k, _, v = cond.partition("=")
+                k = k.strip().lower()
+                if k == "p":
+                    p = float(v)
+                elif k in ("op", "step"):
+                    a, dots, b = v.partition("..")
+                    lo = int(a)
+                    hi = None if (dots and not b.strip()) else (
+                        int(b) if dots else lo)
+                    window = (lo, hi)
+                elif k == "ms":
+                    latency_ms = int(v)
+                else:
+                    raise ValueError(f"unknown fault condition {k!r}")
+            if p is None and window is None:
+                # bare rule: always fires (e.g. 'server1:down')
+                window = (0, None)
+            rules.append(FaultRule(scope=scope, kind=kind, p=p,
+                                   window=window, latency_ms=latency_ms,
+                                   server=server))
+        except ValueError as e:
+            raise ValueError(
+                f"bad BYTEPS_FAULT_SPEC rule {part!r}: {e}") from None
+    return rules
+
+
+class FaultPlan:
+    """Seeded, per-worker fault schedule over the PSWorker wire boundary.
+
+    One plan per PSWorker: ``intercept(op, sidx)`` is called once per wire
+    attempt (push/pull/ping, retries included); it ticks the plan step,
+    evaluates every rule, counts what fired, and returns at most one
+    :class:`Injection` (first matching rule wins; ``slow`` additionally
+    sleeps inline and keeps looking, so latency can compose with a loss).
+    """
+
+    def __init__(self, rules: List[FaultRule], seed: int = 0,
+                 worker_id: int = 0):
+        self.rules = list(rules)
+        self.seed = seed
+        self.worker_id = worker_id
+        self._rng = random.Random(seed * 1000003 + worker_id)
+        self._lock = threading.Lock()
+        self._step = 0
+        self.injected: Dict[str, int] = {k: 0 for k in KINDS}
+
+    @property
+    def step(self) -> int:
+        return self._step
+
+    def intercept(self, op: str, sidx: int) -> Optional[Injection]:
+        """Decide the fate of one wire attempt; sleeps for 'slow' rules."""
+        sleep_ms = 0
+        hit: Optional[Injection] = None
+        with self._lock:
+            self._step += 1
+            for r in self.rules:
+                if not r.matches(op, sidx, self._step, self._rng):
+                    continue
+                if r.kind == "slow":
+                    self.injected["slow"] += 1
+                    sleep_ms += r.latency_ms
+                    continue  # latency composes with a later loss rule
+                self.injected[r.kind] += 1
+                hit = Injection(kind=r.kind, rule=r,
+                                corrupt_at=self._rng.randrange(1 << 30))
+                break
+        if sleep_ms:
+            time.sleep(sleep_ms / 1e3)
+        return hit
+
+    @staticmethod
+    def corrupt(buf, at: int) -> None:
+        """Flip one byte of a writable uint8 buffer in place."""
+        if len(buf) == 0:
+            return
+        i = at % len(buf)
+        buf[i] = (int(buf[i]) ^ 0xFF) & 0xFF
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.injected)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"FaultPlan(seed={self.seed}, worker={self.worker_id}, "
+                f"rules={self.rules})")
+
+
+def plan_from_env(cfg=None, worker_id: int = 0) -> Optional[FaultPlan]:
+    """FaultPlan from BYTEPS_FAULT_SPEC / BYTEPS_FAULT_SEED, or None."""
+    if cfg is None:
+        from byteps_tpu.common.config import get_config
+
+        cfg = get_config()
+    spec = getattr(cfg, "fault_spec", "")
+    if not spec:
+        return None
+    plan = FaultPlan(parse_fault_spec(spec),
+                     seed=getattr(cfg, "fault_seed", 0),
+                     worker_id=worker_id)
+    log.info("fault injection armed for worker %d: %s", worker_id, spec)
+    return plan

@@ -40,11 +40,40 @@ from byteps_tpu.common.tracing import TraceRecorder
 log = get_logger("scheduler")
 
 
+class PartitionFailure(RuntimeError):
+    """A handle failed because one partition's pipeline failed.
+
+    Names the failed partition and attaches the per-partition results that
+    HAD completed when the failure froze the handle (``partial_results`` —
+    a snapshot: later sibling completions do not mutate a failed handle).
+    The original stage exception is ``__cause__``/``cause``.
+    """
+
+    def __init__(self, handle_name: str, part_idx: Optional[int],
+                 cause: BaseException, partial_results: Dict[int, Any]):
+        part = "?" if part_idx is None else str(part_idx)
+        super().__init__(
+            f"handle '{handle_name}' failed at partition {part}: "
+            f"{type(cause).__name__}: {cause} "
+            f"({len(partial_results)} sibling partition(s) completed)")
+        self.handle_name = handle_name
+        self.part_idx = part_idx
+        self.cause = cause
+        self.partial_results = partial_results
+        self.__cause__ = cause
+
+
 class Handle:
     """Completion handle for one enqueued tensor (all its partitions).
 
     Reference analog: the int handle from ``HandleManager``
     (byteps/torch/handle_manager.cc); ``wait()`` is ``wait_and_clear``.
+
+    Failure freezes the handle: the first ``_partition_failed`` snapshots
+    the results collected so far into a :class:`PartitionFailure`, and
+    every later sibling completion is dropped — ``wait()`` after failure
+    must hand back a stable error, not a dict that sibling stage threads
+    are still mutating underneath the caller.
     """
 
     def __init__(self, name: str, num_partitions: int) -> None:
@@ -57,18 +86,26 @@ class Handle:
 
     def _partition_done(self, part_idx: int, result: Any) -> None:
         with self._lock:
+            if self._error is not None:
+                return  # failed handle is frozen
             self.results[part_idx] = result
             self._remaining -= 1
             if self._remaining <= 0:
                 self._event.set()
 
-    def _partition_failed(self, exc: BaseException) -> None:
+    def _partition_failed(self, exc: BaseException,
+                          part_idx: Optional[int] = None) -> None:
         with self._lock:
-            self._error = exc
+            if self._error is None:
+                self._error = PartitionFailure(
+                    self.name, part_idx, exc, dict(self.results))
             self._event.set()
 
     def done(self) -> bool:
         return self._event.is_set()
+
+    def failed(self) -> bool:
+        return self._error is not None
 
     def wait(self, timeout: Optional[float] = None) -> Dict[int, Any]:
         if not self._event.wait(timeout):
@@ -97,6 +134,19 @@ class Stage:
     Default False keeps the hold-until-completion scope (the eager ICI
     pipeline's SYNC stage relies on it: the credit must outlive device-side
     completion, which is what bounds in-flight collectives).
+
+    ``retryable`` re-enqueues a failed task at THIS stage (priority
+    preserved — it re-enters the same priority queue) instead of instantly
+    failing the whole ``Handle``: up to ``max_attempts`` total tries with
+    ``retry_backoff_s`` × 2^n backoff. While backing off, the task's
+    credit (if held) is returned to the pool — a partition sleeping out a
+    DCN fault must not starve its siblings of the wire — and is
+    re-acquired through the normal credited-stage gate when the retry is
+    issued. Exceptions carrying ``retryable = False`` (e.g. a total-DCN
+    outage) fail immediately. The DCN pipelines set it on PUSH/PULL as the
+    second line of defense above the PSWorker wire retries (it is what
+    turns a mid-flight failover — FailedOverError — into a re-run against
+    the new placement instead of a failed handle).
     """
 
     name: str
@@ -104,6 +154,9 @@ class Stage:
     credited: bool = False
     pool_size: int = 1
     releases_credit: bool = False
+    retryable: bool = False
+    max_attempts: int = 3
+    retry_backoff_s: float = 0.05
 
 
 @dataclasses.dataclass
@@ -121,6 +174,9 @@ class PartitionTask:
     # every partition of a tensor, which would let partition 0's credit
     # cover its siblings (and a release refund a credit a sibling holds).
     holds_credit: bool = False
+    # Tries consumed at the CURRENT stage (Stage.retryable); reset to 0
+    # when the task advances, so each stage gets its own budget.
+    stage_attempts: int = 0
 
     @property
     def sort_key(self):
@@ -203,11 +259,41 @@ class PipelineScheduler:
 
     def drain(self, timeout: Optional[float] = None) -> None:
         with self._idle:
-            if not self._idle.wait_for(lambda: self._inflight == 0, timeout):
+            if not self._idle.wait_for(
+                    lambda: self._inflight == 0 or self._shutdown, timeout):
                 raise TimeoutError("scheduler drain timed out")
+            if self._shutdown:
+                # shutdown() failed everything that was in flight; a drain
+                # racing it must report that, not pretend a clean flush
+                raise RuntimeError("PipelineScheduler was shut down while "
+                                   "draining")
 
     def shutdown(self) -> None:
-        self._shutdown = True
+        """Stop the pipeline. Every queued task's handle is FAILED (so
+        ``Handle.wait()`` raises instead of blocking forever on a
+        partition that will never run), in-flight tasks fail on stage
+        exit, and pending retry timers fail their tasks when they fire."""
+        stranded: List[PartitionTask] = []
+        with self._lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+            for q in self._queues:
+                while True:
+                    t = q.pop()
+                    if t is None:
+                        break
+                    stranded.append(t)
+                    if t.holds_credit:
+                        t.holds_credit = False
+                        self._credits = min(self._credits + 1,
+                                            self._credit_total)
+            self._inflight -= len(stranded)
+        err = RuntimeError("PipelineScheduler is shut down")
+        for t in stranded:
+            t.handle._partition_failed(err, t.partition.part_idx)
+        with self._idle:
+            self._idle.notify_all()
         for p in self._pools:
             p.shutdown(wait=False)
 
@@ -243,7 +329,18 @@ class PipelineScheduler:
             if issued is None:
                 return
             si, task = issued
-            self._pools[si].submit(self._run_stage, si, task)
+            try:
+                self._pools[si].submit(self._run_stage, si, task)
+            except RuntimeError as e:
+                # shutdown() ran between our pop and this submit: the pool
+                # rejects new work. The task is in no queue, so shutdown's
+                # strand sweep missed it — fail its handle here or wait()
+                # would hang (the exact class of hang shutdown() fixes).
+                with self._lock:
+                    self._busy[si] -= 1
+                self._finish(task, error=RuntimeError(
+                    f"PipelineScheduler is shut down ({e})"))
+                return
 
     def _run_stage(self, si: int, task: PartitionTask) -> None:
         stage = self.stages[si]
@@ -254,8 +351,24 @@ class PipelineScheduler:
             failed = None
         except BaseException as e:  # noqa: BLE001 - propagate via handle
             failed = e
-            log.error("stage %s failed for %s.%d: %s",
-                      stage.name, task.name, task.partition.part_idx, e)
+        retrying = (
+            failed is not None
+            and stage.retryable
+            and not self._shutdown
+            and task.stage_attempts + 1 < stage.max_attempts
+            and getattr(failed, "retryable", True)
+        )
+        if failed is not None:
+            if retrying:
+                log.warning(
+                    "stage %s failed for %s.%d (attempt %d/%d, will "
+                    "retry): %s", stage.name, task.name,
+                    task.partition.part_idx, task.stage_attempts + 1,
+                    stage.max_attempts, failed)
+            else:
+                log.error("stage %s failed for %s.%d: %s",
+                          stage.name, task.name, task.partition.part_idx,
+                          failed)
         if self._tracer:
             self._tracer.complete_event(
                 name=f"{task.name}.p{task.partition.part_idx}",
@@ -266,6 +379,9 @@ class PipelineScheduler:
                     "key": task.partition.key,
                     "priority": task.partition.priority,
                     "length": task.partition.length,
+                    **({"error": type(failed).__name__,
+                        "attempt": task.stage_attempts}
+                       if failed is not None else {}),
                 },
             )
         with self._lock:
@@ -277,15 +393,64 @@ class PipelineScheduler:
                 # rest of the pipeline (_finish's release is then a no-op)
                 task.holds_credit = False
                 self._credits = min(self._credits + 1, self._credit_total)
+            elif retrying and task.holds_credit:
+                # about to back off: a sleeping task must not keep a
+                # credit out of the pool (it would starve healthy
+                # siblings of the wire). The retry re-acquires through
+                # the normal credited-stage gate when it is re-issued.
+                task.holds_credit = False
+                self._credits = min(self._credits + 1, self._credit_total)
+        if retrying:
+            task.stage_attempts += 1
+            delay = stage.retry_backoff_s * (2 ** (task.stage_attempts - 1))
+            if self._tracer:
+                self._tracer.instant(
+                    f"{task.name}.p{task.partition.part_idx}.retry",
+                    stage.name,
+                    {"key": task.partition.key,
+                     "attempt": task.stage_attempts,
+                     "error": type(failed).__name__})
+            timer = threading.Timer(delay, self._requeue_retry, (si, task))
+            timer.daemon = True
+            timer.start()
+            self._pump()  # the freed credit may unblock a sibling now
+            return
         if failed is not None:
             self._finish(task, error=failed)
         elif si + 1 < len(self.stages):
             task.stage_idx = si + 1
+            task.stage_attempts = 0  # fresh budget at the next stage
             with self._lock:
-                self._queues[si + 1].push(task)
-            self._pump()
+                stranded = self._shutdown
+                if not stranded:
+                    self._queues[si + 1].push(task)
+            if stranded:
+                # shutdown() already drained the queues; a task advancing
+                # past it must fail its handle, not sit in a dead queue
+                self._finish(task, error=RuntimeError(
+                    "PipelineScheduler is shut down"))
+            else:
+                self._pump()
         else:
             self._finish(task)
+
+    def _requeue_retry(self, si: int, task: PartitionTask) -> None:
+        """Backoff timer fired: put the task back on its own stage's
+        priority queue (its sort key is unchanged, so a high-priority
+        retry still jumps the line)."""
+        with self._lock:
+            if not self._shutdown:
+                self._queues[si].push(task)
+                task = None  # enqueued; not stranded
+        if task is not None:  # raced shutdown(): fail, don't strand
+            task.handle._partition_failed(
+                RuntimeError("PipelineScheduler is shut down"),
+                task.partition.part_idx)
+            with self._idle:
+                self._inflight -= 1
+                self._idle.notify_all()
+            return
+        self._pump()
 
     def _finish(self, task: PartitionTask, error: Optional[BaseException] = None) -> None:
         """Reference analog: FinishOrProceed's terminal arm."""
@@ -295,7 +460,7 @@ class PipelineScheduler:
                 self._credits = min(self._credits + 1, self._credit_total)
             self._inflight -= 1
         if error is not None:
-            task.handle._partition_failed(error)
+            task.handle._partition_failed(error, task.partition.part_idx)
         else:
             task.handle._partition_done(task.partition.part_idx, task.payload)
         with self._idle:

@@ -149,6 +149,9 @@ def init(
         # wire scope, COMPRESS of chunk i+1 runs while chunk i is on the
         # wire (credit ≥ 2) and at most ``credit`` encoded payloads are
         # ever buffered ahead of the wire.
+        # PUSH/PULL are stage-retryable (chaos hardening): a mid-flight
+        # failover re-runs the stage against the new server placement
+        # instead of failing the Handle (docs/robustness.md).
         _state.scheduler = PipelineScheduler(
             stages=[
                 Stage("REDUCE", _reduce_stage, pool_size=1),
@@ -156,8 +159,9 @@ def init(
                 Stage("COMPRESS", _compress_stage, credited=True,
                       pool_size=2),
                 Stage("PUSH", _dcn_push_stage, credited=True, pool_size=4,
-                      releases_credit=True),
-                Stage("PULL", _dcn_pull_stage, pool_size=4),
+                      releases_credit=True, retryable=True),
+                Stage("PULL", _dcn_pull_stage, pool_size=4,
+                      retryable=True),
                 Stage("DECOMPRESS", _decompress_stage, pool_size=2),
                 Stage("COPYH2D", _h2d_stage, pool_size=2),
             ],
@@ -426,6 +430,16 @@ def _compress_stage(task: PartitionTask):
 
 def _dcn_push_stage(task: PartitionTask):
     p = task.partition
+    if not _state.psworker.has_live_servers():
+        # total DCN outage: the payload is already the pod's pure-ICI sum
+        # (REDUCE stage), so degrade to it instead of failing the handle —
+        # cross-pod aggregation is lost, intra-pod training continues
+        # (docs/robustness.md; gated by BYTEPS_DEGRADED_OK)
+        from byteps_tpu.common.dcn_adapter import degraded_fallback
+
+        return degraded_fallback(
+            _state.psworker, _state.cfg, task, log,
+            "the pure-ICI (pod-local) allreduce")
     plan = task.context["plans"][p.part_idx]
     store_bytes = (
         plan.codec.store_elems(p.length) * 4 if plan is not None
@@ -438,12 +452,21 @@ def _dcn_push_stage(task: PartitionTask):
     if needs_init:
         _state.psworker.init_key(p.key, store_bytes)
     codec_id = plan.codec.codec_id if plan is not None else 0
-    version = _state.psworker.push_bytes(p.key, task.payload, codec_id)
+    # pin the round across stage retries (see DcnCore._push_stage): a
+    # re-run re-sends the SAME version so the server dedupe recognizes it
+    version = _state.psworker.push_bytes(
+        p.key, task.payload, codec_id,
+        version=getattr(task, "push_version", None))
+    task.push_version = version
     return version
 
 
 def _dcn_pull_stage(task: PartitionTask):
+    from byteps_tpu.common.dcn_adapter import DegradedLocal
+
     p = task.partition
+    if isinstance(task.payload, DegradedLocal):
+        return task.payload.payload
     plan = task.context["plans"][p.part_idx]
     if plan is None:
         return _state.psworker.pull_bytes(
@@ -462,6 +485,11 @@ def _decompress_stage(task: PartitionTask):
     buf = task.payload
     if plan is None:
         return np.ascontiguousarray(buf).view(np.float32).copy()
+    if getattr(task, "degraded", False):
+        # degraded payload is the PUSH-side encoding (the pull wire
+        # format never existed for this round)
+        return plan.codec.decode(np.ascontiguousarray(buf), p.length,
+                                 _wire_seed(task))
     return plan.decode_pull(np.ascontiguousarray(buf), p.length,
                             _wire_seed(task))
 
@@ -469,7 +497,13 @@ def _decompress_stage(task: PartitionTask):
 def _h2d_stage(task: PartitionTask):
     out = jnp.asarray(task.payload)
     if task.context["average"]:
-        out = out / size()  # global worker-device count
+        if getattr(task, "degraded", False):
+            # pod average: an unbiased estimate of the global average
+            # (the pods the fallback cannot reach would have contributed
+            # pod-sums of the same expected scale)
+            out = out / pod_size()
+        else:
+            out = out / size()  # global worker-device count
     return out
 
 

@@ -20,16 +20,28 @@ decompress→sum→recompress engine (SURVEY §2.2/§3.3).
 
 from __future__ import annotations
 
+import random
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+import time
+import zlib
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
 from byteps_tpu.common.config import Config, get_config
+from byteps_tpu.common.faults import (
+    FaultPlan,
+    InjectedConnectionError,
+    InjectedTimeout,
+    ServerDownError,
+    plan_from_env,
+)
 from byteps_tpu.common.logging import get_logger
+from byteps_tpu.common.tracing import get_tracer
 from byteps_tpu.server.native import (
     WIRE_RAW,
     NativeClient,
+    WireCorruption,
     load_lib,
     reduce_sum_f32,
 )
@@ -39,8 +51,48 @@ log = get_logger("server")
 
 __all__ = [
     "start_server", "stop_server", "serve_forever", "server_addresses",
-    "PSWorker", "reduce_sum_f32", "DcnPacer",
+    "PSWorker", "reduce_sum_f32", "DcnPacer", "FailedOverError",
+    "NoLiveServersError", "WireCorruption", "wire_crc32",
 ]
+
+
+def wire_crc32(buf) -> int:
+    """CRC32 as carried in the frame header: 0 means 'unchecked', so the
+    one-in-2^32 payload whose true CRC is 0 maps to 1 (the C++ side's
+    wire_crc applies the identical adjustment)."""
+    c = zlib.crc32(buf) & 0xFFFFFFFF
+    return c if c != 0 else 1
+
+
+class FailedOverError(RuntimeError):
+    """The key's server placement changed (failover) while this op was in
+    flight; its round numbering is gone. Not retryable at the wire level —
+    the *stage* retry re-runs the op, which re-derives version and target
+    against the post-failover topology."""
+
+
+class NoLiveServersError(ConnectionError):
+    """Every summation server is marked dead. Excluded from the WIRE retry
+    budget (re-sending cannot help), but deliberately stage-retryable: the
+    re-run of the PUSH stage takes the degraded pure-ICI branch when
+    BYTEPS_DEGRADED_OK, else fails the handle."""
+
+
+def _is_retryable_wire_error(e: BaseException) -> bool:
+    """Errors the worker retry engine may safely re-attempt: lost
+    responses (rc=-7), desynchronized/killed sockets (rc=-6/-2/-3, the
+    next attempt reconnects), detected corruption (CRC), and injected
+    equivalents. Server-side kErr rejections (size/init mismatches, pull
+    deadline expiry) are semantic failures a resend cannot fix."""
+    if isinstance(e, (NoLiveServersError, FailedOverError)):
+        return False
+    if isinstance(e, (TimeoutError, ConnectionError, WireCorruption)):
+        return True
+    if isinstance(e, RuntimeError):
+        s = str(e)
+        return ("rc=-2" in s or "rc=-3" in s or "key mismatch" in s
+                or "NativeClient is closed" in s)
+    return False
 
 
 def server_addresses(cfg: Optional[Config] = None) -> List[Tuple[str, int]]:
@@ -156,6 +208,7 @@ class PSWorker:
         worker_id: Optional[int] = None,
         use_ipc: Optional[bool] = None,
         throttle_mbps: Optional[float] = None,
+        fault_plan: Optional[FaultPlan] = None,
     ):
         cfg = get_config()
         self._servers = list(servers) if servers else server_addresses()
@@ -180,6 +233,133 @@ class PSWorker:
             throttle_mbps if throttle_mbps is not None
             else cfg.dcn_throttle_mbps
         )
+        # --- robustness state (docs/robustness.md) -------------------------
+        self._plan = (fault_plan if fault_plan is not None
+                      else plan_from_env(cfg, worker_id=self._worker_id))
+        # CRC is forced on while injection is armed: corruption must be
+        # *detected* to be retryable instead of silently summed
+        self._crc = bool(cfg.wire_crc) or self._plan is not None
+        self._retry_limit = max(0, cfg.retry_limit)
+        self._backoff_ms = max(1, cfg.retry_backoff_ms)
+        # seeded jitter: reproducible backoff schedules per worker
+        self._retry_rng = random.Random(
+            0xC0FFEE ^ (self._worker_id * 7919) ^ cfg.fault_seed)
+        self._live: Set[int] = set(range(len(self._servers)))
+        self._epoch = 0  # bumped per failover; in-flight ops self-abort
+        self._key_nbytes: Dict[int, int] = {}  # for post-failover re-init
+        self.counters: Dict[str, int] = {
+            "retries": 0, "timeouts": 0, "conn_errors": 0,
+            "crc_errors": 0, "reinits": 0, "give_ups": 0,
+            "failovers": 0, "ici_fallbacks": 0,
+        }
+        self._counter_lock = threading.Lock()
+        self._health: Optional[_HealthMonitor] = None
+        if cfg.health_interval_ms > 0 and len(self._servers) > 0:
+            self._health = _HealthMonitor(
+                self, interval_ms=cfg.health_interval_ms,
+                miss_limit=max(1, cfg.health_miss_limit))
+            self._health.start()
+
+    # -- robustness helpers -------------------------------------------------
+    def _count(self, name: str, n: int = 1) -> None:
+        with self._counter_lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def _trace_fault(self, event: str, **args) -> None:
+        get_tracer().instant(event, "FAULT",
+                             {"worker": self._worker_id, **args})
+
+    def _kill_conn(self, sidx: int) -> None:
+        """Drop this thread's connection to ``sidx`` (injected socket
+        death); the next attempt reconnects through ``_conn``."""
+        pool = getattr(self._tls, "conns", {})
+        c = pool.get(sidx)
+        if c is not None:
+            self._evict(sidx, c)
+
+    def _inject_pre(self, op: str, sidx: int):
+        """Evaluate the fault plan for one wire attempt. 'kill'/'down'
+        raise here (the request never leaves); 'timeout'/'corrupt' are
+        returned for the caller to act on around the real op."""
+        if self._plan is None:
+            return None
+        inj = self._plan.intercept(op, sidx)
+        if inj is None:
+            return None
+        if inj.kind == "down":
+            self._kill_conn(sidx)
+            raise ServerDownError(
+                f"injected: server {sidx} down during {op} "
+                f"(plan step {self._plan.step})")
+        if inj.kind == "kill":
+            self._kill_conn(sidx)
+            raise InjectedConnectionError(
+                f"injected: connection to server {sidx} killed before {op}")
+        return inj
+
+    def has_live_servers(self) -> bool:
+        return bool(self._live)
+
+    def live_servers(self) -> Set[int]:
+        return set(self._live)
+
+    def fail_over(self, sidx: int, barrier: bool = True) -> bool:
+        """Mark server ``sidx`` dead and remap its keys to the survivors.
+
+        All workers must take the same view of the live set before any
+        pushes the new placement (their health monitors each call this;
+        the worker barrier through the lowest surviving server aligns
+        them). Key remap is rendezvous-hashed over the live set; the dead
+        server's keys get fresh round counters (their stores — and the
+        rounds in flight against them — are gone; in-flight ops for
+        remapped keys abort with :class:`FailedOverError` and the stage
+        retry re-runs them against the new placement). Returns False if
+        the server was already dead."""
+        with self._vlock:
+            if sidx not in self._live:
+                return False
+            old_live = set(self._live)
+            self._live.discard(sidx)
+            self._epoch += 1
+            # reset round numbering for every key whose placement changed,
+            # atomically with the live-set shrink: a push racing this (a
+            # stage retry landing on the survivor) must either see the old
+            # placement (and abort FailedOverError) or a reset counter —
+            # never mint a CONTINUATION version on the new server, which
+            # would make all later fresh rounds look like replays to the
+            # dedupe watermark
+            for key in list(self._versions):
+                if (self._server_for_live(key, old_live)
+                        != self._server_for_live(key, self._live)):
+                    del self._versions[key]
+        self._count("failovers")
+        self._trace_fault("failover", server=sidx,
+                          survivors=sorted(self._live))
+        log.warning("server %d marked dead; %s", sidx,
+                    f"keys fail over to {sorted(self._live)}"
+                    if self._live else "NO live servers remain "
+                    "(degraded mode)")
+        if barrier and self._live:
+            try:
+                self.barrier()
+            except Exception as e:  # noqa: BLE001 - best-effort alignment
+                log.warning("failover barrier failed: %s", e)
+        return True
+
+    def _server_for_live(self, key: int, live: Set[int]) -> int:
+        """Deterministic placement agreed across workers: the home slot
+        (key % n) when alive, else rendezvous hash over the survivors
+        (zlib.crc32 is stable across processes, unlike salted hash())."""
+        home = key % len(self._servers)
+        if home in live or not live:
+            return home  # no survivors: degraded path decides upstream
+        return max(live,
+                   key=lambda s: zlib.crc32(f"{key}:{s}".encode()))
+
+    def server_for(self, key: int) -> int:
+        with self._vlock:
+            live = set(self._live)
+        return self._server_for_live(key, live)
 
     # -- connection management ----------------------------------------------
     def _conn(self, sidx: int) -> NativeClient:
@@ -215,69 +395,201 @@ class PSWorker:
                 pass
         c.close()
 
-    def server_for(self, key: int) -> int:
-        return key % len(self._servers)
-
     def _is_local(self, sidx: int) -> bool:
         return self._ipc and sidx == _INPROC_SERVER_ID
 
+    # -- retry engine -------------------------------------------------------
+    def _retry_loop(self, op: str, key: int, attempt_fn):
+        """Drive ``attempt_fn(sidx) -> result`` under the per-op retry
+        budget. Placement is re-resolved every attempt so a failover
+        mid-retry lands on the survivor; an op whose key MOVED since the
+        first attempt aborts with :class:`FailedOverError` (its round
+        numbering died with the old server — the *stage* retry re-runs
+        the whole op against the new placement, with a fresh version).
+
+        Backoff: ``BYTEPS_RETRY_BACKOFF_MS`` × 2^attempt, capped at 2 s,
+        with seeded jitter in [0.5, 1.0] — the standard exponential
+        backoff + jitter that keeps a retry storm from re-synchronizing
+        every worker onto the recovering server."""
+        sidx0 = self.server_for(key)
+        attempt = 0
+        while True:
+            with self._vlock:
+                live = set(self._live)
+                epoch = self._epoch
+            if not live:
+                raise NoLiveServersError(
+                    f"{op} key {key}: every summation server is dead")
+            sidx = self._server_for_live(key, live)
+            if sidx != sidx0:
+                raise FailedOverError(
+                    f"{op} key {key}: placement moved {sidx0}->{sidx} "
+                    f"(failover epoch {epoch}); round abandoned")
+            try:
+                return attempt_fn(sidx)
+            except BaseException as e:  # noqa: BLE001 - classified below
+                if (isinstance(e, RuntimeError) and "before init" in str(e)
+                        and key in self._key_nbytes
+                        and attempt < self._retry_limit):
+                    # post-failover target has never seen this key:
+                    # re-init from the recorded size and go again (init
+                    # is idempotent server-side)
+                    attempt += 1
+                    self._count("reinits")
+                    self._trace_fault("reinit", key=key, server=sidx)
+                    self._conn(sidx).init_key(key, self._key_nbytes[key])
+                    continue
+                if not _is_retryable_wire_error(e):
+                    raise
+                if attempt >= self._retry_limit:
+                    self._count("give_ups")
+                    self._trace_fault("retry_exhausted", key=key, op=op,
+                                      error=type(e).__name__)
+                    raise
+                attempt += 1
+                if isinstance(e, TimeoutError):
+                    self._count("timeouts")
+                elif isinstance(e, WireCorruption):
+                    self._count("crc_errors")
+                else:
+                    self._count("conn_errors")
+                self._count("retries")
+                self._trace_fault("retry", key=key, op=op, attempt=attempt,
+                                  error=type(e).__name__)
+                log.debug("%s key %d attempt %d failed (%s: %s); retrying",
+                          op, key, attempt, type(e).__name__, e)
+                backoff = min(self._backoff_ms * (2 ** (attempt - 1)), 2000)
+                time.sleep(backoff * self._retry_rng.uniform(0.5, 1.0)
+                           / 1e3)
+
     # -- data plane ---------------------------------------------------------
     def init_key(self, key: int, nbytes: int) -> None:
+        with self._vlock:
+            self._key_nbytes[key] = int(nbytes)
         sidx = self.server_for(key)
         if self._is_local(sidx):
             rc = load_lib().bps_local_init(key, nbytes)
             if rc != 0:
                 raise RuntimeError(f"local init failed (rc={rc})")
             return
-        self._conn(sidx).init_key(key, nbytes)
+
+        def attempt(s):
+            # 'init' only matches server-scoped rules (down windows) —
+            # push/pull-scoped loss rules target the data plane proper
+            self._inject_pre("init", s)
+            self._conn(s).init_key(key, nbytes)
+
+        self._retry_loop("init", key, attempt)
 
     def push_bytes(self, key: int, buf: np.ndarray,
-                   codec: int = WIRE_RAW) -> int:
+                   codec: int = WIRE_RAW,
+                   version: Optional[int] = None) -> int:
         """Push codec-encoded bytes; returns the round number the matching
-        pull must wait for."""
+        pull must wait for. Retryable wire failures re-send the SAME
+        (worker, key, version) — the server dedupes a replay whose
+        original landed (the version-safe replay contract), so a lost
+        *response* cannot double-sum the round.
+
+        ``version`` pins the round across HIGHER-level retries (the
+        scheduler's stage retry passes the version its first try minted):
+        a push whose wire budget was exhausted AFTER the server applied it
+        must re-send the same version, not mint a fresh one that the
+        dedupe cannot recognize. A pinned version from before a failover
+        (the per-key counter was reset, so it exceeds the counter) is
+        discarded and a fresh round minted against the new placement."""
         with self._vlock:
-            version = self._versions.get(key, 0) + 1
-            self._versions[key] = version
-        if self.pacer is not None:
-            # book the payload's transmission time on the emulated NIC
-            # BEFORE the wire op — upstream bandwidth leaves this worker
-            # at the paced rate (applies to the IPC path too: colocated
-            # deployments being modeled still cross a NIC pod-to-pod)
-            self.pacer.throttle_send(int(np.asarray(buf).nbytes))
-        sidx = self.server_for(key)
-        if self._is_local(sidx):
-            b = np.ascontiguousarray(buf)
-            rc = load_lib().bps_local_push(
-                self._worker_id, key, codec,
-                b.ctypes.data, b.nbytes,
-            )
-            if rc != 0:
-                raise RuntimeError(f"local push failed (rc={rc})")
-        else:
-            self._conn(sidx).push(key, buf, codec, self._worker_id)
+            cur = self._versions.get(key, 0)
+            if version is None or version > cur:
+                version = cur + 1
+                self._versions[key] = version
+        b = np.ascontiguousarray(buf)
+        crc = wire_crc32(b) if self._crc and not self._is_local(
+            self.server_for(key)) else 0
+
+        def attempt(sidx):
+            if self.pacer is not None:
+                # book the payload's transmission time on the emulated NIC
+                # BEFORE the wire op (every re-send pays wire time again,
+                # as it would on a real NIC); applies to the IPC path too:
+                # colocated deployments being modeled still cross a NIC
+                self.pacer.throttle_send(int(b.nbytes))
+            if self._is_local(sidx):
+                rc = load_lib().bps_local_push2(
+                    self._worker_id, key, codec, version,
+                    b.ctypes.data, b.nbytes,
+                )
+                if rc != 0:
+                    raise RuntimeError(f"local push failed (rc={rc})")
+                return
+            inj = self._inject_pre("push", sidx)
+            send = b
+            if inj is not None and inj.kind == "corrupt":
+                # CRC was computed on the pristine payload: the flipped
+                # byte is detected server-side and NEVER summed
+                send = b.copy()
+                FaultPlan.corrupt(send.view(np.uint8).reshape(-1),
+                                  inj.corrupt_at)
+            self._conn(sidx).push(key, send, codec, self._worker_id,
+                                  version, crc)
+            if inj is not None and inj.kind == "timeout":
+                # the push WAS applied; lose the ack (models a lost
+                # response) — the retry's re-send exercises the dedupe
+                self._kill_conn(sidx)
+                raise InjectedTimeout(
+                    f"injected: push ack for key {key} lost "
+                    f"(server {sidx})")
+
+        self._retry_loop("push", key, attempt)
         with self._vlock:
-            self.bytes_pushed += int(np.asarray(buf).nbytes)
+            self.bytes_pushed += int(b.nbytes)
         return version
 
     def pull_bytes(self, key: int, capacity: int, version: int,
                    codec: int = WIRE_RAW) -> np.ndarray:
-        """Pull the round result as codec-encoded bytes."""
-        out = np.empty(capacity, np.uint8)
-        sidx = self.server_for(key)
-        if self._is_local(sidx):
-            got = load_lib().bps_local_pull(
-                key, codec, version, self._recv_timeout,
-                out.ctypes.data, out.nbytes,
-            )
-            if got < 0:
-                raise RuntimeError(f"local pull failed (rc={got})")
-        else:
-            got = self._conn(sidx).pull(key, out, version, codec)
-        if self.pacer is not None:
-            # book the response's transmission time (downstream direction)
-            self.pacer.throttle_recv(int(got))
+        """Pull the round result as codec-encoded bytes. Pull retries are
+        naturally idempotent (the round snapshot is immutable)."""
+
+        def attempt(sidx):
+            out = np.empty(capacity, np.uint8)
+            if self._is_local(sidx):
+                got = load_lib().bps_local_pull(
+                    key, codec, version, self._recv_timeout,
+                    out.ctypes.data, out.nbytes,
+                )
+                if got < 0:
+                    raise RuntimeError(f"local pull failed (rc={got})")
+                if self.pacer is not None:
+                    self.pacer.throttle_recv(int(got))
+                return out, int(got)
+            inj = self._inject_pre("pull", sidx)
+            conn = self._conn(sidx)
+            if self._crc:
+                got, resp_crc = conn.pull(key, out, version, codec,
+                                          want_crc=True)
+            else:
+                got, resp_crc = conn.pull(key, out, version, codec), 0
+            if self.pacer is not None:
+                # book the response's transmission time per ATTEMPT
+                # (downstream direction): a lost/corrupted response still
+                # crossed the emulated NIC, exactly like a re-sent push
+                self.pacer.throttle_recv(int(got))
+            if inj is not None:
+                if inj.kind == "timeout":
+                    self._kill_conn(sidx)
+                    raise InjectedTimeout(
+                        f"injected: pull response for key {key} lost "
+                        f"(server {sidx})")
+                if inj.kind == "corrupt" and got > 0:
+                    FaultPlan.corrupt(out[:got], inj.corrupt_at)
+            if resp_crc and wire_crc32(out[:got]) != resp_crc:
+                raise WireCorruption(
+                    f"pull response for key {key} failed CRC "
+                    f"(server {sidx}); retrying")
+            return out, int(got)
+
+        out, got = self._retry_loop("pull", key, attempt)
         with self._vlock:
-            self.bytes_pulled += int(got)
+            self.bytes_pulled += got
         return out[:got]
 
     def push(self, key: int, data: np.ndarray) -> int:
@@ -296,13 +608,18 @@ class PSWorker:
         return self.pull(key, data.size, v)
 
     def barrier(self) -> None:
-        """Global worker barrier through server 0 (reference: ps-lite
-        Postoffice::Barrier via the scheduler)."""
-        self._conn(0).barrier()
+        """Global worker barrier through the lowest LIVE server (server 0
+        while healthy — reference: ps-lite Postoffice::Barrier via the
+        scheduler; after a failover the survivors host it)."""
+        with self._vlock:
+            sidx = min(self._live) if self._live else 0
+        self._conn(sidx).barrier()
 
     def ping(self, sidx: int = 0) -> Tuple[int, int]:
         """(server CLOCK_REALTIME ns, rtt ns) for clock alignment of merged
-        worker/server traces (SURVEY §5.1 dPRO clock-offset capability)."""
+        worker/server traces (SURVEY §5.1 dPRO clock-offset capability).
+        Also the health monitor's probe — injected down windows fail it."""
+        self._inject_pre("ping", sidx)
         return self._conn(sidx).ping()
 
     def clock_offset_ns(self, sidx: int = 0) -> int:
@@ -318,6 +635,18 @@ class PSWorker:
         if self._closed:
             return
         self._closed = True
+        if self._health is not None:
+            # join (bounded by the monitor's short probe timeouts) BEFORE
+            # tearing down: the monitor owns its probe connections, but a
+            # fail_over it triggers mid-shutdown would race the teardown
+            self._health.stop(join=True)
+        # export the robustness counters into the chrome trace so a retry
+        # storm / failover is visible beside the dPRO timeline
+        counters = self.get_counters()
+        if any(counters.values()):
+            tracer = get_tracer()
+            tracer.metadata.setdefault("robustness", {})[
+                f"worker{self._worker_id}"] = counters
         # one shutdown per server (not per connection): servers count
         # shutdowns against DMLC_NUM_WORKER. Use this thread's pool
         # (creating connections as needed), then close EVERY connection
@@ -337,11 +666,121 @@ class PSWorker:
                     with self._conn_lock:
                         self._all_conns.append(c)
                 c.shutdown()
-            except Exception:  # noqa: BLE001 - server may already be gone
-                pass
+            except Exception as e:  # noqa: BLE001 - server may already be
+                # gone (it stops itself once every worker said shutdown,
+                # and a chaos run may have killed it outright) — expected
+                # enough not to warn, but never silent: the index says
+                # WHICH server missed its shutdown count
+                log.debug("shutdown of server %d failed: %s: %s",
+                          sidx, type(e).__name__, e)
         with self._conn_lock:
             conns = list(self._all_conns)
             self._all_conns.clear()
         for c in conns:
             c.close()
         self._tls.conns = {}
+
+    def get_counters(self) -> Dict[str, int]:
+        """Robustness counters (+ per-kind injected counts when a fault
+        plan is armed) — what the chaos smoke and the bench assert on."""
+        with self._counter_lock:
+            out = dict(self.counters)
+        if self._plan is not None:
+            for k, v in self._plan.counters().items():
+                out[f"injected_{k}"] = v
+        return out
+
+
+class _HealthMonitor:
+    """Marks servers dead after K consecutive missed heartbeats.
+
+    Built on the kPing probe, but on the monitor's OWN connections with
+    SHORT connect/recv timeouts (scaled to the probe interval): they are
+    never shared with — or torn down by — the data plane, so a probe
+    mid-flight during ``PSWorker.shutdown`` cannot race a freed native
+    client, and a really-hung server costs one bounded probe, not the
+    data plane's long recv timeout. ``miss_limit`` consecutive failures
+    trigger :meth:`PSWorker.fail_over`. The reference analog is ps-lite's
+    scheduler heartbeat (SURVEY §5.3); every worker monitors
+    independently and the failover barrier aligns their live-set views.
+    Injected ``server<N>`` fault windows fail the probe through the
+    worker's plan (``_inject_pre('ping', ...)``).
+    """
+
+    def __init__(self, worker: "PSWorker", interval_ms: int,
+                 miss_limit: int):
+        self._worker = worker
+        self._interval = max(1, interval_ms) / 1e3
+        # probe timeout: generous vs the interval, small vs the data
+        # plane's recv timeout
+        self._probe_ms = max(500, 4 * interval_ms)
+        self._miss_limit = miss_limit
+        self._misses: Dict[int, int] = {}
+        self._conns: Dict[int, NativeClient] = {}
+        self._stop_ev = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="bps-health", daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self, join: bool = False) -> None:
+        self._stop_ev.set()
+        if join and self._thread.is_alive():
+            # bounded: one probe + one bounded failover barrier, both on
+            # probe timeouts (never the data plane's long recv timeout)
+            self._thread.join(timeout=2 * self._probe_ms / 1e3 + 5.0)
+
+    def _probe(self, sidx: int) -> None:
+        self._worker._inject_pre("ping", sidx)
+        c = self._conns.get(sidx)
+        if c is None or c.is_dead():
+            if c is not None:
+                c.close()
+            host, port = self._worker._servers[sidx]
+            c = NativeClient(host, port, self._probe_ms, self._probe_ms)
+            self._conns[sidx] = c
+        c.ping()
+
+    def _run(self) -> None:
+        try:
+            while not self._stop_ev.wait(self._interval):
+                for sidx in sorted(self._worker.live_servers()):
+                    if self._stop_ev.is_set():
+                        return
+                    try:
+                        self._probe(sidx)
+                        self._misses[sidx] = 0
+                    except Exception as e:  # noqa: BLE001 - miss
+                        n = self._misses.get(sidx, 0) + 1
+                        self._misses[sidx] = n
+                        log.debug(
+                            "heartbeat miss %d/%d for server %d (%s)",
+                            n, self._miss_limit, sidx, e)
+                        if n >= self._miss_limit:
+                            self._fail_over(sidx)
+        finally:
+            for c in self._conns.values():
+                c.close()
+
+    def _fail_over(self, sidx: int) -> None:
+        """Failover with a BOUNDED alignment barrier: the data-plane
+        barrier waits on the worker's long recv timeout, which would hold
+        this thread (and block a joining shutdown) for tens of seconds —
+        use a dedicated probe-timeout connection instead, and accept that
+        a laggard peer degrades the barrier to best-effort (fail_over's
+        own barrier handling is best-effort already)."""
+        if not self._worker.fail_over(sidx, barrier=False):
+            return
+        live = self._worker.live_servers()
+        if not live:
+            return
+        try:
+            host, port = self._worker._servers[min(live)]
+            c = NativeClient(host, port, self._probe_ms, self._probe_ms)
+            try:
+                c.barrier()
+            finally:
+                c.close()
+        except Exception as e:  # noqa: BLE001 - best-effort alignment
+            log.warning("failover barrier (monitor) failed: %s", e)

@@ -42,7 +42,15 @@ int bps_local_init(uint64_t key, uint64_t nbytes) {
 
 int bps_local_push(uint16_t worker, uint64_t key, uint8_t codec,
                    const void* buf, uint64_t nbytes) {
-  return bps::LocalPush(worker, key, codec,
+  return bps::LocalPush(worker, key, codec, 0,
+                        static_cast<const char*>(buf), nbytes);
+}
+
+// Versioned variant: `version` != 0 arms the per-(worker, key) replay
+// dedupe, making retry-engine re-sends idempotent.
+int bps_local_push2(uint16_t worker, uint64_t key, uint8_t codec,
+                    uint64_t version, const void* buf, uint64_t nbytes) {
+  return bps::LocalPush(worker, key, codec, version,
                         static_cast<const char*>(buf), nbytes);
 }
 
@@ -79,10 +87,30 @@ int bps_client_push(void* client, uint64_t key, const void* data,
                                                  worker_id);
 }
 
+// Versioned + checksummed push: `version` != 0 arms the server-side
+// (worker, key, version) replay dedupe; `crc` != 0 is verified server-side
+// before the payload is summed (mismatch -> retryable kErr).
+int bps_client_push2(void* client, uint64_t key, const void* data,
+                     uint64_t nbytes, uint8_t codec, uint16_t worker_id,
+                     uint64_t version, uint32_t crc) {
+  return static_cast<bps::Client*>(client)->Push(key, data, nbytes, codec,
+                                                 worker_id, version, crc);
+}
+
 int bps_client_pull(void* client, uint64_t key, void* data, uint64_t nbytes,
                     uint64_t version, uint8_t codec, uint64_t* out_bytes) {
   return static_cast<bps::Client*>(client)->Pull(key, data, nbytes, version,
                                                  codec, out_bytes);
+}
+
+// Checksummed pull: want_crc != 0 asks the server to checksum the
+// response; *out_crc receives it (caller verifies — kept out of the C
+// layer so the fault-injection harness can corrupt the buffer first).
+int bps_client_pull2(void* client, uint64_t key, void* data,
+                     uint64_t nbytes, uint64_t version, uint8_t codec,
+                     int want_crc, uint64_t* out_bytes, uint32_t* out_crc) {
+  return static_cast<bps::Client*>(client)->Pull(
+      key, data, nbytes, version, codec, out_bytes, want_crc != 0, out_crc);
 }
 
 int bps_client_barrier(void* client) {

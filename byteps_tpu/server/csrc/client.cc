@@ -76,9 +76,11 @@ void Client::Kill() {
 int Client::Roundtrip(Cmd cmd, uint64_t key, uint64_t version,
                       const void* req, uint32_t req_len, void* in,
                       uint64_t in_cap, uint64_t* got, uint8_t flags,
-                      uint16_t reserved, uint64_t* resp_version) {
+                      uint16_t reserved, uint64_t* resp_version,
+                      uint32_t req_crc, uint32_t* resp_crc) {
   if (fd_ < 0) return -2;
-  if (!send_frame(fd_, cmd, key, version, req, req_len, flags, reserved)) {
+  if (!send_frame(fd_, cmd, key, version, req, req_len, flags, reserved,
+                  req_crc)) {
     Kill();
     return -2;
   }
@@ -108,6 +110,7 @@ int Client::Roundtrip(Cmd cmd, uint64_t key, uint64_t version,
     return 1;
   }
   if (resp_version != nullptr) *resp_version = h.version;
+  if (resp_crc != nullptr) *resp_crc = h.crc;
   if (h.cmd == kResp) {
     if (in == nullptr || h.len > in_cap) {
       if (!drain_bytes(fd_, h.len)) {
@@ -140,17 +143,23 @@ int Client::InitKey(uint64_t key, uint64_t nbytes) {
 }
 
 int Client::Push(uint64_t key, const void* data, uint64_t nbytes,
-                 uint8_t codec, uint16_t worker_id) {
+                 uint8_t codec, uint16_t worker_id, uint64_t version,
+                 uint32_t crc) {
   std::lock_guard<std::mutex> lk(mu_);
-  return Roundtrip(kPush, key, 0, data, static_cast<uint32_t>(nbytes),
-                   nullptr, 0, nullptr, codec, worker_id, nullptr);
+  return Roundtrip(kPush, key, version, data,
+                   static_cast<uint32_t>(nbytes), nullptr, 0, nullptr,
+                   codec, worker_id, nullptr, crc);
 }
 
 int Client::Pull(uint64_t key, void* data, uint64_t nbytes, uint64_t version,
-                 uint8_t codec, uint64_t* out_bytes) {
+                 uint8_t codec, uint64_t* out_bytes, bool want_crc,
+                 uint32_t* out_crc) {
   std::lock_guard<std::mutex> lk(mu_);
+  // request crc = 1 is the "checksum the response" marker (any nonzero
+  // value works; the pull request itself has no payload to checksum)
   return Roundtrip(kPull, key, version, nullptr, 0, data, nbytes,
-                   out_bytes, codec, 0, nullptr);
+                   out_bytes, codec, 0, nullptr, want_crc ? 1u : 0u,
+                   out_crc);
 }
 
 int Client::Barrier() {

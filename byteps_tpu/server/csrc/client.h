@@ -35,13 +35,23 @@ class Client {
   int Connect(const std::string& host, uint16_t port, int timeout_ms,
               int recv_timeout_ms);
   int InitKey(uint64_t key, uint64_t nbytes);
-  // Push `nbytes` of codec-encoded payload as `worker_id`.
+  // Push `nbytes` of codec-encoded payload as `worker_id`. `version` is
+  // the round this push belongs to (0 = unversioned): the server drops a
+  // replayed (worker, key, version) instead of double-summing, which is
+  // what makes the worker retry engine's re-sent pushes safe. `crc` is
+  // the payload checksum as computed by wire_crc (0 = unchecked); a
+  // mismatch is rejected server-side with a retryable kErr.
   int Push(uint64_t key, const void* data, uint64_t nbytes, uint8_t codec,
-           uint16_t worker_id);
+           uint16_t worker_id, uint64_t version = 0, uint32_t crc = 0);
   // Blocks until the server completed round `version`; response encoded as
   // `codec` is written into data (capacity `nbytes`); *out_bytes = actual.
+  // want_crc requests a checksummed response; *out_crc receives the
+  // server-computed wire_crc of the payload (0 when not requested) for
+  // the CALLER to verify — verification is deliberately not done here so
+  // the fault-injection layer can corrupt the buffer in between.
   int Pull(uint64_t key, void* data, uint64_t nbytes, uint64_t version,
-           uint8_t codec, uint64_t* out_bytes);
+           uint8_t codec, uint64_t* out_bytes, bool want_crc = false,
+           uint32_t* out_crc = nullptr);
   int Barrier();
   int Shutdown();
   // Clock-offset probe: *server_ns = server CLOCK_REALTIME at serve time,
@@ -55,7 +65,8 @@ class Client {
  private:
   int Roundtrip(Cmd cmd, uint64_t key, uint64_t version, const void* req,
                 uint32_t req_len, void* in, uint64_t in_cap, uint64_t* got,
-                uint8_t flags, uint16_t reserved, uint64_t* resp_version);
+                uint8_t flags, uint16_t reserved, uint64_t* resp_version,
+                uint32_t req_crc = 0, uint32_t* resp_crc = nullptr);
   // Close the socket after a stream-desynchronizing error; later calls
   // return -2 instead of misparsing stale frames.
   void Kill();

@@ -6,17 +6,25 @@
 //
 // Frame layout (32 bytes header):
 //   u32 magic 'BPS1'  | u8 cmd | u8 flags | u16 reserved
-//   u64 key           | u64 version       | u32 payload_len | u32 pad
+//   u64 key           | u64 version       | u32 payload_len | u32 crc
 //
 // Field use per command:
 //   kInit     version = dense store bytes (payload empty)
-//   kPush     flags = codec, reserved = worker_id, payload = encoded data
-//   kPull     flags = desired response codec, version = min round
-//   kResp     flags = codec, version = round, payload = encoded result
+//   kPush     flags = codec, reserved = worker_id, version = round the
+//             push belongs to (0 = unversioned legacy; nonzero versions
+//             let the server drop replayed (worker, key, version) pushes
+//             from the worker retry engine instead of double-summing),
+//             crc = wire_crc of payload (0 = unchecked)
+//   kPull     flags = desired response codec, version = min round,
+//             crc != 0 requests a checksummed response
+//   kResp     flags = codec, version = round, payload = encoded result,
+//             crc = wire_crc of payload when the pull asked for it
 //   kPing     -> kAck with version = server CLOCK_REALTIME ns (clock align)
 #pragma once
 
+#include <array>
 #include <cerrno>
+#include <cstddef>
 #include <cstdint>
 #include <cstring>
 #include <string>
@@ -59,11 +67,41 @@ struct FrameHeader {
   uint64_t key = 0;
   uint64_t version = 0;
   uint32_t len = 0;
-  uint32_t pad = 0;
+  uint32_t crc = 0;  // payload CRC32 (0 = unchecked; was padding)
 };
 #pragma pack(pop)
 
 static_assert(sizeof(FrameHeader) == 32, "frame header must be 32 bytes");
+
+// CRC-32 (IEEE 802.3 polynomial, zlib-compatible: Python's zlib.crc32
+// computes the identical value, which the worker-side verify relies on).
+inline uint32_t crc32_of(const void* buf, size_t len) {
+  static const std::array<uint32_t, 256> table = [] {
+    std::array<uint32_t, 256> t{};
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k) {
+        c = (c & 1u) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      }
+      t[i] = c;
+    }
+    return t;
+  }();
+  uint32_t c = 0xFFFFFFFFu;
+  const unsigned char* p = static_cast<const unsigned char*>(buf);
+  for (size_t i = 0; i < len; ++i) {
+    c = table[(c ^ p[i]) & 0xFFu] ^ (c >> 8);
+  }
+  return c ^ 0xFFFFFFFFu;
+}
+
+// CRC as carried on the wire: 0 means "unchecked", so the one-in-2^32
+// payload whose true CRC is 0 is mapped to 1 by BOTH sides (sender and
+// verifier apply the same adjustment before comparing).
+inline uint32_t wire_crc(const void* buf, size_t len) {
+  uint32_t c = crc32_of(buf, len);
+  return c != 0 ? c : 1u;
+}
 
 // Full-buffer send/recv (TCP gives a byte stream; short reads are normal).
 inline bool send_all(int fd, const void* buf, size_t n) {
@@ -112,7 +150,7 @@ inline bool drain_bytes(int fd, size_t n) {
 
 inline bool send_frame(int fd, Cmd cmd, uint64_t key, uint64_t version,
                        const void* payload, uint32_t len, uint8_t flags = 0,
-                       uint16_t reserved = 0) {
+                       uint16_t reserved = 0, uint32_t crc = 0) {
   FrameHeader h;
   h.cmd = cmd;
   h.flags = flags;
@@ -120,6 +158,7 @@ inline bool send_frame(int fd, Cmd cmd, uint64_t key, uint64_t version,
   h.key = key;
   h.version = version;
   h.len = len;
+  h.crc = crc;
   // scatter-gather write: header + payload leave in one sendmsg (one
   // syscall and one coalesced TCP segment stream instead of two sends
   // per frame; MSG_NOSIGNAL keeps the no-SIGPIPE contract of send_all)

@@ -92,7 +92,7 @@ void local_body(std::atomic<int>* failures) {
   std::vector<float> data(kElems, 3.0f);
   for (int r = 1; r <= kRounds; ++r) {
     for (int w = 0; w < kWorkers; ++w) {
-      if (bps::LocalPush(w, key, 0,
+      if (bps::LocalPush(w, key, 0, static_cast<uint64_t>(r),
                          reinterpret_cast<const char*>(data.data()),
                          kElems * 4) != 0) {
         failures->fetch_add(1);

@@ -91,12 +91,14 @@ struct PendingPull {
   ConnPtr conn;
   uint64_t version;  // respond when store version >= this
   uint8_t codec;     // response encoding the worker asked for
+  bool want_crc;     // checksummed response requested
   int64_t enq_ms;    // steady clock, for the timeout sweep
 };
 
 struct DeferredPush {
   uint16_t worker;
   uint8_t codec;
+  uint64_t version;
   std::shared_ptr<RawBuf> buf;
 };
 
@@ -120,6 +122,12 @@ struct KeyStore {
   uint64_t version = 0;
   uint32_t arrived = 0;
   std::vector<uint8_t> pushed;         // per-worker arrival bitmap (sync)
+  // Highest push version already summed per worker (0 = none). A re-sent
+  // push from the worker retry engine carries the same (worker, key,
+  // version) as the original; when the original DID land (the lost frame
+  // was the ack/response, not the request), the replay must be dropped
+  // here instead of double-summing the round.
+  std::vector<uint64_t> applied_version;
   std::vector<DeferredPush> deferred;  // next-round pushes that came early
   CodecHint hint;         // evolves with every push (current open round)
   CodecHint result_hint;  // frozen copy of `hint` when `result`'s round
@@ -293,7 +301,7 @@ class Server {
   }
 
   int LocalPush(uint16_t worker, uint64_t key, uint8_t codec,
-                const char* buf, size_t len) {
+                uint64_t version, const char* buf, size_t len) {
     if (!running_) return -10;
     KeyStore* ks = Get(key);
     if (ks == nullptr) return -1;
@@ -301,7 +309,7 @@ class Server {
     const int64_t n = static_cast<int64_t>(ks->n_elems);
     if (!validate_payload(codec, buf, len, n)) return -3;
     auto owned = std::make_shared<RawBuf>(buf, buf + len);
-    ApplyPush(ks, key, worker, codec, std::move(owned));
+    ApplyPush(ks, key, worker, codec, version, std::move(owned));
     return 0;
   }
 
@@ -477,10 +485,11 @@ class Server {
   }
 
   void SendFrame(const ConnPtr& c, Cmd cmd, uint64_t key, uint64_t version,
-                 const void* payload, uint32_t len, uint8_t flags = 0) {
+                 const void* payload, uint32_t len, uint8_t flags = 0,
+                 uint32_t crc = 0) {
     std::lock_guard<std::mutex> lk(c->send_mu);
     if (c->closed) return;  // peer went away; response is moot
-    send_frame(c->fd, cmd, key, version, payload, len, flags);
+    send_frame(c->fd, cmd, key, version, payload, len, flags, 0, crc);
   }
 
   void SendErr(const ConnPtr& c, uint64_t key, const char* msg) {
@@ -496,6 +505,7 @@ class Server {
       slot->accum.assign(nfloats, 0.f);
       slot->result = std::make_shared<const FloatBuf>(nfloats, 0.f);
       slot->pushed.assign(num_workers_, 0);
+      slot->applied_version.assign(num_workers_, 0);
     }
     return slot.get();
   }
@@ -513,6 +523,7 @@ class Server {
   struct ReadyResp {
     ConnPtr conn;
     uint8_t codec;
+    bool want_crc;
     uint64_t version;
     std::shared_ptr<const FloatBuf> snap;
     CodecHint hint;
@@ -522,14 +533,31 @@ class Server {
   // v+1 before round v closed (pipelined pushes are legal — the ack no
   // longer waits for the sum) is deferred and re-applied at round close.
   // Pulls satisfied by a closing round are appended to `ready` with that
-  // round's snapshot.
+  // round's snapshot. `version` != 0 arms replay dedupe: a (worker,
+  // version) at or below the already-applied watermark — or already
+  // sitting in the deferred queue — is a retry-engine re-send whose
+  // original landed, and is dropped instead of double-summed.
   void ApplyPushLocked(KeyStore* ks, uint16_t worker, uint8_t codec,
-                       std::shared_ptr<RawBuf> buf,
+                       uint64_t version, std::shared_ptr<RawBuf> buf,
                        std::vector<ReadyResp>* ready) {
     const int64_t n = static_cast<int64_t>(ks->n_elems);
+    if (version != 0 && worker < ks->applied_version.size() &&
+        version <= ks->applied_version[worker]) {
+      return;  // duplicate of an already-summed push
+    }
     if (!async_ && ks->pushed[worker]) {
-      ks->deferred.push_back({worker, codec, std::move(buf)});
+      if (version != 0) {
+        for (const auto& d : ks->deferred) {
+          if (d.worker == worker && d.version == version) {
+            return;  // duplicate of a push already queued for next round
+          }
+        }
+      }
+      ks->deferred.push_back({worker, codec, version, std::move(buf)});
       return;
+    }
+    if (version != 0 && worker < ks->applied_version.size()) {
+      ks->applied_version[worker] = version;
     }
     if (!async_ && ks->arrived == 0) {
       // Start of a round: accum is UNINITIALIZED (the close path moves it
@@ -578,8 +606,8 @@ class Server {
       auto it = ks->pending.begin();
       while (it != ks->pending.end()) {
         if (ks->version >= it->version) {
-          ready->push_back({it->conn, it->codec, ks->version, ks->result,
-                            ks->result_hint});
+          ready->push_back({it->conn, it->codec, it->want_crc, ks->version,
+                            ks->result, ks->result_hint});
           it = ks->pending.erase(it);
         } else {
           ++it;
@@ -588,24 +616,25 @@ class Server {
       auto deferred = std::move(ks->deferred);
       ks->deferred.clear();
       for (auto& d : deferred) {
-        ApplyPushLocked(ks, d.worker, d.codec, std::move(d.buf), ready);
+        ApplyPushLocked(ks, d.worker, d.codec, d.version, std::move(d.buf),
+                        ready);
       }
     }
   }
 
   void ApplyPush(KeyStore* ks, uint64_t key, uint16_t worker, uint8_t codec,
-                 std::shared_ptr<RawBuf> buf) {
+                 uint64_t version, std::shared_ptr<RawBuf> buf) {
     const int64_t t0 = realtime_ns();
     const uint32_t len = static_cast<uint32_t>(buf->size());
     std::vector<ReadyResp> ready;
     {
       std::lock_guard<std::mutex> lk(ks->mu);
-      ApplyPushLocked(ks, worker, codec, std::move(buf), &ready);
+      ApplyPushLocked(ks, worker, codec, version, std::move(buf), &ready);
       if (async_) {
         auto it = ks->pending.begin();
         while (it != ks->pending.end()) {
           ready.push_back(
-              {it->conn, it->codec, ks->version,
+              {it->conn, it->codec, it->want_crc, ks->version,
                std::make_shared<const FloatBuf>(ks->accum),
                ks->hint});
           it = ks->pending.erase(it);
@@ -616,7 +645,8 @@ class Server {
     for (auto& p : ready) {
       // parallel fan-out: each response encodes+sends on its own engine slot
       SubmitEngine(key, [this, ks, key, p = std::move(p)] {
-        RespondPull(p.conn, key, ks, p.codec, p.version, p.snap, p.hint);
+        RespondPull(p.conn, key, ks, p.codec, p.want_crc, p.version, p.snap,
+                    p.hint);
       });
     }
   }
@@ -649,28 +679,29 @@ class Server {
   }
 
   void RespondPull(const ConnPtr& c, uint64_t key, KeyStore* ks,
-                   uint8_t codec, uint64_t version,
+                   uint8_t codec, bool want_crc, uint64_t version,
                    std::shared_ptr<const FloatBuf> snap,
                    const CodecHint& hint) {
     const int64_t t0 = realtime_ns();
     if (codec == kCodecRaw) {
       // zero-copy from the immutable snapshot
-      SendFrame(c, kResp, key, version, snap->data(),
-                static_cast<uint32_t>(snap->size() * sizeof(float)),
-                kCodecRaw);
-      Trace(kTrPullResp, key,
-            static_cast<uint32_t>(snap->size() * sizeof(float)), kCodecRaw,
-            t0);
+      const uint32_t len =
+          static_cast<uint32_t>(snap->size() * sizeof(float));
+      const uint32_t crc = want_crc ? wire_crc(snap->data(), len) : 0;
+      SendFrame(c, kResp, key, version, snap->data(), len, kCodecRaw, crc);
+      Trace(kTrPullResp, key, len, kCodecRaw, t0);
       return;
     }
     auto blob = EncodeResponse(ks, snap, hint, version, codec);
+    const uint32_t crc =
+        want_crc ? wire_crc(blob->data(), blob->size()) : 0;
     SendFrame(c, kResp, key, version, blob->data(),
-              static_cast<uint32_t>(blob->size()), codec);
+              static_cast<uint32_t>(blob->size()), codec, crc);
     Trace(kTrPullResp, key, static_cast<uint32_t>(blob->size()), codec, t0);
   }
 
   void HandlePull(const ConnPtr& c, uint64_t key, uint64_t version,
-                  uint8_t codec) {
+                  uint8_t codec, bool want_crc) {
     KeyStore* ks = Get(key);
     if (ks == nullptr) {
       SendErr(c, key, "pull before init");
@@ -684,7 +715,7 @@ class Server {
       std::lock_guard<std::mutex> lk(ks->mu);
       ready = async_ ? ks->version > 0 : ks->version >= version;
       if (!ready) {
-        ks->pending.push_back({c, version, codec, steady_ms()});
+        ks->pending.push_back({c, version, codec, want_crc, steady_ms()});
       } else {
         v = ks->version;
         if (async_) {
@@ -697,9 +728,9 @@ class Server {
       }
     }
     if (ready) {
-      SubmitEngine(key, [this, c, key, ks, codec, v, hint,
+      SubmitEngine(key, [this, c, key, ks, codec, want_crc, v, hint,
                          snap = std::move(snap)] {
-        RespondPull(c, key, ks, codec, v, snap, hint);
+        RespondPull(c, key, ks, codec, want_crc, v, snap, hint);
       });
     }
   }
@@ -791,6 +822,13 @@ class Server {
             SendErr(c, h.key, "payload does not match store size");
             break;
           }
+          if (h.crc != 0 &&
+              wire_crc(payload->data(), payload->size()) != h.crc) {
+            // corrupted in transit — detected, NOT applied; the worker
+            // retry engine treats this kErr as retryable and re-sends
+            SendErr(c, h.key, "payload crc mismatch");
+            break;
+          }
           // ack on receipt — the pull's version gate provides the round
           // barrier, so the worker can pipeline its next push while the
           // engine sums this one. Applications are ordered per
@@ -803,13 +841,15 @@ class Server {
           const uint8_t codec = h.flags;
           PostOrdered(ks, h.key, worker,
                       [this, ks, key = h.key, worker, codec,
+                       version = h.version,
                        buf = std::move(payload)]() mutable {
-                        ApplyPush(ks, key, worker, codec, std::move(buf));
+                        ApplyPush(ks, key, worker, codec, version,
+                                  std::move(buf));
                       });
           break;
         }
         case kPull:
-          HandlePull(c, h.key, h.version, h.flags);
+          HandlePull(c, h.key, h.version, h.flags, h.crc != 0);
           break;
         case kBarrier:
           HandleBarrier(c);
@@ -951,10 +991,11 @@ int LocalInit(uint64_t key, uint64_t nbytes) {
   return s != nullptr ? s->LocalInit(key, nbytes) : -10;
 }
 
-int LocalPush(uint16_t worker, uint64_t key, uint8_t codec, const char* buf,
-              size_t len) {
+int LocalPush(uint16_t worker, uint64_t key, uint8_t codec,
+              uint64_t version, const char* buf, size_t len) {
   Server* s = GetServer();
-  return s != nullptr ? s->LocalPush(worker, key, codec, buf, len) : -10;
+  return s != nullptr ? s->LocalPush(worker, key, codec, version, buf, len)
+                      : -10;
 }
 
 int LocalPull(uint64_t key, uint8_t codec, uint64_t version, int timeout_ms,

@@ -39,8 +39,10 @@ int ServerTraceDump(const char* path);
 // directly instead of looping through TCP. Round completion still answers
 // remote TCP pulls.
 int LocalInit(uint64_t key, uint64_t nbytes);
-int LocalPush(uint16_t worker, uint64_t key, uint8_t codec, const char* buf,
-              size_t len);
+// `version` != 0 arms the per-(worker, key) replay dedupe (a re-sent push
+// with an already-applied version is dropped, not double-summed).
+int LocalPush(uint16_t worker, uint64_t key, uint8_t codec,
+              uint64_t version, const char* buf, size_t len);
 // Blocks up to timeout_ms for round `version`; fills `out` with the
 // response encoded as `codec`.
 int LocalPull(uint64_t key, uint8_t codec, uint64_t version, int timeout_ms,

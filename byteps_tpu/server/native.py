@@ -35,6 +35,12 @@ WIRE_DITHER = 4
 WIRE_FP8 = 5
 
 
+class WireCorruption(RuntimeError):
+    """A CRC32-checked payload arrived corrupted (push rejected server-side
+    or pull response failing the worker-side verify). Always retryable:
+    the data was detected bad, never summed or consumed."""
+
+
 def _build() -> None:
     log.info("building native server library (one-time)…")
     subprocess.run(
@@ -80,6 +86,11 @@ def load_lib() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_uint64,
         ]
         lib.bps_local_push.restype = ctypes.c_int
+        lib.bps_local_push2.argtypes = [
+            ctypes.c_uint16, ctypes.c_uint64, ctypes.c_uint8,
+            ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
+        ]
+        lib.bps_local_push2.restype = ctypes.c_int
         lib.bps_local_pull.argtypes = [
             ctypes.c_uint64, ctypes.c_uint8, ctypes.c_uint64, ctypes.c_int,
             ctypes.c_void_p, ctypes.c_uint64,
@@ -98,12 +109,25 @@ def load_lib() -> ctypes.CDLL:
             ctypes.c_uint64, ctypes.c_uint8, ctypes.c_uint16,
         ]
         lib.bps_client_push.restype = ctypes.c_int
+        lib.bps_client_push2.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
+            ctypes.c_uint64, ctypes.c_uint8, ctypes.c_uint16,
+            ctypes.c_uint64, ctypes.c_uint32,
+        ]
+        lib.bps_client_push2.restype = ctypes.c_int
         lib.bps_client_pull.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
             ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint8,
             ctypes.POINTER(ctypes.c_uint64),
         ]
         lib.bps_client_pull.restype = ctypes.c_int
+        lib.bps_client_pull2.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint8,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.bps_client_pull2.restype = ctypes.c_int
         lib.bps_client_barrier.argtypes = [ctypes.c_void_p]
         lib.bps_client_barrier.restype = ctypes.c_int
         lib.bps_client_shutdown.argtypes = [ctypes.c_void_p]
@@ -164,23 +188,40 @@ class NativeClient:
                     "init")
 
     def push(self, key: int, data, codec: int = WIRE_RAW,
-             worker_id: int = 0) -> None:
-        """Push codec-encoded bytes (np array of any contiguous dtype)."""
+             worker_id: int = 0, version: int = 0, crc: int = 0) -> None:
+        """Push codec-encoded bytes (np array of any contiguous dtype).
+        ``version`` != 0 arms the server's (worker, key, version) replay
+        dedupe; ``crc`` != 0 (the wire convention of
+        :func:`~byteps_tpu.server.wire_crc32`) is verified server-side
+        before the payload is summed."""
         buf = np.ascontiguousarray(data)
         self._require_open()
         self._check(
-            self._lib.bps_client_push(
-                self._h, key, buf.ctypes.data, buf.nbytes, codec, worker_id
+            self._lib.bps_client_push2(
+                self._h, key, buf.ctypes.data, buf.nbytes, codec,
+                worker_id, version, crc,
             ),
             "push",
         )
 
     def pull(self, key: int, out: np.ndarray, version: int,
-             codec: int = WIRE_RAW) -> int:
-        """Pull into `out` (capacity buffer); returns actual bytes."""
+             codec: int = WIRE_RAW, want_crc: bool = False) -> int:
+        """Pull into `out` (capacity buffer); returns actual bytes (or
+        ``(bytes, crc)`` when ``want_crc`` — the caller verifies, so the
+        fault-injection layer can corrupt the buffer in between)."""
         assert out.flags.c_contiguous
         self._require_open()
         got = ctypes.c_uint64(0)
+        if want_crc:
+            crc = ctypes.c_uint32(0)
+            self._check(
+                self._lib.bps_client_pull2(
+                    self._h, key, out.ctypes.data, out.nbytes, version,
+                    codec, 1, ctypes.byref(got), ctypes.byref(crc),
+                ),
+                "pull",
+            )
+            return int(got.value), int(crc.value)
         self._check(
             self._lib.bps_client_pull(
                 self._h, key, out.ctypes.data, out.nbytes, version, codec,
@@ -230,6 +271,10 @@ class NativeClient:
     def _check(self, rc: int, op: str) -> None:
         if rc > 0:  # server-side kErr with a message
             msg = self._lib.bps_client_last_error(self._h) or b""
+            if b"crc mismatch" in msg:
+                raise WireCorruption(
+                    f"bps {op} rejected: {msg.decode()} (detected, "
+                    "not applied; retryable)")
             raise RuntimeError(f"bps {op} rejected: {msg.decode()}")
         if rc == -7:
             raise TimeoutError(

@@ -110,7 +110,13 @@ def push_pull_async(tensor: tf.Tensor, average: bool = True,
 def synchronize(handle: Handle, timeout: Optional[float] = 120.0) -> tf.Tensor:
     flat = DcnCore.assemble(handle, timeout)
     if handle.average:  # type: ignore[attr-defined]
+        # degraded slices = LOCAL contributions (no live servers): their
+        # average over the available contributions is themselves; only
+        # global slices divide by size() — handles can be MIXED when the
+        # last server died between partitions (docs/robustness.md)
         flat = flat / size()
+        for off, ln in getattr(handle, "degraded_parts", {}).values():
+            flat[off:off + ln] *= size()
     out = tf.reshape(tf.convert_to_tensor(flat), handle.shape)  # type: ignore[attr-defined]
     return tf.cast(out, handle.dtype)  # type: ignore[attr-defined]
 

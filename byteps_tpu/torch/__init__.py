@@ -125,7 +125,14 @@ def synchronize(handle: Handle, timeout: Optional[float] = 120.0) -> torch.Tenso
     (reference: ``synchronize``/``wait_and_clear``)."""
     flat = DcnCore.assemble(handle, timeout)
     if handle.average:  # type: ignore[attr-defined]
+        # Degraded partitions (no live summation servers mid-handle,
+        # docs/robustness.md) resolved to the LOCAL contribution, whose
+        # average over the available contributions is itself; only the
+        # globally-aggregated slices divide by size(). A handle can be
+        # MIXED when the last server died between partitions.
         flat = flat / size()
+        for off, ln in getattr(handle, "degraded_parts", {}).values():
+            flat[off:off + ln] *= size()
     tensor: torch.Tensor = handle.tensor  # type: ignore[attr-defined]
     out = torch.from_numpy(flat).view(tensor.shape).to(tensor.dtype)
     with torch.no_grad():

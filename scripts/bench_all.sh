@@ -32,6 +32,7 @@ run --mode dcn                           # DCN summation tier
 run --mode dcn-profile                   # host component ceilings
 run --mode throttled                     # compression race on emulated slow DCN
 run --mode tune                          # joint (partition, credit) auto-tune
+run --mode chaos                         # goodput vs fault rate (+BENCH_chaos.json)
 
 echo "collected $(wc -l < "$OUT") results in $OUT" >&2
 cat "$OUT"

@@ -1,0 +1,474 @@
+"""Chaos-hardened DCN data plane (docs/robustness.md).
+
+Tier-1 part (runs every CI pass): fault-spec grammar, plan determinism,
+the csrc replay-dedupe golden test, CRC corruption detection, the
+dead-socket shutdown branch, and the chaos SMOKE — a fixed-seed DcnCore
+push_pull run under two injected fault kinds that must converge to the
+clean values with retry counters > 0 and zero credit leak.
+
+Slow tier: the acceptance sweep (5% timeouts + a 15-step server-down
+window, bit-identical sums vs the clean run), health-monitor failover
+onto the surviving server, and the graceful pure-local degradation when
+every server is dead. The goodput-vs-fault-rate measurement lives in
+``bench.py --mode chaos``.
+"""
+
+import dataclasses
+import logging
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.common.faults import (
+    FaultPlan,
+    FaultRule,
+    parse_fault_spec,
+)
+from byteps_tpu.server import (
+    PSWorker,
+    start_server,
+    stop_server,
+    wire_crc32,
+)
+from byteps_tpu.server.native import NativeClient, WireCorruption, load_lib
+
+BASE_PORT = 25100
+
+
+@pytest.fixture(autouse=True)
+def _cleanup_server():
+    yield
+    stop_server()
+
+
+# ---- fault-spec grammar (pure unit tier) ------------------------------------
+def test_parse_fault_spec_grammar():
+    rules = parse_fault_spec(
+        "push:timeout@p=0.05;server1:down@step=40..55;pull:corrupt@p=0.01;"
+        "all:slow@p=0.5,ms=10;server0:down;push:kill@op=7")
+    assert rules[0] == FaultRule(scope="push", kind="timeout", p=0.05)
+    assert rules[1].server == 1 and rules[1].window == (40, 55)
+    assert rules[2].kind == "corrupt" and rules[2].p == 0.01
+    assert rules[3].latency_ms == 10 and rules[3].p == 0.5
+    assert rules[4].window == (0, None)  # bare rule = always
+    assert rules[5].window == (7, 7)     # single-op window
+    # open-ended window
+    (r,) = parse_fault_spec("server2:down@step=100..")
+    assert r.window == (100, None)
+    for bad in ("push:explode", "push:timeout@q=1", "flux:timeout",
+                "push:timeout@p=x"):
+        with pytest.raises(ValueError, match="bad BYTEPS_FAULT_SPEC"):
+            parse_fault_spec(bad)
+
+
+def test_fault_plan_deterministic_from_seed():
+    spec = "push:timeout@p=0.3;pull:corrupt@p=0.2"
+    a = FaultPlan(parse_fault_spec(spec), seed=7, worker_id=1)
+    b = FaultPlan(parse_fault_spec(spec), seed=7, worker_id=1)
+    seq_a = [(a.intercept("push", 0) or None) and "t" for _ in range(200)]
+    seq_b = [(b.intercept("push", 0) or None) and "t" for _ in range(200)]
+    assert seq_a == seq_b
+    assert a.counters() == b.counters()
+    # a different worker id draws a different (but still seeded) schedule
+    c = FaultPlan(parse_fault_spec(spec), seed=7, worker_id=2)
+    [c.intercept("push", 0) for _ in range(200)]
+    assert c.counters() != {}  # sanity: counters populated
+
+
+def test_fault_plan_window_ticks_per_op():
+    (r,) = parse_fault_spec("server1:down@step=3..4")
+    plan = FaultPlan([r], seed=0)
+    hits = [plan.intercept("push", 1) is not None for _ in range(6)]
+    # ops 3 and 4 (1-indexed) fall in the window — including retries,
+    # which is what lets a transient window expire under pure retry
+    assert hits == [False, False, True, True, False, False]
+    # ops against another server never match
+    plan2 = FaultPlan([r], seed=0)
+    assert all(plan2.intercept("push", 0) is None for _ in range(6))
+
+
+# ---- csrc golden: version-safe replay dedupe --------------------------------
+def _serve(port, num_workers=1, **kw):
+    start_server(port=port, num_workers=num_workers, engine_threads=2,
+                 async_mode=False, **kw)
+    return [("127.0.0.1", port)]
+
+
+def test_push_replay_dedupe_golden():
+    """A re-sent push carrying the same (worker, key, version) — the retry
+    engine's replay after a lost ack — must be summed exactly once."""
+    port = BASE_PORT + 1
+    _serve(port, num_workers=2)
+    c0 = NativeClient("127.0.0.1", port)
+    c1 = NativeClient("127.0.0.1", port)
+    n = 64
+    rng = np.random.default_rng(5)
+    x0 = rng.standard_normal(n).astype(np.float32)
+    x1 = rng.standard_normal(n).astype(np.float32)
+    c0.init_key(0, n * 4)
+    b0 = x0.view(np.uint8).ravel()
+    b1 = x1.view(np.uint8).ravel()
+    # round 1: worker 0's push arrives THREE times (two replays)
+    for _ in range(3):
+        c0.push(0, b0, 0, worker_id=0, version=1, crc=wire_crc32(b0))
+    c1.push(0, b1, 0, worker_id=1, version=1, crc=wire_crc32(b1))
+    out = np.empty(n * 4, np.uint8)
+    got = c0.pull(0, out, 1, 0)
+    np.testing.assert_array_equal(out[:got].view(np.float32), x0 + x1)
+
+    # round 2 pipelined while round 2 is still open for worker 1: worker
+    # 0's v2 goes to the DEFERRED queue — its replay must dedupe there too
+    for _ in range(2):
+        c0.push(0, b0, 0, worker_id=0, version=2, crc=wire_crc32(b0))
+    c1.push(0, b1, 0, worker_id=1, version=2, crc=wire_crc32(b1))
+    got = c0.pull(0, out, 2, 0)
+    np.testing.assert_array_equal(out[:got].view(np.float32), x0 + x1)
+
+    # unversioned pushes (version=0, the legacy wire) never dedupe:
+    # round 3 takes worker 0's push once and worker 1's once as before
+    c0.push(0, b0, 0, worker_id=0, version=3, crc=wire_crc32(b0))
+    c1.push(0, b1, 0, worker_id=1, version=3, crc=wire_crc32(b1))
+    got = c0.pull(0, out, 3, 0)
+    np.testing.assert_array_equal(out[:got].view(np.float32), x0 + x1)
+    c0.shutdown()
+    c1.shutdown()
+    c0.close()
+    c1.close()
+
+
+def test_push_crc_mismatch_rejected_and_not_summed():
+    """A corrupted-but-checksummed push is rejected (retryable
+    WireCorruption), and the round sum proves it was never applied."""
+    port = BASE_PORT + 2
+    _serve(port, num_workers=1)
+    c = NativeClient("127.0.0.1", port)
+    n = 32
+    x = np.arange(n, dtype=np.float32)
+    b = x.view(np.uint8).ravel()
+    c.init_key(0, n * 4)
+    crc = wire_crc32(b)
+    bad = b.copy()
+    bad[5] ^= 0xFF
+    with pytest.raises(WireCorruption, match="crc mismatch"):
+        c.push(0, bad, 0, worker_id=0, version=1, crc=crc)
+    # the pristine re-send (same version) completes the round correctly
+    c.push(0, b, 0, worker_id=0, version=1, crc=crc)
+    out = np.empty(n * 4, np.uint8)
+    got = c.pull(0, out, 1, 0)
+    np.testing.assert_array_equal(out[:got].view(np.float32), x)
+    # checksummed pull: the returned crc verifies round-trip
+    got2, rcrc = c.pull(0, out, 1, 0, want_crc=True)
+    assert rcrc == wire_crc32(out[:got2])
+    c.shutdown()
+    c.close()
+
+
+# ---- PSWorker retry engine --------------------------------------------------
+def test_worker_retries_injected_timeouts_and_corruption(monkeypatch):
+    """Direct PSWorker loop under injected push-ack loss (the op WAS
+    applied — replay dedupe keeps sums exact) and pull corruption
+    (detected by the response CRC)."""
+    monkeypatch.setenv("BYTEPS_RETRY_LIMIT", "6")
+    monkeypatch.setenv("BYTEPS_RETRY_BACKOFF_MS", "2")
+    monkeypatch.setenv(
+        "BYTEPS_FAULT_SPEC", "push:timeout@p=0.25;pull:corrupt@p=0.25")
+    monkeypatch.setenv("BYTEPS_FAULT_SEED", "3")
+    port = BASE_PORT + 3
+    servers = _serve(port, num_workers=1)
+    w = PSWorker(servers=servers, worker_id=0)
+    x = np.linspace(-1, 1, 256, dtype=np.float32)
+    w.init_key(1, x.nbytes)
+    for _ in range(25):
+        np.testing.assert_array_equal(w.push_pull(1, x), x)
+    counters = w.get_counters()
+    assert counters["retries"] > 0, counters
+    assert counters["injected_timeout"] > 0, counters
+    assert counters["injected_corrupt"] > 0, counters
+    assert counters["crc_errors"] > 0, counters
+    assert counters["give_ups"] == 0, counters
+    w.shutdown()
+
+
+def test_shutdown_dead_socket_branch_and_debug_log(monkeypatch):
+    """Satellite: PSWorker.shutdown() must send kShutdown on a FRESH
+    connection when the pooled one is dead (or the server's exit count
+    never completes), and the server-already-gone branch logs at debug
+    WITH the server index instead of swallowing bare."""
+    monkeypatch.setenv("BYTEPS_RETRY_LIMIT", "0")  # fail fast to kill conn
+    port = BASE_PORT + 4
+    servers = _serve(port, num_workers=1)
+    w = PSWorker(servers=servers, worker_id=0, recv_timeout_ms=300)
+    x = np.ones(8, np.float32)
+    w.init_key(2, x.nbytes)
+    w.push_pull(2, x)
+    # pull a round that will never exist -> socket-level recv timeout
+    # kills the connection (and retry_limit=0 surfaces it immediately)
+    with pytest.raises(TimeoutError):
+        w.pull(2, 8, version=99)
+    assert w._tls.conns[2 % 1].is_dead()
+    w.shutdown()  # dead pooled conn -> kShutdown rides a fresh connection
+    lib = load_lib()
+    deadline = time.time() + 5
+    while time.time() < deadline and lib.bps_local_init(3, 32) != -10:
+        time.sleep(0.05)
+    assert lib.bps_local_init(3, 32) == -10  # server counted the shutdown
+
+    # server gone: a second worker's shutdown logs the failure at debug
+    # (the byteps_tpu root logger has propagate=False, so attach a
+    # handler directly instead of relying on caplog's root handler)
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    srv_log = logging.getLogger("byteps_tpu.server")
+    cap = _Capture(level=logging.DEBUG)
+    old_level = srv_log.level
+    srv_log.addHandler(cap)
+    srv_log.setLevel(logging.DEBUG)
+    try:
+        w2 = PSWorker(servers=servers, worker_id=0, timeout_ms=500)
+        w2.shutdown()
+    finally:
+        srv_log.removeHandler(cap)
+        srv_log.setLevel(old_level)
+    assert any("shutdown of server 0 failed" in m for m in records), records
+
+
+# ---- tier-1 chaos smoke (full DcnCore pipeline) -----------------------------
+def test_chaos_smoke_dcncore_converges_with_retries(monkeypatch):
+    """THE tier-1 chaos smoke: fixed seed, two fault kinds (push-ack loss
+    + pull corruption) through the full COMPRESS/PUSH/PULL/DECOMPRESS
+    pipeline. Asserts (a) every round's push_pull values converge to the
+    clean expectation, (b) retry counters fired, (c) no credit leaked."""
+    from byteps_tpu.common import config as config_mod
+    from byteps_tpu.common.dcn_adapter import DcnCore
+
+    monkeypatch.setenv("BYTEPS_RETRY_LIMIT", "6")
+    monkeypatch.setenv("BYTEPS_RETRY_BACKOFF_MS", "2")
+    monkeypatch.setenv(
+        "BYTEPS_FAULT_SPEC", "push:timeout@p=0.2;pull:corrupt@p=0.2")
+    monkeypatch.setenv("BYTEPS_FAULT_SEED", "1")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+    config_mod.reset_config()
+    port = BASE_PORT + 5
+    _serve(port, num_workers=1)
+    core = DcnCore(servers=[("127.0.0.1", port)])
+    try:
+        rng = np.random.default_rng(0)
+        flat = rng.standard_normal(16384).astype(np.float32)
+        for _ in range(20):
+            h = core.push_pull_async(flat, name="chaos_smoke")
+            out = DcnCore.assemble(h, timeout=60.0)
+            # one worker: the round sum IS the pushed vector, bit-exact
+            np.testing.assert_array_equal(out, flat)
+        counters = core.worker.get_counters()
+        assert counters["retries"] > 0, counters
+        assert counters["injected_timeout"] > 0, counters
+        assert counters["injected_corrupt"] > 0, counters
+        assert counters["give_ups"] == 0, counters
+        # no credit leaked across all those retries
+        sched = core.scheduler
+        assert sched._credits == sched._credit_total
+    finally:
+        core.shutdown()
+
+
+# ---- acceptance: transient server-down window (slow tier) -------------------
+@pytest.mark.slow
+def test_bit_identical_sums_under_timeouts_and_down_window(monkeypatch):
+    """Acceptance criterion: 5% injected recv timeouts plus one 15-step
+    server-down window; a 2-worker multi-round push_pull workload must
+    complete with BIT-IDENTICAL sums to the clean run (replay dedupe +
+    retry/backoff outlasting the window), with retry counters fired."""
+    import threading
+
+    rng = np.random.default_rng(11)
+    keys = [0, 1]
+    rounds = 30
+    n = 512
+    data = {w: {k: rng.standard_normal(n).astype(np.float32)
+                for k in keys} for w in range(2)}
+
+    def run(port, spec):
+        monkeypatch.setenv("BYTEPS_RETRY_LIMIT", "30")
+        monkeypatch.setenv("BYTEPS_RETRY_BACKOFF_MS", "1")
+        monkeypatch.setenv("BYTEPS_FAULT_SPEC", spec)
+        monkeypatch.setenv("BYTEPS_FAULT_SEED", "2")
+        from byteps_tpu.common import config as config_mod
+
+        config_mod.reset_config()
+        servers = _serve(port, num_workers=2)
+        results = {}
+        counters = {}
+
+        def body(widx):
+            w = PSWorker(servers=servers, worker_id=widx)
+            for k in keys:
+                w.init_key(k, n * 4)
+            w.barrier()
+            out = []
+            for _ in range(rounds):
+                vs = [w.push(k, data[widx][k]) for k in keys]
+                out.append([w.pull(k, n, v).copy()
+                            for k, v in zip(keys, vs)])
+            results[widx] = out
+            counters[widx] = w.get_counters()
+            w.shutdown()
+
+        ts = [threading.Thread(target=body, args=(i,)) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+            assert not t.is_alive(), "worker hung under chaos"
+        stop_server()
+        return results, counters
+
+    clean, _ = run(BASE_PORT + 6, "")
+    chaos, counters = run(
+        BASE_PORT + 7,
+        "push:timeout@p=0.05;server0:down@step=40..55")
+    # the chaos run saw faults and healed
+    total = {k: sum(c[k] for c in counters.values())
+             for k in counters[0]}
+    assert total["retries"] > 0, total
+    assert total["injected_timeout"] + total["injected_down"] > 0, total
+    # ...and every round of every worker matches the clean run BIT-exactly
+    for widx in range(2):
+        for r in range(rounds):
+            for ki, k in enumerate(keys):
+                np.testing.assert_array_equal(
+                    chaos[widx][r][ki], clean[widx][r][ki],
+                    err_msg=f"worker {widx} round {r} key {k}")
+
+
+# ---- failover + graceful degradation (slow tier) ----------------------------
+@pytest.mark.slow
+def test_health_monitor_failover_to_survivor(monkeypatch):
+    """An open-ended down window on server 1 trips the ping health monitor
+    after K misses; server 1's keys fail over (rendezvous over the live
+    set) to server 0 and push_pull keeps working with fresh rounds."""
+    import os
+    import subprocess
+    import sys
+
+    p0, p1 = BASE_PORT + 8, BASE_PORT + 9
+    _serve(p0, num_workers=1)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "from byteps_tpu.server import start_server;"
+         "from byteps_tpu.server.native import load_lib;"
+         "start_server(port=%d, num_workers=1, engine_threads=1,"
+         "async_mode=False); load_lib().bps_server_wait()" % p1],
+        env={**os.environ, "PYTHONPATH": repo},
+    )
+    try:
+        monkeypatch.setenv("BYTEPS_RETRY_LIMIT", "2")
+        monkeypatch.setenv("BYTEPS_RETRY_BACKOFF_MS", "1")
+        # server 1 goes down from plan-op 30 onward, forever
+        monkeypatch.setenv("BYTEPS_FAULT_SPEC", "server1:down@op=30..")
+        monkeypatch.setenv("BYTEPS_HEALTH_INTERVAL_MS", "50")
+        monkeypatch.setenv("BYTEPS_HEALTH_MISS_LIMIT", "3")
+        from byteps_tpu.common import config as config_mod
+
+        config_mod.reset_config()
+        servers = [("127.0.0.1", p0), ("127.0.0.1", p1)]
+        w = PSWorker(servers=servers, worker_id=0)
+        x = np.arange(64, dtype=np.float32)
+        for k in (0, 1):  # key 0 -> server 0, key 1 -> server 1
+            w.init_key(k, x.nbytes)
+            np.testing.assert_array_equal(w.push_pull(k, x), x)
+        assert w.server_for(1) == 1
+        # monitor pings tick the plan past op 30 -> server 1 "dies";
+        # K misses at 50 ms intervals mark it dead
+        deadline = time.time() + 15
+        while time.time() < deadline and 1 in w.live_servers():
+            time.sleep(0.05)
+        assert w.live_servers() == {0}, "health monitor never failed over"
+        assert w.server_for(1) == 0  # remapped to the survivor
+        # new rounds work against the survivor (fresh round numbering,
+        # lazy re-init from the recorded key size)
+        for _ in range(3):
+            np.testing.assert_array_equal(w.push_pull(1, x), x)
+        counters = w.get_counters()
+        assert counters["failovers"] == 1, counters
+        assert counters["reinits"] >= 1, counters
+        w.shutdown()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+@pytest.mark.slow
+def test_degraded_local_fallback_when_all_servers_dead(monkeypatch):
+    """With NO live servers and BYTEPS_DEGRADED_OK (default), DcnCore
+    degrades push_pull to the local contribution instead of failing the
+    handle; with it off, the handle fails loudly."""
+    from byteps_tpu.common import config as config_mod
+    from byteps_tpu.common.dcn_adapter import DcnCore
+    from byteps_tpu.common.scheduler import PartitionFailure
+
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    config_mod.reset_config()
+    port = BASE_PORT + 10
+    _serve(port, num_workers=1)
+    core = DcnCore(servers=[("127.0.0.1", port)])
+    try:
+        flat = np.linspace(0, 1, 4096, dtype=np.float32)
+        h = core.push_pull_async(flat, name="pre")
+        np.testing.assert_array_equal(DcnCore.assemble(h, 30.0), flat)
+        core.worker.fail_over(0, barrier=False)  # the only server "dies"
+        assert not core.worker.has_live_servers()
+        h = core.push_pull_async(flat, name="post")
+        out = DcnCore.assemble(h, 30.0)
+        np.testing.assert_array_equal(out, flat)  # local contribution
+        assert core.worker.get_counters()["ici_fallbacks"] >= 1
+    finally:
+        core.shutdown()
+        stop_server()
+
+    # strict mode: degraded_ok=False fails the handle instead
+    cfg = dataclasses.replace(config_mod.Config.from_env(),
+                              degraded_ok=False, num_worker=1)
+    config_mod.set_config(cfg)
+    port = BASE_PORT + 11
+    _serve(port, num_workers=1)
+    core = DcnCore(servers=[("127.0.0.1", port)])
+    try:
+        flat = np.linspace(0, 1, 4096, dtype=np.float32)
+        core.worker.fail_over(0, barrier=False)
+        h = core.push_pull_async(flat, name="strict")
+        with pytest.raises(PartitionFailure, match="no live summation"):
+            DcnCore.assemble(h, 30.0)
+    finally:
+        core.shutdown()
+
+
+def test_mixed_degraded_handle_scales_per_partition(monkeypatch):
+    """A handle can be MIXED: partition 0 aggregated globally before the
+    last server died, partition 1 degraded to the local contribution.
+    Averaging adapters must scale slice-by-slice — global slices divide
+    by size(), degraded slices stay local."""
+    torch = pytest.importorskip("torch")
+    import dataclasses as dc
+
+    import byteps_tpu.torch as bt
+    from byteps_tpu.common.config import Config
+    from byteps_tpu.common.scheduler import Handle
+
+    monkeypatch.setattr(bt._state, "initialized", True)
+    monkeypatch.setattr(bt._state, "cfg", dc.replace(Config(), num_worker=4))
+    h = Handle("t", 2)
+    h._partition_done(0, np.full(4, 8.0, np.float32))  # 4-worker global sum
+    h._partition_done(1, np.full(4, 3.0, np.float32))  # degraded local value
+    h.average = True
+    h.degraded_parts = {1: (4, 4)}  # part 1 covers elements [4, 8)
+    h.tensor = torch.zeros(8)
+    out = bt.synchronize(h)
+    np.testing.assert_array_equal(
+        out.numpy(), np.array([2, 2, 2, 2, 3, 3, 3, 3], np.float32))

@@ -10,6 +10,7 @@ import pytest
 from byteps_tpu.common.partition import make_partitions
 from byteps_tpu.common.scheduler import (
     Handle,
+    PartitionFailure,
     PartitionTask,
     PipelineScheduler,
     Stage,
@@ -121,8 +122,12 @@ def test_stage_error_propagates():
     sched = PipelineScheduler([Stage("A", boom)], credit=1)
     h = Handle("t", 1)
     sched.enqueue(_tasks_for(0, 1, "t", h))
-    with pytest.raises(ValueError):
+    # wait() raises a PartitionFailure NAMING the failed partition, with
+    # the original stage exception as its cause
+    with pytest.raises(PartitionFailure, match="partition 0") as ei:
         h.wait(5)
+    assert isinstance(ei.value.cause, ValueError)
+    assert ei.value.part_idx == 0
     sched.shutdown()
 
 
@@ -235,3 +240,209 @@ def test_enqueue_after_shutdown_raises():
     h = Handle("t", 1)
     with pytest.raises(RuntimeError):
         sched.enqueue(_tasks_for(0, 1, "t", h))
+
+
+# ---- chaos-hardening regressions (docs/robustness.md) -----------------------
+def test_shutdown_fails_queued_and_inflight_handles():
+    """Regression: shutdown() used to strand queued/in-flight tasks —
+    Handle.wait() and drain() blocked forever. Both must raise."""
+    release = threading.Event()
+
+    def slow(task):
+        release.wait(5)
+        return task.partition.key
+
+    # single worker: task 0 occupies the pool, the rest sit queued
+    sched = PipelineScheduler(
+        [Stage("A", slow, pool_size=1)], credit=4)
+    h = Handle("t", 4)
+    sched.enqueue(_tasks_for(0, 4, "t", h))
+    time.sleep(0.05)  # let the pool pick up the first task
+    sched.shutdown()
+    release.set()
+    with pytest.raises(PartitionFailure, match="shut down"):
+        h.wait(5)
+    with pytest.raises(RuntimeError, match="shut down"):
+        sched.drain(timeout=5)
+    # enqueue after shutdown still raises (pre-existing contract)
+    h2 = Handle("t2", 1)
+    with pytest.raises(RuntimeError):
+        sched.enqueue(_tasks_for(1, 1, "t2", h2))
+
+
+def test_shutdown_fails_task_advancing_mid_pipeline():
+    """An in-flight task whose stage completes AFTER shutdown must fail
+    its handle rather than being re-queued into a dead scheduler."""
+    entered = threading.Event()
+    release = threading.Event()
+
+    def gate(task):
+        entered.set()
+        release.wait(5)
+        return 1
+
+    sched = PipelineScheduler(
+        [Stage("A", gate), Stage("B", lambda t: t.payload)], credit=1)
+    h = Handle("t", 1)
+    sched.enqueue(_tasks_for(0, 1, "t", h))
+    assert entered.wait(5)
+    sched.shutdown()  # while the task is inside stage A
+    release.set()
+    with pytest.raises(PartitionFailure, match="shut down"):
+        h.wait(5)
+
+
+def test_failed_handle_freezes_results_and_names_partition():
+    """Regression: _partition_failed set the event while sibling
+    partitions kept mutating `results`. The failure must snapshot the
+    partials, name the failed partition, and freeze the handle against
+    later completions."""
+    fail_gate = threading.Event()
+
+    def fn(task):
+        if task.partition.part_idx == 1:
+            raise RuntimeError("wire died")
+        if task.partition.part_idx == 3:
+            # finishes AFTER the failure has frozen the handle
+            fail_gate.wait(5)
+            time.sleep(0.05)
+        return task.partition.part_idx * 10
+
+    sched = PipelineScheduler([Stage("A", fn, pool_size=4)], credit=8)
+    h = Handle("t", 4)
+    sched.enqueue(_tasks_for(0, 4, "t", h))
+    with pytest.raises(PartitionFailure) as ei:
+        # handle completes (failed) as soon as partition 1 dies
+        h.wait(10)
+    fail_gate.set()
+    err = ei.value
+    assert err.part_idx == 1
+    assert "partition 1" in str(err)
+    assert isinstance(err.cause, RuntimeError)
+    # partial results are a snapshot of what had completed pre-failure
+    assert set(err.partial_results).issubset({0, 2, 3})
+    assert all(v == k * 10 for k, v in err.partial_results.items())
+    snapshot = dict(err.partial_results)
+    sched.drain(timeout=10)  # let partition 3 finish against the frozen handle
+    assert err.partial_results == snapshot  # late completion didn't mutate
+    assert h.failed()
+    sched.shutdown()
+
+
+def test_retryable_stage_reenqueues_with_backoff_and_succeeds():
+    """Stage.retryable: a transiently-failing stage re-runs at its own
+    stage (bounded attempts, priority preserved) instead of failing the
+    Handle."""
+    calls = {}
+    lock = threading.Lock()
+
+    def flaky(task):
+        with lock:
+            n = calls.get(task.partition.part_idx, 0) + 1
+            calls[task.partition.part_idx] = n
+        if n < 3:
+            raise TimeoutError("transient")
+        return task.partition.part_idx
+
+    sched = PipelineScheduler(
+        [Stage("PUSH", flaky, credited=True, pool_size=2, retryable=True,
+               max_attempts=4, retry_backoff_s=0.005)],
+        credit=2,
+    )
+    h = Handle("t", 3)
+    sched.enqueue(_tasks_for(0, 3, "t", h))
+    res = h.wait(10)
+    assert res == {0: 0, 1: 1, 2: 2}
+    assert all(n == 3 for n in calls.values())
+    assert sched._credits == sched._credit_total
+    sched.shutdown()
+
+
+def test_retryable_stage_exhausts_budget_then_fails():
+    def always(task):
+        raise TimeoutError("still down")
+
+    sched = PipelineScheduler(
+        [Stage("PUSH", always, retryable=True, max_attempts=3,
+               retry_backoff_s=0.001)],
+        credit=1,
+    )
+    h = Handle("t", 1)
+    sched.enqueue(_tasks_for(0, 1, "t", h))
+    with pytest.raises(PartitionFailure, match="still down"):
+        h.wait(10)
+    assert sched._credits == sched._credit_total
+    sched.shutdown()
+
+
+def test_nonretryable_error_fails_immediately():
+    """An exception carrying retryable=False skips the stage retry."""
+    calls = []
+
+    class Fatal(RuntimeError):
+        retryable = False
+
+    def fn(task):
+        calls.append(1)
+        raise Fatal("no point")
+
+    sched = PipelineScheduler(
+        [Stage("PUSH", fn, retryable=True, max_attempts=5,
+               retry_backoff_s=0.001)],
+        credit=1,
+    )
+    h = Handle("t", 1)
+    sched.enqueue(_tasks_for(0, 1, "t", h))
+    with pytest.raises(PartitionFailure, match="no point"):
+        h.wait(5)
+    assert len(calls) == 1
+    sched.shutdown()
+
+
+def test_retry_credit_interaction_randomized():
+    """Pinned satellite: a task failing mid-PUSH (wire-scoped credit
+    released on the backoff path) retries without the credit pool ever
+    exceeding _credit_total or leaking below it, across 100 randomized
+    failure schedules."""
+    import random
+
+    rng = random.Random(1234)
+    for schedule in range(100):
+        p_push, p_pull = rng.uniform(0.0, 0.6), rng.uniform(0.0, 0.6)
+        credit = rng.randint(1, 3)
+        nparts = rng.randint(2, 6)
+        observed_max = [0]
+        violations = []
+
+        def make_flaky(p):
+            def fn(task, _p=p):
+                with lock:
+                    # pool invariant sampled from inside the stages too
+                    if not (0 <= sched._credits <= sched._credit_total):
+                        violations.append(sched._credits)
+                if rng.random() < _p and task.stage_attempts < 3:
+                    raise TimeoutError("injected")
+                return task.partition.part_idx
+            return fn
+
+        lock = threading.Lock()
+        sched = PipelineScheduler(
+            [Stage("PUSH", make_flaky(p_push), credited=True, pool_size=4,
+                   releases_credit=True, retryable=True, max_attempts=5,
+                   retry_backoff_s=0.001),
+             Stage("PULL", make_flaky(p_pull), pool_size=4,
+                   retryable=True, max_attempts=5,
+                   retry_backoff_s=0.001)],
+            credit=credit,
+        )
+        h = Handle(f"s{schedule}", nparts)
+        sched.enqueue(_tasks_for(schedule, nparts, f"s{schedule}", h))
+        try:
+            h.wait(30)
+        except PartitionFailure:
+            pass  # a schedule may exhaust a task's budget; that's fine
+        sched.drain(timeout=30)
+        assert not violations, (schedule, violations)
+        assert sched._credits == sched._credit_total, (
+            schedule, sched._credits, sched._credit_total, observed_max)
+        sched.shutdown()
