@@ -1329,6 +1329,214 @@ def bench_throttled(rates_mbps=(64, 200, 800), reps: int = 3,
     }
 
 
+def bench_hybrid(workers: int = 4, rate_mbps: float = 200.0,
+                 payload_mb: int = 16, reps: int = 3,
+                 partition_kbs=(256, 512)) -> dict:
+    """The sharded-wire hierarchical race (BytePS "use every link"):
+    a pod of ``workers`` controllers, each with its own token-bucket NIC
+    at ``rate_mbps``, aggregates a ``payload_mb`` MB gradient through the
+    DCN summation tier.
+
+    * **sharded** — ``DcnCore(pod_controllers=W)``: the pod's sum is
+      pushed ONCE, each partition through its rendezvous-hashed owner's
+      NIC — per-NIC wire bytes divide by W and all W NICs run in
+      parallel (this PR's hierarchical dataflow).
+    * **everyone** — the flat/vanilla-PS dataflow the hierarchy replaces:
+      W full DMLC workers, each pushing the ENTIRE gradient through its
+      own NIC (the server sums W contributions), so every NIC carries
+      full-gradient bytes.
+
+    Both legs run the full COMPRESS→PUSH→PULL→DECOMPRESS pipeline on raw
+    fp32 wires (compression composes orthogonally — the throttled race
+    measures it), 3-rep medians with spreads, at every partition size in
+    ``partition_kbs`` (the dataflows prefer different sizes: sharded
+    wants small chunks for per-NIC balance/pipelining, flat PS wants
+    large ones for fewer per-op round trips). The headline is the
+    CONSERVATIVE cross: best-everyone-over-sizes / best-sharded-over-
+    sizes — each dataflow at the partition size that favors it (≥ 3× at
+    W=4 is the acceptance bar)."""
+    import dataclasses as _dc
+    import threading
+
+    from byteps_tpu.common import config as config_mod
+    from byteps_tpu.common.dcn_adapter import DcnCore
+    from byteps_tpu.server import start_server_any_port, stop_server
+
+    base_port = 25400
+    nelems = payload_mb * (1 << 20) // 4
+    flat = np.random.default_rng(0).standard_normal(nelems).astype(
+        np.float32)
+    dense_bytes = flat.nbytes
+    base_cfg = config_mod.Config.from_env()
+    results = {}
+    port = [base_port]
+
+    def next_server(num_workers):
+        port[0] = start_server_any_port(port[0] + 1, num_workers=num_workers,
+                                        engine_threads=4, async_mode=False)
+        return port[0]
+
+    def run_sharded(partition_kb):
+        cfg = _dc.replace(base_cfg, num_worker=1, num_server=1,
+                          dcn_throttle_mbps=float(rate_mbps),
+                          partition_bytes=partition_kb << 10)
+        config_mod.set_config(cfg)
+        next_server(num_workers=1)
+        core = None
+        try:
+            core = DcnCore(servers=[("127.0.0.1", port[0])],
+                           pod_controllers=workers)
+            times = []
+            for rep in range(reps + 1):   # rep 0 = warmup (key init)
+                t0 = time.perf_counter()
+                h = core.push_pull_async(flat, name="hybrid.sharded")
+                out = DcnCore.assemble(h, timeout=600.0)
+                if rep > 0:
+                    times.append(time.perf_counter() - t0)
+            np.testing.assert_array_equal(out, flat)  # 1 pod: sum == in
+            per_nic = [w.bytes_pushed // (reps + 1) for w in core.workers]
+        finally:
+            if core is not None:
+                core.shutdown()
+            stop_server()
+            config_mod.reset_config()
+        times.sort()
+        med = float(np.median(times))
+        _log(f"hybrid sharded  W={workers} @{rate_mbps:g}Mbps "
+             f"{partition_kb}KB: {med:.3f}s/round "
+             f"[{times[0]:.3f}, {times[-1]:.3f}], "
+             f"{sum(1 for b in per_nic if b)} NICs active, "
+             f"max {max(per_nic)/1e6:.2f} MB/NIC/dir")
+        return {
+            "sec_med": round(med, 3),
+            "sec_spread": [round(times[0], 3), round(times[-1], 3)],
+            "dense_gbps_eff": round(2 * dense_bytes / med / 1e9, 4),
+            "push_bytes_per_nic_round": per_nic,
+            "active_nics": sum(1 for b in per_nic if b),
+        }
+
+    def run_everyone(partition_kb):
+        cfg = _dc.replace(base_cfg, num_worker=workers, num_server=1,
+                          dcn_throttle_mbps=float(rate_mbps),
+                          partition_bytes=partition_kb << 10)
+        config_mod.set_config(cfg)
+        next_server(num_workers=workers)
+        cores: list = [None] * workers
+        try:
+            # DcnCore.__init__ runs the worker barrier — construct
+            # concurrently or the first would wait for peers forever.
+            # Worker-thread exceptions are collected and re-raised so a
+            # connect/push failure fails the bench HERE, not as a
+            # misleading downstream assert on a None output. A death
+            # BEFORE the rep barrier aborts it (siblings unblock with
+            # BrokenBarrierError); a death AFTER it is noticed by the
+            # siblings' short assemble() poll, which gives up once a
+            # peer has recorded an error — the server round can never
+            # complete without the dead worker's contribution.
+            errs: list = []
+
+            def mk(w):
+                try:
+                    cores[w] = DcnCore(servers=[("127.0.0.1", port[0])],
+                                       worker_id=w, pod_controllers=1)
+                except BaseException as e:
+                    errs.append(e)
+
+            ts = [threading.Thread(target=mk, args=(w,))
+                  for w in range(workers)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            if errs:
+                raise errs[0]
+            times = []
+            outs = [None] * workers
+            for rep in range(reps + 1):
+                barrier = threading.Barrier(workers)
+
+                def body(w):
+                    try:
+                        barrier.wait()
+                        h = cores[w].push_pull_async(
+                            flat, name="hybrid.everyone")
+                        deadline = time.monotonic() + 600.0
+                        while True:
+                            try:
+                                outs[w] = DcnCore.assemble(h, timeout=5.0)
+                                break
+                            except TimeoutError:
+                                if errs or time.monotonic() > deadline:
+                                    raise
+                    except threading.BrokenBarrierError:
+                        pass  # a sibling already recorded the cause
+                    except BaseException as e:
+                        errs.append(e)
+                        barrier.abort()
+
+                ts = [threading.Thread(target=body, args=(w,))
+                      for w in range(workers)]
+                t0 = time.perf_counter()
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                if errs:
+                    raise errs[0]
+                if rep > 0:
+                    times.append(time.perf_counter() - t0)
+            for w in range(workers):  # server summed all W contributions
+                np.testing.assert_allclose(outs[w], workers * flat,
+                                           rtol=1e-6)
+            per_nic = [c.worker.bytes_pushed // (reps + 1) for c in cores]
+        finally:
+            for c in cores:
+                if c is not None:
+                    c.shutdown()
+            stop_server()
+            config_mod.reset_config()
+        times.sort()
+        med = float(np.median(times))
+        _log(f"hybrid everyone W={workers} @{rate_mbps:g}Mbps "
+             f"{partition_kb}KB: {med:.3f}s/round "
+             f"[{times[0]:.3f}, {times[-1]:.3f}]")
+        return {
+            "sec_med": round(med, 3),
+            "sec_spread": [round(times[0], 3), round(times[-1], 3)],
+            "dense_gbps_eff": round(2 * dense_bytes / med / 1e9, 4),
+            "push_bytes_per_nic_round": per_nic,
+        }
+
+    for pkb in partition_kbs:
+        results[f"{pkb}KB"] = {
+            "sharded": run_sharded(pkb),
+            "everyone": run_everyone(pkb),
+        }
+    best_sharded = min(r["sharded"]["sec_med"] for r in results.values())
+    best_everyone = min(r["everyone"]["sec_med"] for r in results.values())
+    for r in results.values():
+        r["speedup_same_size"] = round(
+            r["everyone"]["sec_med"] / r["sharded"]["sec_med"], 3)
+    speedup = best_everyone / best_sharded
+    _log(f"hybrid race: best sharded {best_sharded:.3f}s vs best "
+         f"everyone {best_everyone:.3f}s -> {speedup:.2f}x")
+    return {
+        "metric": (f"sharded-wire hierarchical push_pull race "
+                   f"({workers} pod controllers x {rate_mbps:g} Mbps "
+                   f"NICs vs everyone-pushes-everything, each at its "
+                   f"best partition size)"),
+        "value": round(speedup, 3),
+        "unit": "x aggregate goodput vs flat PS",
+        "vs_baseline": round(speedup, 3),
+        "workers": workers,
+        "rate_mbps": rate_mbps,
+        "payload_mb": payload_mb,
+        "partition_kbs": list(partition_kbs),
+        "reps": reps,
+        "results": results,
+    }
+
+
 def bench_chaos(payload_mb: int = 8, rounds: int = 4, reps: int = 3) -> dict:
     """Goodput degradation vs fault rate (docs/robustness.md): the chaos
     matrix {clean, 5% push-ack loss, one server down} × {raw, onebit}
@@ -1610,11 +1818,18 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode",
                     choices=["auto", "dcn", "dcn-profile", "throttled",
-                             "tune", "chaos", "generate", "profile"],
+                             "tune", "chaos", "hybrid", "generate",
+                             "profile"],
                     default="auto")
     ap.add_argument("--rates", default="64,200,800",
                     help="throttled mode: comma-separated emulated link "
                     "rates in Mbps (BYTEPS_DCN_THROTTLE_MBPS sweep)")
+    ap.add_argument("--workers", type=int, default=4,
+                    help="hybrid mode: emulated pod controllers (sharded "
+                    "leg) = DMLC workers (everyone leg), one throttled "
+                    "NIC each")
+    ap.add_argument("--rate", type=float, default=200.0,
+                    help="hybrid mode: per-NIC emulated rate in Mbps")
     ap.add_argument("--model",
                     choices=["gpt", "gpt2m", "bert", "resnet50", "vit",
                              "t5", "moe"],
@@ -1643,7 +1858,8 @@ def main() -> None:
         _log(f"bench: WARNING --ce has no effect on {args.model} — its "
              "class-count logits are tiny, so there is no chunked-CE path "
              "to toggle (docs/models.md families table)")
-    if args.mode in ("dcn", "dcn-profile", "throttled", "tune", "chaos"):
+    if args.mode in ("dcn", "dcn-profile", "throttled", "tune", "chaos",
+                     "hybrid"):
         if flags_set:
             _log("bench: WARNING --model/--compressor/--ce ignored in "
                  f"{args.mode} mode")
@@ -1659,6 +1875,12 @@ def main() -> None:
             with open("BENCH_chaos.json", "w") as f:
                 json.dump(result, f, indent=1)
             _log("bench: wrote BENCH_chaos.json")
+        elif args.mode == "hybrid":
+            result = bench_hybrid(workers=args.workers,
+                                  rate_mbps=args.rate)
+            with open("BENCH_hybrid.json", "w") as f:
+                json.dump(result, f, indent=1)
+            _log("bench: wrote BENCH_hybrid.json")
         else:
             result = bench_dcn_profile()
     elif args.mode == "profile":

@@ -60,6 +60,75 @@ def allreduce_flat(
     return _allreduce_impl(x, mesh=mesh, axis=axis, average=average)
 
 
+@functools.partial(jax.jit, static_argnames=("axis", "mesh"))
+def _reduce_scatter_impl(x, *, mesh: Mesh, axis: str):
+    n = mesh.shape[axis]
+    L = x.shape[1]
+    seg = -(-L // n)
+
+    def inner(blk):
+        gp = jnp.pad(blk[0], (0, seg * n - L))
+        return jax.lax.psum_scatter(gp, axis, scatter_dimension=0,
+                                    tiled=True)
+
+    # check_vma=False for symmetry with the compressed impls: the sharded
+    # output spec is exactly what psum_scatter produces, but the static
+    # varying-mesh-axes analysis on this jax version can't always prove it
+    return jax.shard_map(
+        inner, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+        check_vma=False,
+    )(x)
+
+
+def reduce_scatter_flat(
+    x: jnp.ndarray, mesh: Mesh, axis: Optional[str] = None
+) -> jnp.ndarray:
+    """Sum-reduce (N, L) into per-device owner segments: device j ends up
+    holding segment j of the pod sum — a flat ``(n·ceil(L/n),)`` array
+    sharded over ``axis`` whose first L elements, concatenated, are the
+    sum. The first half of the hierarchical wire plan (the reference's
+    intra-machine NCCL reduce-scatter before COPYD2H): each link carries
+    (n−1)/n · L elements instead of allreduce's 2(n−1)/n, and each host
+    only ever needs its own segments off the device.
+
+    The tail half is :func:`all_gather_flat`; reduce-scatter + all-gather
+    moves the same total bytes as one allreduce, but lets the DCN round
+    trip (and per-owner compression) happen on the scattered form.
+    """
+    axis = axis or mesh.axis_names[0]
+    return _reduce_scatter_impl(x, mesh=mesh, axis=axis)
+
+
+@functools.partial(jax.jit, static_argnames=("axis", "mesh"))
+def _all_gather_impl(x, *, mesh: Mesh, axis: str):
+    def inner(seg):
+        return jax.lax.all_gather(seg, axis, axis=0, tiled=True)
+
+    return jax.shard_map(
+        inner, mesh=mesh, in_specs=P(axis), out_specs=P(),
+        check_vma=False,
+    )(x)
+
+
+def all_gather_flat(
+    x: jnp.ndarray,
+    mesh: Mesh,
+    axis: Optional[str] = None,
+    length: Optional[int] = None,
+) -> jnp.ndarray:
+    """Replicate per-device segments back into one flat vector: the
+    ``(n·seg,)`` array sharded over ``axis`` (the layout
+    :func:`reduce_scatter_flat` produces, and the layout the sharded
+    COPYH2D stage device_puts) becomes a replicated ``(length,)`` result —
+    the hierarchical tail (the reference's BROADCAST after COPYH2D).
+    Exact: gathering moves bits, never sums."""
+    axis = axis or mesh.axis_names[0]
+    out = _all_gather_impl(x, mesh=mesh, axis=axis)
+    if length is not None and length != out.shape[0]:
+        out = jax.lax.slice_in_dim(out, 0, length, axis=0)
+    return out
+
+
 @functools.partial(jax.jit, static_argnames=("axis", "root", "mesh"))
 def _broadcast_impl(x, *, mesh: Mesh, axis: str, root: int):
     def inner(blk):

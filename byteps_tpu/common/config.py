@@ -114,6 +114,24 @@ class Config:
     # slow cross-pod link (the regime gradient compression exists for).
     # 0 disables. See server/pacer.py and bench.py --mode throttled.
     dcn_throttle_mbps: float = 0.0
+    # Sharded-wire hierarchical DCN tier (BytePS "use every link", OSDI'20
+    # §hierarchical): the hybrid pipeline reduce-SCATTERs the pod instead
+    # of allreducing, assigns each partition an owner controller
+    # (rendezvous hash over the pod's controllers), and each owner
+    # pushes/pulls only its ~1/controllers slice through its own NIC; an
+    # all-gather tail reassembles before H2D. Results are bit-exact vs
+    # the unsharded path (raw) / at wire-codec roundoff (compressed) —
+    # pinned in tests/test_sharded_hybrid.py. Default on.
+    hybrid_sharded: bool = True
+    # Controller NICs the pod is modeled with (each its own PSWorker:
+    # connections, pacer, fault plan). 1 = the classic single-pusher
+    # wire. > 1 divides per-NIC DCN bytes by the count — the sharded
+    # race bench.py --mode hybrid measures. Deliberately its own knob
+    # (NOT BYTEPS_LOCAL_SIZE, which counts launcher-spawned processes).
+    pod_controllers: int = 1
+    # Salt of the partition→owner rendezvous hash (reshuffles placement
+    # without renaming tensors; must agree across a pod's controllers).
+    owner_salt: int = 0
 
     # --- robustness / chaos (docs/robustness.md) ---------------------------
     # Deterministic fault injection at the PSWorker wire boundary
@@ -190,6 +208,9 @@ class Config:
             log_level=_env_str("BYTEPS_LOG_LEVEL", "INFO").upper(),
             min_compress_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES", 65536),
             dcn_throttle_mbps=_env_float("BYTEPS_DCN_THROTTLE_MBPS", 0.0),
+            hybrid_sharded=_env_bool("BYTEPS_HYBRID_SHARDED", True),
+            pod_controllers=_env_int("BYTEPS_POD_CONTROLLERS", 1),
+            owner_salt=_env_int("BYTEPS_OWNER_SALT", 0),
             fault_spec=_env_str("BYTEPS_FAULT_SPEC", ""),
             fault_seed=_env_int("BYTEPS_FAULT_SEED", 0),
             retry_limit=_env_int("BYTEPS_RETRY_LIMIT", 8),

@@ -9,15 +9,16 @@ Framework adapters only convert tensors to/from flat numpy fp32.
 
 from __future__ import annotations
 
+import dataclasses
 import threading
-import zlib
-from typing import Optional
+from typing import Dict, List, Optional, Sequence, Set
 
 import numpy as np
 
 from byteps_tpu.common.config import get_config
-from byteps_tpu.common.logging import get_logger
-from byteps_tpu.common.partition import TensorRegistry
+from byteps_tpu.common.faults import FaultPlan, parse_fault_spec
+from byteps_tpu.common.logging import bps_check, get_logger
+from byteps_tpu.common.partition import OwnerTable, TensorRegistry
 from byteps_tpu.common.scheduler import (
     Handle,
     PartitionTask,
@@ -25,10 +26,67 @@ from byteps_tpu.common.scheduler import (
     Stage,
 )
 from byteps_tpu.common.tracing import get_tracer
-from byteps_tpu.compression.wire import Fp16Wire, WireCodec, WirePlan
-from byteps_tpu.server import NoLiveServersError, PSWorker
+from byteps_tpu.compression.wire import (
+    Fp16Wire,
+    WireCodec,
+    WirePlan,
+    wire_seed,
+)
+from byteps_tpu.server import (
+    FailedOverError,
+    NoLiveServersError,
+    PSWorker,
+    hand_off_owner,
+    retire_nic,
+)
 
 log = get_logger("dcn_adapter")
+
+
+def owner_wire_death(e: BaseException) -> bool:
+    """Classify a stage-level wire failure as the OWNER's NIC dying
+    (sharded-wire mode): a connection-class error that still escaped the
+    PSWorker retry engine means every wire attempt through that owner's
+    connections failed — the common element is the owner's NIC, so remap
+    its partitions to the surviving controllers. Server-side conditions
+    (failover in progress, no live servers, a server-down window that
+    outlasted the wire retry budget) are explicitly NOT owner deaths: the
+    existing health-monitor/failover/degraded paths own those.
+    ServerDownError names the SERVER as the culprit — remapping would let
+    one slow-to-detect server outage serially (and irreversibly) kill
+    every healthy controller routing at it; the stage retry it gets
+    instead rides out the window or the health monitor trips first.
+    TimeoutError and CRC-detected WireCorruption are excluded for the
+    same reason: a recv timeout blames a slow-but-alive SERVER at least
+    as plausibly as the local NIC (a dead NIC resurfaces as a
+    refused/reset reconnect, i.e. ConnectionError, on the next attempt),
+    corrupt payloads blame the server/path that produced them, and
+    failover is irreversible while a stage retry costs one backoff."""
+    from byteps_tpu.common.faults import ServerDownError
+
+    if isinstance(e, (NoLiveServersError, FailedOverError,
+                      ServerDownError)):
+        return False
+    return isinstance(e, ConnectionError)
+
+
+def remap_dead_owner(task, owner: int, owners, fail_owner, owner_of,
+                     cause: BaseException, verb: str):
+    """Shared owner-failover CLIENT policy (DcnCore and the jax hybrid
+    pipeline both route here): fail ``owner`` over — or piggyback on a
+    sibling task's earlier failover of the same rank, which ``fail_owner``
+    reports as False exactly like the last-controller case — and raise
+    the stage-retryable remap error so the re-run resolves a survivor.
+    Returns without raising only when no survivor exists (last
+    controller): the caller's degraded/terminal path decides."""
+    failed = fail_owner(owner, cause)
+    if failed or owner not in owners.live():
+        err = RuntimeError(
+            f"owner {owner} {verb} for {task.name}."
+            f"{task.partition.part_idx}; remapped — retrying via owner "
+            f"{owner_of(task.partition.key)}")
+        err.retryable = True
+        raise err from cause
 
 
 class DegradedLocal:
@@ -108,39 +166,131 @@ class DcnCore:
     credit ≥ 2 (default 4), and slow pulls never starve later pushes.
     """
 
-    def __init__(self, servers=None, worker_id=None) -> None:
+    def __init__(self, servers=None, worker_id=None,
+                 pod_controllers: Optional[int] = None,
+                 fault_specs: Optional[Sequence[Optional[str]]] = None,
+                 ) -> None:
+        """``pod_controllers`` > 1 turns on the sharded-wire hierarchical
+        mode (BytePS "use every link"): the pod is modeled as that many
+        controllers, each with its own PSWorker — its own connections,
+        pacer-emulated NIC, and fault plan — and each partition is
+        COMPRESSed/PUSHed/PULLed only by its rendezvous-hashed owner, so
+        per-NIC DCN bytes divide by the controller count. Default: the
+        config's BYTEPS_POD_CONTROLLERS when BYTEPS_HYBRID_SHARDED, else
+        1 (identical to the classic single-NIC core). ``fault_specs``
+        optionally arms a per-OWNER fault plan (chaos tests kill one
+        owner's NIC while its siblings stay healthy)."""
         cfg = get_config()
         self.cfg = cfg
-        self.worker = PSWorker(servers=servers, worker_id=worker_id)
+        if pod_controllers is None:
+            pod_controllers = (max(1, cfg.pod_controllers)
+                               if cfg.hybrid_sharded else 1)
+        plans: List[Optional[FaultPlan]] = [None] * pod_controllers
+        if fault_specs is not None:
+            bps_check(
+                len(fault_specs) == pod_controllers,
+                f"fault_specs needs one entry per controller "
+                f"(got {len(fault_specs)} for {pod_controllers})")
+            plans = [
+                FaultPlan(parse_fault_spec(s), seed=cfg.fault_seed,
+                          worker_id=o) if s else None
+                for o, s in enumerate(fault_specs)
+            ]
+        # All of a pod's controllers push under the POD's worker_id: the
+        # server sees one contribution per pod per round per key (from
+        # whichever controller owns it), and replay dedupe — which is
+        # keyed (worker, key, version) — survives an owner remap because
+        # the surviving controller adopts the round counters and re-sends
+        # under the same pod id (PSWorker.adopt_rounds).
+        self.workers: List[PSWorker] = [
+            PSWorker(servers=servers, worker_id=worker_id,
+                     fault_plan=plans[o])
+            for o in range(pod_controllers)
+        ]
+        self.worker = self.workers[0]  # back-compat accounting handle
+        self.owners = OwnerTable(pod_controllers, salt=cfg.owner_salt)
+        self._owner_lock = threading.Lock()
+        self.owner_failovers = 0
         self.registry = TensorRegistry()
         # PUSH/PULL are stage-retryable: the second line of defense above
         # PSWorker's wire retries — a mid-flight failover (FailedOverError)
         # re-runs the stage against the new placement with a fresh round
-        # number instead of failing the Handle.
+        # number instead of failing the Handle. Sharded pods scope credits
+        # per owner: each NIC gets its own in-flight bound, so one faulted
+        # owner backing off cannot starve its siblings' wires.
         self.scheduler = PipelineScheduler(
             stages=[
                 Stage("COMPRESS", self._compress_stage, credited=True,
                       pool_size=2),
+                # +1 attempt per extra controller: a total-DCN-outage
+                # walk-down spends one stage attempt failing each owner
+                # over before the last controller may degrade
                 Stage("PUSH", self._push_stage, credited=True, pool_size=4,
-                      releases_credit=True, retryable=True),
+                      releases_credit=True, retryable=True,
+                      max_attempts=2 + pod_controllers),
                 Stage("PULL", self._pull_stage, pool_size=4,
-                      retryable=True),
+                      retryable=True, max_attempts=2 + pod_controllers),
                 Stage("DECOMPRESS", self._decompress_stage, pool_size=2),
             ],
             credit=cfg.scheduling_credit,
             tracer=get_tracer(),
+            credit_scope="owner" if pod_controllers > 1 else "global",
         )
-        self._inited_keys = set()
+        # keys each OWNER has successfully init'ed on the servers: a new
+        # owner (post-failover) must re-run the idempotent init before
+        # its first push of an inherited key
+        self._inited_keys: Dict[int, Set[int]] = {
+            o: set() for o in range(pod_controllers)}
         self._key_lock = threading.Lock()
         self._versions = {}
         self.worker.barrier()
 
-    @staticmethod
-    def _wire_seed(name: str, version: int, part_idx: int) -> int:
-        """Deterministic per (tensor, round, partition) codec seed, agreed
-        across workers (same derivation as the jax hybrid pipeline)."""
-        base = zlib.crc32(name.encode()) & 0xFFFFFFFF
-        return (base * 1000003 + version * 8191 + part_idx) % (2 ** 63)
+    # -- sharded-wire ownership --------------------------------------------
+    def _owner_of(self, key: int) -> int:
+        return self.owners.owner(key)
+
+    def fail_owner(self, rank: int,
+                   cause: Optional[BaseException] = None) -> bool:
+        """Mark controller ``rank`` dead and remap its partitions to the
+        survivors (fence → export → adopt → shrink; the ordering argument
+        lives on :func:`byteps_tpu.server.hand_off_owner`). EF/momentum-style
+        per-owner state does not exist on this host core; the jax hybrid
+        pipeline resets its own on the matching event. Returns False if
+        already dead or it is the last controller (then the normal
+        degraded/no-live-servers machinery decides)."""
+        with self._owner_lock:
+            if hand_off_owner(self.workers, self.owners, rank) is None:
+                return False
+            self.owner_failovers += 1
+        if rank != 0:
+            # free the dead NIC (health monitor thread, connections,
+            # pacer) — nothing routes through it again. Worker 0 stays
+            # open, fenced: it alone may carry the pod's single kShutdown
+            # round at teardown (servers count one goodbye per pod). Its
+            # counters (the retries/injected faults that killed it) fold
+            # into the trace first — close() alone would drop them.
+            retire_nic(self.workers[rank], rank)
+        get_tracer().instant("owner_failover", "FAULT",
+                             {"owner": rank,
+                              "survivors": sorted(self.owners.live()),
+                              "cause": type(cause).__name__ if cause
+                              else None})
+        log.warning(
+            "pod controller %d gave up its wire (%s); its partitions "
+            "remap to owners %s", rank,
+            cause if cause is not None else "requested",
+            sorted(self.owners.live()))
+        return True
+
+    def _owner_giveup(self, task: PartitionTask, owner: int,
+                      e: BaseException):
+        """A retry-exhausted wire error through ``owner``'s NIC: fail the
+        owner over and turn the error into a stage-retryable one so the
+        scheduler re-runs the stage, which re-resolves to a survivor."""
+        if len(self.workers) > 1 and owner_wire_death(e):
+            remap_dead_owner(task, owner, self.owners, self.fail_owner,
+                             self._owner_of, e, "wire dead")
+        raise e
 
     # -- stages -------------------------------------------------------------
     def _compress_stage(self, task: PartitionTask):
@@ -159,15 +309,32 @@ class DcnCore:
             return chunk.view(np.uint8).ravel()
         return plan.codec.encode(
             chunk,
-            self._wire_seed(task.name, task.context["version"], p.part_idx),
+            wire_seed(task.name, task.context["version"], p.part_idx),
         )
 
     def _push_stage(self, task: PartitionTask):
         p = task.partition
-        if not self.worker.has_live_servers():
+        owner = self._owner_of(p.key)
+        worker = self.workers[owner]
+        if not worker.has_live_servers():
+            # THIS NIC sees zero live servers. Each PSWorker's health
+            # monitor pings through its own connections, so with sibling
+            # NICs alive this is indistinguishable from the OWNER's link
+            # dying — fail the owner over first (a sibling's view may be
+            # healthy; degrading here would silently turn this
+            # partition's result pod-LOCAL while other pods keep global
+            # sums). A genuine total outage walks the owners down to the
+            # last controller, which then degrades as before.
+            if len(self.workers) > 1:
+                remap_dead_owner(
+                    task, owner, self.owners, self.fail_owner,
+                    self._owner_of,
+                    NoLiveServersError(
+                        f"owner {owner} sees no live servers"),
+                    "lost all servers")
             # total DCN outage: degrade to the local contribution instead
             # of failing the handle (docs/robustness.md)
-            return degraded_fallback(self.worker, self.cfg, task, log,
+            return degraded_fallback(worker, self.cfg, task, log,
                                      "LOCAL sums")
         plan: Optional[WirePlan] = task.context["plans"][p.part_idx]
         store_bytes = (
@@ -175,22 +342,37 @@ class DcnCore:
             else p.length * 4
         )
         with self._key_lock:
-            needs_init = p.key not in self._inited_keys
+            needs_init = p.key not in self._inited_keys[owner]
+        try:
             if needs_init:
-                self._inited_keys.add(p.key)
-        if needs_init:
-            # no cross-worker barrier needed: server-side init is idempotent
-            # and never resets an existing store, so only THIS worker's init
-            # must precede its own push (serial on this connection)
-            self.worker.init_key(p.key, store_bytes)
-        codec_id = plan.codec.codec_id if plan is not None else 0
-        # pin the round across STAGE retries: a re-run whose first try's
-        # push WAS applied (wire budget exhausted on lost acks) must
-        # re-send the same version for the server dedupe to recognize it;
-        # push_bytes discards a pin that predates a failover reset
-        version = self.worker.push_bytes(
-            p.key, task.payload, codec_id,
-            version=getattr(task, "push_version", None))
+                # no cross-worker barrier needed: server-side init is
+                # idempotent and never resets an existing store, so only
+                # this owner's init must precede its own push (serial on
+                # its connection). Marked inited only AFTER success — a
+                # failed init retried at the stage level must re-run, not
+                # be skipped forever (two racing pushes of one key both
+                # initing is harmless, again by idempotence).
+                worker.init_key(p.key, store_bytes)
+                with self._key_lock:
+                    self._inited_keys[owner].add(p.key)
+            codec_id = plan.codec.codec_id if plan is not None else 0
+            # pin the round BEFORE the wire attempt (mint_version): a
+            # stage retry — including one re-routed to a surviving owner
+            # after a failover — must re-send the SAME round, whether the
+            # first try was applied (ack lost: the server dedupe drops
+            # the re-send) or never arrived (the server is still waiting
+            # for exactly this round). Minting inside push_bytes would
+            # lose the number when the attempt throws, and the retry's
+            # fresh mint would stall the server's round sequence forever.
+            # A pin predating a server-failover counter reset is
+            # discarded (fresh round against the new placement).
+            task.push_version = worker.mint_version(
+                p.key, getattr(task, "push_version", None))
+            version = worker.push_bytes(
+                p.key, task.payload, codec_id,
+                version=task.push_version)
+        except BaseException as e:  # noqa: BLE001 - owner-death classify
+            self._owner_giveup(task, owner, e)
         task.push_version = version
         return version
 
@@ -202,7 +384,12 @@ class DcnCore:
         capacity = (plan.pull_capacity(p.length) if plan is not None
                     else p.length * 4)
         codec_id = plan.pull_codec_id if plan is not None else 0
-        return self.worker.pull_bytes(p.key, capacity, task.payload, codec_id)
+        owner = self._owner_of(p.key)
+        try:
+            return self.workers[owner].pull_bytes(
+                p.key, capacity, task.payload, codec_id)
+        except BaseException as e:  # noqa: BLE001 - owner-death classify
+            self._owner_giveup(task, owner, e)
 
     def _decompress_stage(self, task: PartitionTask):
         """Wire decode of the pulled round result (reference DECOMPRESS),
@@ -210,8 +397,7 @@ class DcnCore:
         p = task.partition
         plan: Optional[WirePlan] = task.context["plans"][p.part_idx]
         buf = np.ascontiguousarray(task.payload)
-        seed = self._wire_seed(task.name, task.context["version"],
-                               p.part_idx)
+        seed = wire_seed(task.name, task.context["version"], p.part_idx)
         if plan is None:
             return buf.view(np.float32)
         if getattr(task, "degraded", False):
@@ -249,10 +435,12 @@ class DcnCore:
         shared = {"flat": flat, "plans": plans, "version": version}
         tasks = []
         for p in ctx.partitions:
-            if priority is not None:
-                p = type(p)(key=p.key, tensor_id=p.tensor_id,
-                            part_idx=p.part_idx, offset=p.offset,
-                            length=p.length, priority=priority)
+            # owner label = placement at enqueue time (credit-pool
+            # identity / trace attribution); live routing re-resolves per
+            # stage so a failover mid-flight moves the wire anyway
+            p = dataclasses.replace(
+                p, owner=self._owner_of(p.key),
+                **({"priority": priority} if priority is not None else {}))
             tasks.append(PartitionTask(partition=p, name=name, handle=handle,
                                        context=shared))
         self.scheduler.enqueue(tasks)
@@ -264,6 +452,17 @@ class DcnCore:
         parts = [results[i] for i in sorted(results)]
         return parts[0] if len(parts) == 1 else np.concatenate(parts)
 
+    def bytes_moved(self):
+        """(pushed, pulled) summed over every controller NIC."""
+        return (sum(w.bytes_pushed for w in self.workers),
+                sum(w.bytes_pulled for w in self.workers))
+
     def shutdown(self) -> None:
         self.scheduler.shutdown()
+        # one kShutdown round per pod, not per controller: servers count
+        # shutdowns against DMLC_NUM_WORKER and every controller shares
+        # the pod's worker id — the extra NICs retire (counters folded
+        # into the trace under a per-NIC tag, sockets dropped)
+        for rank, w in enumerate(self.workers[1:], start=1):
+            retire_nic(w, rank)
         self.worker.shutdown()

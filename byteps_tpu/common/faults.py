@@ -12,8 +12,10 @@ Spec grammar (semicolon-separated rules)::
 
     BYTEPS_FAULT_SPEC = rule (';' rule)*
     rule   = scope ':' kind ['@' cond (',' cond)*]
-    scope  = 'push' | 'pull' | 'all' | 'server<N>'
+    scope  = 'push' | 'pull' | 'init' | 'all' | 'server<N>'
              # push/pull/all match DATA-PLANE ops only ('all' = push+pull);
+             # 'init' matches key-init attempts only (kill = the init
+             # never reached the server; timeout = applied, ack lost);
              # server<N> matches every op against that server, including
              # init and the health monitor's pings
     kind   = 'timeout' | 'kill' | 'slow' | 'corrupt' | 'down'
@@ -90,7 +92,7 @@ class ServerDownError(ConnectionError):
 
 @dataclasses.dataclass(frozen=True)
 class FaultRule:
-    scope: str                 # 'push' | 'pull' | 'all' | 'server<N>'
+    scope: str                 # 'push' | 'pull' | 'init' | 'all' | 'server<N>'
     kind: str                  # one of KINDS
     p: Optional[float] = None  # per-op probability (None = always/window)
     window: Optional[Tuple[int, Optional[int]]] = None  # [a, b] op window
@@ -103,6 +105,9 @@ class FaultRule:
             # init, and the health monitor's pings (that is what lets a
             # 'down' window trip the monitor)
             if sidx != self.server:
+                return False
+        elif self.scope == "init":
+            if op != "init":
                 return False
         else:
             # push/pull/all scopes are DATA-PLANE only: loss specs must
@@ -147,7 +152,7 @@ def parse_fault_spec(spec: str) -> List[FaultRule]:
             server = None
             if scope.startswith("server"):
                 server = int(scope[len("server"):])
-            elif scope not in ("push", "pull", "all"):
+            elif scope not in ("push", "pull", "all", "init"):
                 raise ValueError(f"unknown fault scope {scope!r}")
             p = None
             window = None

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import zlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -54,6 +55,13 @@ class Partition:
     offset: int        # element offset into the flattened tensor
     length: int        # element count
     priority: int      # = -tensor_id (higher = schedule earlier)
+    # Sharded-wire hierarchical mode: the pod controller that carries this
+    # partition over the DCN (rendezvous hash over the pod's controllers,
+    # see OwnerTable). 0 — the only controller — everywhere else; the
+    # field is assigned at hash time and is a LABEL (credit-pool identity,
+    # trace attribution): live routing re-resolves through the OwnerTable
+    # so an owner failover moves the wire without rewriting tasks.
+    owner: int = 0
 
 
 @dataclasses.dataclass
@@ -169,6 +177,13 @@ class TensorRegistry:
         with self._lock:
             return self._by_name.get(name)
 
+    def snapshot(self) -> List[Tuple[str, TensorContext]]:
+        """Locked point-in-time view of every declared tensor — for
+        cross-tensor walks (owner failover's moved-partition diff) that
+        must not race declare()/repartition()."""
+        with self._lock:
+            return list(self._by_name.items())
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._by_name)
@@ -182,3 +197,63 @@ class TensorRegistry:
                 ctx.partitions = make_partitions(
                     ctx.tensor_id, nelem, ctx.dtype.itemsize, partition_bytes
                 )
+
+
+def owner_for_key(key: int, controllers, salt: int = 0) -> int:
+    """Deterministic partition→controller placement: rendezvous hash over
+    the given controller ranks (mirrors PSWorker._server_for_live's
+    key→server hash, so owner remap composes with server failover — both
+    layers move only the dead member's keys). zlib.crc32 is stable across
+    processes/runs, unlike salted hash(); ``salt`` (BYTEPS_OWNER_SALT)
+    lets a deployment reshuffle placement without renaming tensors."""
+    ranks = list(controllers)
+    bps_check(len(ranks) > 0, "owner_for_key: no live controllers")
+    if len(ranks) == 1:
+        return ranks[0]
+    return max(ranks,
+               key=lambda c: zlib.crc32(f"{key}:{c}:{salt}".encode()))
+
+
+class OwnerTable:
+    """Live-controller view for the sharded-wire hierarchical DCN tier.
+
+    One per pod-controller process. Each partition key is owned by exactly
+    one of the pod's ``n_controllers`` (rendezvous hash over the LIVE
+    set): the owner alone COMPRESSes, PUSHes and PULLs that partition
+    through its own NIC, dividing per-NIC DCN bytes by the live-controller
+    count. ``fail(rank)`` shrinks the live set — only the dead
+    controller's keys move (rendezvous property), exactly like PR3's
+    server-side key remap. Thread-safe; ``owner()`` is resolved at stage
+    execution time so a stage retry after a failover lands on the
+    survivor.
+    """
+
+    def __init__(self, n_controllers: int, salt: int = 0) -> None:
+        bps_check(n_controllers >= 1, "OwnerTable needs >= 1 controller")
+        self._lock = threading.Lock()
+        self._live = set(range(n_controllers))
+        self.n_controllers = n_controllers
+        self.salt = salt
+
+    def live(self):
+        with self._lock:
+            return set(self._live)
+
+    def owner(self, key: int) -> int:
+        with self._lock:
+            live = set(self._live)
+        return owner_for_key(key, live, self.salt)
+
+    def owner_in(self, key: int, live) -> int:
+        """Placement under an explicit live set (failover diffing)."""
+        return owner_for_key(key, live, self.salt)
+
+    def fail(self, rank: int) -> bool:
+        """Mark a controller dead; False if already dead. Refuses to kill
+        the last controller (the pod would have no wire at all — that is
+        the total-DCN-outage degraded path's job, not ours)."""
+        with self._lock:
+            if rank not in self._live or len(self._live) == 1:
+                return False
+            self._live.discard(rank)
+            return True

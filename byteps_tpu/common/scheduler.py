@@ -174,6 +174,10 @@ class PartitionTask:
     # every partition of a tensor, which would let partition 0's credit
     # cover its siblings (and a release refund a credit a sibling holds).
     holds_credit: bool = False
+    # The credit POOL this task's credit came from (owner-scoped credits):
+    # recorded at acquire time so the release refunds the same pool even
+    # if an owner failover re-routes the task's wire mid-flight.
+    credit_pool: int = 0
     # Tries consumed at the CURRENT stage (Stage.retryable); reset to 0
     # when the task advances, so each stage gets its own budget.
     stage_attempts: int = 0
@@ -200,6 +204,33 @@ class _StageQueue:
             return None
         return heapq.heappop(self._heap)[2]
 
+    def pop_ready(self, ready) -> Optional[PartitionTask]:
+        """Pop the highest-priority task satisfying ``ready``, skipping
+        blocked heads (owner-scoped credits: a drained owner's partition
+        at the head must not head-of-line-block a sibling owner whose
+        NIC still has credits). Skipped items keep their heap position.
+
+        Deliberately a linear scan past the blocked prefix (O(blocked ·
+        log n) per issue) rather than per-owner sub-heaps: readiness is
+        NOT uniform per owner — a mid-queue task may hold a credit from
+        an earlier credited stage, and an owner failover remaps
+        partitions while queued — so bucket heads alone can hide a ready
+        task. Partition counts are bounded (gradient_bytes /
+        partition_bytes, typically ≤ a few hundred) and the scan runs
+        only when the head is blocked; revisit if profiles ever show
+        this lock hot."""
+        skipped = []
+        got = None
+        while self._heap:
+            item = heapq.heappop(self._heap)
+            if ready(item[2]):
+                got = item[2]
+                break
+            skipped.append(item)
+        for it in skipped:
+            heapq.heappush(self._heap, it)
+        return got
+
     def peek(self) -> Optional[PartitionTask]:
         if not self._heap:
             return None
@@ -221,11 +252,23 @@ class PipelineScheduler:
         stages: Sequence[Stage],
         credit: int = 4,
         tracer: Optional[TraceRecorder] = None,
+        credit_scope: str = "global",
     ) -> None:
+        """``credit_scope="owner"`` gives each partition OWNER (the pod
+        controller whose NIC carries it in sharded-wire hybrid mode) its
+        own credit pool of ``credit``: the bound models per-NIC queue
+        depth, so one owner's slow/faulted wire backs off only its own
+        partitions instead of starving every sibling NIC of issue slots.
+        "global" (default) is the single shared pool (one NIC)."""
+        if credit_scope not in ("global", "owner"):
+            raise ValueError(f"unknown credit_scope {credit_scope!r}")
         self.stages = list(stages)
         self._queues = [_StageQueue() for _ in self.stages]
         self._credit_total = max(1, credit)
+        self._credit_scope = credit_scope
         self._credits = self._credit_total
+        # owner scope: pool id -> available credits, created on first use
+        self._owner_credits: Dict[int, int] = {}
         self._lock = threading.Lock()
         self._tracer = tracer
         self._pools: List[ThreadPoolExecutor] = [
@@ -255,7 +298,47 @@ class PipelineScheduler:
             delta = max(1, credit) - self._credit_total
             self._credit_total = max(1, credit)
             self._credits += delta
+            for pool in self._owner_credits:
+                self._owner_credits[pool] += delta
         self._pump()
+
+    # -- credit accounting (call with self._lock held) ----------------------
+    def _credit_available(self, task: PartitionTask) -> bool:
+        if self._credit_scope == "global":
+            return self._credits > 0
+        return self._owner_credits.get(
+            task.partition.owner, self._credit_total) > 0
+
+    def _acquire_credit_locked(self, task: PartitionTask) -> None:
+        task.holds_credit = True
+        if self._credit_scope == "global":
+            task.credit_pool = 0
+            self._credits -= 1
+            return
+        pool = task.partition.owner
+        task.credit_pool = pool
+        self._owner_credits[pool] = self._owner_credits.get(
+            pool, self._credit_total) - 1
+
+    def _release_credit_locked(self, task: PartitionTask) -> None:
+        if not task.holds_credit:
+            return
+        task.holds_credit = False
+        if self._credit_scope == "global":
+            self._credits = min(self._credits + 1, self._credit_total)
+            return
+        pool = task.credit_pool
+        self._owner_credits[pool] = min(
+            self._owner_credits.get(pool, self._credit_total) + 1,
+            self._credit_total)
+
+    def credit_pools(self) -> Dict[int, int]:
+        """Snapshot of available credits per pool (leak assertions): the
+        global pool is key 0; owner scope reports every pool touched."""
+        with self._lock:
+            if self._credit_scope == "global":
+                return {0: self._credits}
+            return dict(self._owner_credits)
 
     def drain(self, timeout: Optional[float] = None) -> None:
         with self._idle:
@@ -284,10 +367,7 @@ class PipelineScheduler:
                     if t is None:
                         break
                     stranded.append(t)
-                    if t.holds_credit:
-                        t.holds_credit = False
-                        self._credits = min(self._credits + 1,
-                                            self._credit_total)
+                    self._release_credit_locked(t)
             self._inflight -= len(stranded)
         err = RuntimeError("PipelineScheduler is shut down")
         for t in stranded:
@@ -315,14 +395,23 @@ class PipelineScheduler:
                     # lifetime (reference: credit held from PUSH until the
                     # partition completes); one already holding a credit
                     # passes later credited stages freely.
-                    head = q.peek()
-                    needs_credit = stage.credited and not head.holds_credit
-                    if needs_credit and self._credits <= 0:
-                        continue
-                    task = q.pop()
-                    if needs_credit:
-                        self._credits -= 1
-                        task.holds_credit = True
+                    if stage.credited and self._credit_scope == "owner":
+                        task = q.pop_ready(
+                            lambda t: t.holds_credit
+                            or self._credit_available(t))
+                        if task is None:
+                            continue
+                        if not task.holds_credit:
+                            self._acquire_credit_locked(task)
+                    else:
+                        head = q.peek()
+                        needs_credit = (stage.credited
+                                        and not head.holds_credit)
+                        if needs_credit and not self._credit_available(head):
+                            continue
+                        task = q.pop()
+                        if needs_credit:
+                            self._acquire_credit_locked(task)
                     self._busy[si] += 1
                     issued = (si, task)
                     break
@@ -386,20 +475,17 @@ class PipelineScheduler:
             )
         with self._lock:
             self._busy[si] -= 1
-            if (failed is None and stage.releases_credit
-                    and task.holds_credit):
+            if failed is None and stage.releases_credit:
                 # wire-scoped credit: frees on stage exit so the next
                 # partition's push can start while this one drains the
                 # rest of the pipeline (_finish's release is then a no-op)
-                task.holds_credit = False
-                self._credits = min(self._credits + 1, self._credit_total)
-            elif retrying and task.holds_credit:
+                self._release_credit_locked(task)
+            elif retrying:
                 # about to back off: a sleeping task must not keep a
                 # credit out of the pool (it would starve healthy
                 # siblings of the wire). The retry re-acquires through
                 # the normal credited-stage gate when it is re-issued.
-                task.holds_credit = False
-                self._credits = min(self._credits + 1, self._credit_total)
+                self._release_credit_locked(task)
         if retrying:
             task.stage_attempts += 1
             delay = stage.retry_backoff_s * (2 ** (task.stage_attempts - 1))
@@ -455,9 +541,7 @@ class PipelineScheduler:
     def _finish(self, task: PartitionTask, error: Optional[BaseException] = None) -> None:
         """Reference analog: FinishOrProceed's terminal arm."""
         with self._lock:
-            if task.holds_credit:
-                task.holds_credit = False
-                self._credits = min(self._credits + 1, self._credit_total)
+            self._release_credit_locked(task)
             self._inflight -= 1
         if error is not None:
             task.handle._partition_failed(error, task.partition.part_idx)

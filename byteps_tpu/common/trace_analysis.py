@@ -36,7 +36,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 # unknown stages sort after, alphabetically.
 _STAGE_ORDER = [
     "REDUCE", "COPYD2H", "COMPRESS", "PUSH", "PULL",
-    "DECOMPRESS", "COPYH2D", "PUSHPULL",
+    "DECOMPRESS", "COPYH2D", "ALLGATHER", "PUSHPULL",
     "PUSH_RECV", "SUM", "PULL_RESP", "ROUND",
 ]
 

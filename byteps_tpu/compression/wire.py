@@ -17,11 +17,28 @@ caller-supplied integer seed so every pod agrees where it must.
 from __future__ import annotations
 
 import dataclasses
+import zlib
 from typing import Optional
 
 import numpy as np
 
 from byteps_tpu.compression.error_feedback import CompressionSpec
+
+
+def wire_seed(name: str, version: int, part_idx: int, salt: int = 0) -> int:
+    """THE deterministic per-(tensor, round, partition) codec seed.
+
+    Every party that encodes or decodes a given partition round — the jax
+    hybrid COMPRESS/DECOMPRESS stages on every pod, DcnCore's host
+    stages, and (positionally) the summation server — must draw stochastic
+    codec choices (randomk support, dithering rounding) from the SAME
+    seed, or payloads stop being summable. This is the single definition
+    of that contract (it used to live twice, computing different seeds);
+    ``salt`` carries a CompressionSpec's user seed where one exists.
+    zlib.crc32 is stable across processes/runs, unlike salted hash().
+    """
+    base = zlib.crc32(name.encode()) & 0xFFFFFFFF
+    return (base * 1000003 + version * 8191 + part_idx + salt) % (2 ** 63)
 
 # Codec ids — must match server/csrc/codec.h Codec enum.
 WIRE_RAW = 0

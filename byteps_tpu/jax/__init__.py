@@ -35,8 +35,9 @@ from byteps_tpu.common.jax_compat import ensure as _ensure_jax_compat
 
 _ensure_jax_compat()
 
+import dataclasses
 import threading
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -44,7 +45,7 @@ import numpy as np
 
 from byteps_tpu.common.config import Config, get_config
 from byteps_tpu.common.logging import bps_check, get_logger
-from byteps_tpu.common.partition import TensorRegistry
+from byteps_tpu.common.partition import OwnerTable, TensorRegistry
 from byteps_tpu.common.scheduler import (
     Handle,
     PartitionTask,
@@ -53,9 +54,11 @@ from byteps_tpu.common.scheduler import (
 )
 from byteps_tpu.common.tracing import get_tracer
 from byteps_tpu.comm.ici import (
+    all_gather_flat,
     allreduce_flat,
     broadcast_flat,
     compressed_allreduce_flat,
+    reduce_scatter_flat,
 )
 from byteps_tpu.comm.mesh import device_mesh
 from byteps_tpu.compression import from_params
@@ -87,9 +90,27 @@ class _BytePSJaxState:
         self.base_rng = None
         self.anon_counter = 0
         self.lock = threading.Lock()
+        # Serializes ICI collective DISPATCH across stage pool threads:
+        # XLA launches collective programs in dispatch order per device,
+        # so two host threads dispatching (reduce-scatter from REDUCE,
+        # all-gather from ALLGATHER) concurrently can enqueue them in
+        # different orders on different devices — a rendezvous deadlock
+        # (observed on the CPU backend, same hazard on TPU). Dispatch is
+        # async; only the enqueue order is pinned.
+        self.ici_lock = threading.Lock()
         self.tuner = None
         self.psworker = None        # DCN tier client (distributed mode)
-        self.inited_keys = set()
+        # sharded-wire hierarchical mode: one PSWorker per pod controller
+        # (psworker aliases psworkers[0]); owners maps partition keys to
+        # the controller whose NIC carries them
+        self.psworkers: List[Any] = []
+        self.owners: Optional[OwnerTable] = None
+        self.owner_failovers = 0
+        # bumped (under lock) by _fail_owner's EF/momentum reset; a
+        # COMPRESS that read its state before the bump must not write the
+        # stale residual back after it (see _compress_stage)
+        self.failover_gen = 0
+        self.inited_keys = set()   # {(owner, key)} successfully init'ed
 
 
 _state = _BytePSJaxState()
@@ -109,10 +130,24 @@ def init(
     if _state.initialized:
         return
     cfg = get_config()
-    _state.cfg = cfg
     from byteps_tpu.comm.distributed import maybe_init_distributed
 
     maybe_init_distributed(cfg)
+    from byteps_tpu.comm.distributed import is_multiprocess
+
+    if cfg.hybrid_sharded and is_multiprocess():
+        # The sharded graph's COPYD2H/COPYH2D move per-device SEGMENTS of
+        # the reduce-scattered array; in a multi-process global mesh those
+        # segments span non-addressable devices and jax.device_get would
+        # throw on every push_pull. The dataflow needs per-process
+        # addressable-shard plumbing (future work) — until then the
+        # classic graph (full allreduce, controller 0's NIC) is the
+        # correct multi-process hybrid.
+        log.warning(
+            "BYTEPS_HYBRID_SHARDED is not yet supported in multi-process "
+            "global-mesh mode; falling back to the unsharded hybrid graph")
+        cfg = dataclasses.replace(cfg, hybrid_sharded=False)
+    _state.cfg = cfg
     _state.mesh = mesh if mesh is not None else device_mesh()
     _state.registry = TensorRegistry()
     _state.spec = from_params(compression_params)
@@ -128,9 +163,22 @@ def init(
         # decompress→fp32-sum→recompress (SURVEY §2.2/§3.3). Only this
         # controller pushes the pod-sum per partition, which is what makes
         # the hybrid topology bandwidth-optimal (SURVEY §5.8).
+        # Sharded-wire hierarchical tier (BYTEPS_HYBRID_SHARDED, default
+        # on): REDUCE becomes an ICI reduce-SCATTER, each partition is
+        # owned by one of the pod's BYTEPS_POD_CONTROLLERS controllers
+        # (rendezvous hash) whose own NIC carries it over DCN — per-NIC
+        # wire bytes divide by the controller count instead of H−1 NICs
+        # idling — and an ALLGATHER tail reassembles the global sums
+        # across the pod. Each controller is modeled by its own PSWorker
+        # (own connections, pacer NIC, fault plan); with 1 controller the
+        # graph is the same wire as before plus the scatter/gather pair,
+        # pinned bit-exact against the unsharded path.
         from byteps_tpu.server import PSWorker
 
-        _state.psworker = PSWorker()
+        n_ctl = max(1, cfg.pod_controllers) if cfg.hybrid_sharded else 1
+        _state.psworkers = [PSWorker() for _ in range(n_ctl)]
+        _state.psworker = _state.psworkers[0]
+        _state.owners = OwnerTable(n_ctl, salt=cfg.owner_salt)
         if cfg.trace_on:
             # measure server_clock − local_clock per server (kPing RTT/2)
             # so merge_traces can align EVERY server's rows, not just
@@ -152,21 +200,32 @@ def init(
         # PUSH/PULL are stage-retryable (chaos hardening): a mid-flight
         # failover re-runs the stage against the new server placement
         # instead of failing the Handle (docs/robustness.md).
+        stages = [
+            Stage("REDUCE", _reduce_stage, pool_size=1),
+            Stage("COPYD2H", _d2h_stage, pool_size=2),
+            Stage("COMPRESS", _compress_stage, credited=True,
+                  pool_size=2),
+            # +1 attempt per extra controller: a total-DCN-outage
+            # walk-down spends one stage attempt failing each owner over
+            # before the last controller may degrade
+            Stage("PUSH", _dcn_push_stage, credited=True, pool_size=4,
+                  releases_credit=True, retryable=True,
+                  max_attempts=2 + n_ctl),
+            Stage("PULL", _dcn_pull_stage, pool_size=4,
+                  retryable=True, max_attempts=2 + n_ctl),
+            Stage("DECOMPRESS", _decompress_stage, pool_size=2),
+            Stage("COPYH2D", _h2d_stage, pool_size=2),
+        ]
+        if cfg.hybrid_sharded:
+            # the hierarchical tail: H2D placed the pulled global sums as
+            # per-device segments; the ICI all-gather replicates them
+            # (reference BROADCAST after COPYH2D)
+            stages.append(Stage("ALLGATHER", _allgather_stage, pool_size=2))
         _state.scheduler = PipelineScheduler(
-            stages=[
-                Stage("REDUCE", _reduce_stage, pool_size=1),
-                Stage("COPYD2H", _d2h_stage, pool_size=2),
-                Stage("COMPRESS", _compress_stage, credited=True,
-                      pool_size=2),
-                Stage("PUSH", _dcn_push_stage, credited=True, pool_size=4,
-                      releases_credit=True, retryable=True),
-                Stage("PULL", _dcn_pull_stage, pool_size=4,
-                      retryable=True),
-                Stage("DECOMPRESS", _decompress_stage, pool_size=2),
-                Stage("COPYH2D", _h2d_stage, pool_size=2),
-            ],
+            stages=stages,
             credit=cfg.scheduling_credit,
             tracer=tracer,
+            credit_scope="owner" if n_ctl > 1 else "global",
         )
     else:
         # Eager ICI pipeline: PUSHPULL issues the jitted chunk collective
@@ -237,8 +296,17 @@ def shutdown() -> None:
     if _state.scheduler is not None:
         _state.scheduler.shutdown()
     if _state.psworker is not None:
+        # one kShutdown round per pod (servers count pods, and all of a
+        # pod's controller NICs share its worker id); extra NICs retire
+        # (counters folded into the trace under a per-NIC tag)
+        from byteps_tpu.server import retire_nic
+
+        for rank, w in enumerate(_state.psworkers[1:], start=1):
+            retire_nic(w, rank)
         _state.psworker.shutdown()
         _state.psworker = None
+        _state.psworkers = []
+        _state.owners = None
     tracer = get_tracer()
     if tracer.enabled:
         # after the pipeline stops so late stage events are included; runs
@@ -367,34 +435,60 @@ def _sync_stage(task: PartitionTask):
 
 # --- hybrid (distributed) pipeline stages -----------------------------------
 def _reduce_stage(task: PartitionTask):
-    """Intra-pod ICI sum of this chunk (async dispatch; reference REDUCE)."""
+    """Intra-pod ICI sum of this chunk (async dispatch; reference REDUCE).
+
+    Sharded-wire mode reduce-SCATTERs instead: each device ends up
+    holding its segment of the pod sum — half the ICI bytes of a full
+    allreduce (the ALLGATHER tail pays the other half AFTER the DCN round
+    trip, reassembling the *global* sums), and on a multi-host pod each
+    controller then only d2h's its own segments."""
     x = task.context["x2d"]
     p = task.partition
     chunk = jax.lax.slice_in_dim(x, p.offset, p.offset + p.length, axis=1)
-    return allreduce_flat(chunk, _state.mesh, _state.cfg.dp_axis,
-                          average=False)
+    with _state.ici_lock:
+        if _state.cfg.hybrid_sharded:
+            return reduce_scatter_flat(chunk, _state.mesh,
+                                       _state.cfg.dp_axis)
+        return allreduce_flat(chunk, _state.mesh, _state.cfg.dp_axis,
+                              average=False)
 
 
 def _d2h_stage(task: PartitionTask):
     """Device→host for the DCN wire (reference COPYD2H; pool threads give
-    the double-buffering the reference gets from pinned shm)."""
-    return np.asarray(task.payload, dtype=np.float32)
+    the double-buffering the reference gets from pinned shm).
+
+    ``jax.device_get`` instead of ``np.asarray(..., dtype=np.float32)``:
+    on a CPU-backed buffer the old spelling could cast-copy a second
+    time; device_get hands back the transferred (or zero-copy host) f32
+    buffer directly. The scattered REDUCE output may be padded to
+    n·ceil(L/n) — trim to the partition. Contract (pinned in
+    tests/test_sharded_hybrid.py): f32 and C-contiguous always; writable
+    whenever EF/momentum are configured, so the COMPRESS stage's state
+    arithmetic may mutate in place — a read-only zero-copy view is only
+    ever returned on the stateless path."""
+    out = jax.device_get(task.payload)
+    out = out.reshape(-1)[: task.partition.length]
+    spec = task.context["spec"]
+    needs_write = spec.enabled and (spec.ef or spec.momentum)
+    if (out.dtype != np.float32 or not out.flags.c_contiguous
+            or (needs_write and not out.flags.writeable)):
+        out = np.ascontiguousarray(out, dtype=np.float32)
+        if needs_write and not out.flags.writeable:
+            out = out.copy()
+    return out
 
 
 def _wire_seed(task: PartitionTask) -> int:
     """Deterministic per (tensor, version, partition) seed shared by the
     COMPRESS and DECOMPRESS stages on every pod — the reference's
-    synchronized compressor PRNG (randomk index agreement, dithering)."""
-    import zlib
+    synchronized compressor PRNG (randomk index agreement, dithering).
+    One definition for every path: compression/wire.py wire_seed (the
+    host DcnCore derives the same seed at salt 0)."""
+    from byteps_tpu.compression.wire import wire_seed
 
-    base = zlib.crc32(task.name.encode()) & 0xFFFFFFFF
-    spec = task.context["spec"]
-    return (
-        base * 1000003
-        + task.context["version"] * 8191
-        + task.partition.part_idx
-        + spec.seed
-    ) % (2**63)
+    return wire_seed(task.name, task.context["version"],
+                     task.partition.part_idx,
+                     salt=task.context["spec"].seed)
 
 
 def _compress_stage(task: PartitionTask):
@@ -409,13 +503,21 @@ def _compress_stage(task: PartitionTask):
     spec = task.context["spec"]
     seed = _wire_seed(task)
     skey = (task.name, p.part_idx)
+    # _fail_owner resets EF/momentum for partitions whose owner moved; a
+    # compress that read its buffers BEFORE that reset must not write them
+    # back after it (the stale residual would silently resurrect). Writes
+    # are dropped if the generation moved between read and write-back —
+    # losing one best-effort residual update beats racing the reset.
+    gen = _state.failover_gen
     if spec.momentum:
         m = _state.mom_state.get(skey)
         if m is None:
             m = np.zeros_like(x)
         m_new = spec.mu * m + x
         x = x + spec.mu * m_new
-        _state.mom_state[skey] = m_new
+        with _state.lock:
+            if _state.failover_gen == gen:
+                _state.mom_state[skey] = m_new
     if spec.ef:
         e = _state.ef_state.get(skey)
         if e is None:
@@ -423,14 +525,94 @@ def _compress_stage(task: PartitionTask):
         corrected = x + e
         payload = plan.codec.encode(corrected, seed)
         approx = plan.codec.decode(payload, x.size, seed)
-        _state.ef_state[skey] = corrected - approx
+        with _state.lock:
+            if _state.failover_gen == gen:
+                _state.ef_state[skey] = corrected - approx
         return payload
     return plan.codec.encode(x, seed)
 
 
+def _owner_of(key: int) -> int:
+    return _state.owners.owner(key) if _state.owners is not None else 0
+
+
+def _fail_owner(rank: int, cause: Optional[BaseException] = None) -> bool:
+    """Jax-side owner failover (mirrors DcnCore.fail_owner; the shared
+    fence → export → adopt → shrink critical section is
+    :func:`byteps_tpu.server.hand_off_owner`), then reset EF/momentum
+    state for every partition whose owner moved — per-owner compressor
+    state does not migrate off a dead controller; the residual restarts
+    from zero with the remap, exactly like a PR3 key remap."""
+    from byteps_tpu.server import hand_off_owner
+
+    with _state.lock:
+        live = hand_off_owner(_state.psworkers, _state.owners, rank)
+        if live is None:
+            return False
+        new_live = _state.owners.live()
+        moved = set()
+        for name, ctx in _state.registry.snapshot():
+            for part in ctx.partitions:
+                if _state.owners.owner_in(part.key, live) == rank:
+                    moved.add((name, part.part_idx))
+        for skey in moved:
+            _state.ef_state.pop(skey, None)
+            _state.mom_state.pop(skey, None)
+        # invalidate write-backs from any COMPRESS that read its state
+        # before this reset (see _compress_stage)
+        _state.failover_gen += 1
+        _state.owner_failovers += 1
+    if rank != 0:
+        # free the dead NIC (monitor thread, connections, pacer) — worker
+        # 0 stays open, fenced: it carries the pod's kShutdown round. The
+        # dead NIC's counters (the faults that killed it) fold into the
+        # trace first — close() alone would drop them.
+        from byteps_tpu.server import retire_nic
+
+        retire_nic(_state.psworkers[rank], rank)
+    get_tracer().instant("owner_failover", "FAULT",
+                         {"owner": rank, "survivors": sorted(new_live),
+                          "cause": type(cause).__name__ if cause else None})
+    log.warning(
+        "pod controller %d gave up its wire (%s); %d partition state "
+        "buffer(s) reset, partitions remap to owners %s", rank,
+        cause if cause is not None else "requested", len(moved),
+        sorted(new_live))
+    return True
+
+
+def _owner_giveup(task: PartitionTask, owner: int, e: BaseException):
+    """Retry-exhausted wire error through ``owner``'s NIC: fail it over
+    and re-raise stage-retryably so the re-run lands on a survivor."""
+    from byteps_tpu.common.dcn_adapter import (
+        owner_wire_death,
+        remap_dead_owner,
+    )
+
+    if len(_state.psworkers) > 1 and owner_wire_death(e):
+        remap_dead_owner(task, owner, _state.owners, _fail_owner,
+                         _owner_of, e, "wire dead")
+    raise e
+
+
 def _dcn_push_stage(task: PartitionTask):
     p = task.partition
-    if not _state.psworker.has_live_servers():
+    owner = _owner_of(p.key)
+    worker = _state.psworkers[owner]
+    if not worker.has_live_servers():
+        # THIS NIC sees zero live servers — with sibling NICs alive that
+        # is the OWNER's link dying (per-PSWorker health monitors ping
+        # through their own connections), so fail the owner over before
+        # degrading; a genuine total outage walks down to the last
+        # controller, which degrades as before.
+        from byteps_tpu.common.dcn_adapter import remap_dead_owner
+        from byteps_tpu.server import NoLiveServersError
+
+        if len(_state.psworkers) > 1:
+            remap_dead_owner(
+                task, owner, _state.owners, _fail_owner, _owner_of,
+                NoLiveServersError(f"owner {owner} sees no live servers"),
+                "lost all servers")
         # total DCN outage: the payload is already the pod's pure-ICI sum
         # (REDUCE stage), so degrade to it instead of failing the handle —
         # cross-pod aggregation is lost, intra-pod training continues
@@ -438,7 +620,7 @@ def _dcn_push_stage(task: PartitionTask):
         from byteps_tpu.common.dcn_adapter import degraded_fallback
 
         return degraded_fallback(
-            _state.psworker, _state.cfg, task, log,
+            worker, _state.cfg, task, log,
             "the pure-ICI (pod-local) allreduce")
     plan = task.context["plans"][p.part_idx]
     store_bytes = (
@@ -446,17 +628,28 @@ def _dcn_push_stage(task: PartitionTask):
         else p.length * 4
     )
     with _state.lock:
-        needs_init = p.key not in _state.inited_keys
+        needs_init = (owner, p.key) not in _state.inited_keys
+    try:
         if needs_init:
-            _state.inited_keys.add(p.key)
-    if needs_init:
-        _state.psworker.init_key(p.key, store_bytes)
-    codec_id = plan.codec.codec_id if plan is not None else 0
-    # pin the round across stage retries (see DcnCore._push_stage): a
-    # re-run re-sends the SAME version so the server dedupe recognizes it
-    version = _state.psworker.push_bytes(
-        p.key, task.payload, codec_id,
-        version=getattr(task, "push_version", None))
+            # marked inited only AFTER success: a failed init whose stage
+            # retries must re-run it, not be skipped forever (every later
+            # push would then hit an uninitialized server key); two racing
+            # pushes both initing is harmless — server init is idempotent
+            worker.init_key(p.key, store_bytes)
+            with _state.lock:
+                _state.inited_keys.add((owner, p.key))
+        codec_id = plan.codec.codec_id if plan is not None else 0
+        # pin the round BEFORE the wire attempt (see DcnCore._push_stage
+        # for the full why): a stage retry — possibly via a surviving
+        # owner after a failover — re-sends the SAME round, which the
+        # server either sums (never arrived) or dedupes (ack lost)
+        task.push_version = worker.mint_version(
+            p.key, getattr(task, "push_version", None))
+        version = worker.push_bytes(
+            p.key, task.payload, codec_id,
+            version=task.push_version)
+    except BaseException as e:  # noqa: BLE001 - owner-death classify
+        _owner_giveup(task, owner, e)
     task.push_version = version
     return version
 
@@ -468,14 +661,17 @@ def _dcn_pull_stage(task: PartitionTask):
     if isinstance(task.payload, DegradedLocal):
         return task.payload.payload
     plan = task.context["plans"][p.part_idx]
-    if plan is None:
-        return _state.psworker.pull_bytes(
-            p.key, p.length * 4, task.payload, 0
+    owner = _owner_of(p.key)
+    worker = _state.psworkers[owner]
+    try:
+        if plan is None:
+            return worker.pull_bytes(p.key, p.length * 4, task.payload, 0)
+        return worker.pull_bytes(
+            p.key, plan.pull_capacity(p.length), task.payload,
+            plan.pull_codec_id,
         )
-    return _state.psworker.pull_bytes(
-        p.key, plan.pull_capacity(p.length), task.payload,
-        plan.pull_codec_id,
-    )
+    except BaseException as e:  # noqa: BLE001 - owner-death classify
+        _owner_giveup(task, owner, e)
 
 
 def _decompress_stage(task: PartitionTask):
@@ -494,8 +690,7 @@ def _decompress_stage(task: PartitionTask):
                             _wire_seed(task))
 
 
-def _h2d_stage(task: PartitionTask):
-    out = jnp.asarray(task.payload)
+def _average_h2d(task: PartitionTask, out: jnp.ndarray) -> jnp.ndarray:
     if task.context["average"]:
         if getattr(task, "degraded", False):
             # pod average: an unbiased estimate of the global average
@@ -505,6 +700,40 @@ def _h2d_stage(task: PartitionTask):
         else:
             out = out / size()  # global worker-device count
     return out
+
+
+def _h2d_stage(task: PartitionTask):
+    """Host→device of the pulled global sum (reference COPYH2D).
+
+    Sharded-wire mode places it as per-device SEGMENTS over the dp axis —
+    each device receives ~1/n of the partition over PCIe — and the
+    ALLGATHER tail stage replicates them over ICI (the reference's
+    BROADCAST). Unsharded keeps the replicated put + averaging here."""
+    if not _state.cfg.hybrid_sharded:
+        return _average_h2d(task, jnp.asarray(task.payload))
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = pod_size()
+    L = task.partition.length
+    seg = -(-L // n)
+    host = np.asarray(task.payload, dtype=np.float32)
+    if seg * n != L:
+        host = np.pad(host, (0, seg * n - L))
+    sh = NamedSharding(_state.mesh, P(_state.cfg.dp_axis))
+    return jax.device_put(host, sh)
+
+
+def _allgather_stage(task: PartitionTask):
+    """Sharded-wire tail: replicate the per-device segments across the
+    pod (exact — a gather moves bits, never sums) and apply the
+    averaging scale the unsharded graph applies at H2D."""
+    with _state.ici_lock:  # pin collective dispatch order (see ici_lock)
+        out = all_gather_flat(task.payload, _state.mesh,
+                              _state.cfg.dp_axis,
+                              length=task.partition.length)
+    # averaging is elementwise — no collective, so dispatch it outside
+    # the lock rather than serializing against REDUCE's dispatch
+    return _average_h2d(task, out)
 
 
 def push_pull_async(
@@ -629,11 +858,15 @@ def push_pull_async(
     }
     tasks = []
     for p in ctx.partitions:
+        overrides: Dict[str, Any] = {}
         if priority is not None:
-            p = type(p)(  # override declaration-order priority if given
-                key=p.key, tensor_id=p.tensor_id, part_idx=p.part_idx,
-                offset=p.offset, length=p.length, priority=priority,
-            )
+            overrides["priority"] = priority  # override declaration order
+        if _state.owners is not None:
+            # owner label = placement at enqueue time (credit-pool
+            # identity / trace attribution); stages re-resolve live
+            overrides["owner"] = _state.owners.owner(p.key)
+        if overrides:
+            p = dataclasses.replace(p, **overrides)
         tasks.append(
             PartitionTask(partition=p, name=name, handle=handle, context=shared)
         )

@@ -50,7 +50,8 @@ from byteps_tpu.server.pacer import DcnPacer, pacer_from_mbps
 log = get_logger("server")
 
 __all__ = [
-    "start_server", "stop_server", "serve_forever", "server_addresses",
+    "start_server", "start_server_any_port", "stop_server",
+    "serve_forever", "server_addresses",
     "PSWorker", "reduce_sum_f32", "DcnPacer", "FailedOverError",
     "NoLiveServersError", "WireCorruption", "wire_crc32",
 ]
@@ -76,6 +77,39 @@ class NoLiveServersError(ConnectionError):
     budget (re-sending cannot help), but deliberately stage-retryable: the
     re-run of the PUSH stage takes the degraded pure-ICI branch when
     BYTEPS_DEGRADED_OK, else fails the handle."""
+
+
+def hand_off_owner(workers, owners, rank: int):
+    """The owner-failover handoff critical section — ONE definition shared
+    by the jax hybrid pipeline and DcnCore (the caller holds its own pod
+    lock around this). Fences the dying controller's worker so no round
+    can be minted past the snapshot, hands its round counters / store
+    sizes to every survivor, then shrinks the live set — in that order:
+    fence-before-export closes the mint race, export-before-fail keeps a
+    racing stage retry from minting a round at/below the server's replay
+    watermark (the PR3 atomicity argument). Returns the PRE-fail live set
+    (callers diff it to find which partitions moved), or None if ``rank``
+    is already dead or the last controller."""
+    live = owners.live()
+    if rank not in live or len(live) <= 1:
+        return None
+    workers[rank].fence()
+    versions, nbytes = workers[rank].export_rounds()
+    for r in sorted(live - {rank}):
+        workers[r].adopt_rounds(versions, nbytes)
+    owners.fail(rank)
+    return live
+
+
+def retire_nic(worker, rank: int) -> None:
+    """Free an EXTRA pod-controller NIC (owner failover or pod shutdown):
+    fold its robustness counters into the trace first — tagged per-NIC,
+    since every controller shares the pod's worker id — then close it
+    (health monitor thread, connections, pacer). NIC 0 never retires this
+    way: it alone carries the pod's single kShutdown round, so it goes
+    through ``PSWorker.shutdown``."""
+    worker.export_counters(f"worker{worker._worker_id}.nic{rank}")
+    worker.close()
 
 
 def _is_retryable_wire_error(e: BaseException) -> bool:
@@ -149,6 +183,26 @@ def stop_server() -> None:
     _INPROC_SERVER_ID = None
 
 
+def start_server_any_port(port: int, attempts: int = 16, stride: int = 1,
+                          **kw) -> int:
+    """``start_server``, sidestepping ephemeral-port squatters: when the
+    OS ip_local_port_range overlaps the chosen port (this image's starts
+    at 16000), any client socket can be sitting on it and the bind fails
+    rc=-2. Probes ``attempts`` ports ``stride`` apart and returns the
+    port actually bound; any other bind error propagates."""
+    last: Optional[RuntimeError] = None
+    for i in range(attempts):
+        p = port + i * stride
+        try:
+            return start_server(port=p, **kw)
+        except RuntimeError as e:
+            if "rc=-2" not in str(e):
+                raise
+            last = e
+    raise RuntimeError(
+        f"no squatter-free port in {attempts} probes from {port}") from last
+
+
 def dump_server_trace(path: str) -> int:
     """Write the server's chrome trace JSON; returns event count."""
     return load_lib().bps_server_trace_dump(path.encode())
@@ -220,6 +274,7 @@ class PSWorker:
         self._tls = threading.local()
         self._versions: Dict[int, int] = {}
         self._vlock = threading.Lock()
+        self._fenced = False
         self._all_conns: List[NativeClient] = []
         self._conn_lock = threading.Lock()
         self._closed = False
@@ -462,6 +517,46 @@ class PSWorker:
                 time.sleep(backoff * self._retry_rng.uniform(0.5, 1.0)
                            / 1e3)
 
+    # -- owner-failover handoff (sharded-wire hierarchical mode) ------------
+    def fence(self) -> None:
+        """Refuse every future round mint on this worker. Set when its
+        owner is declared dead, BEFORE ``export_rounds`` snapshots the
+        counters: a push thread that resolved this owner pre-failover
+        could otherwise mint a round AFTER the snapshot — invisible to
+        the survivors' adopted counters, so the next round's re-mint of
+        the same number would be dropped by the server's replay dedupe
+        (silent stale gradient). The FailedOverError is stage-retryable:
+        the re-run resolves ownership afresh and lands on a survivor."""
+        with self._vlock:
+            self._fenced = True
+
+    def export_rounds(self) -> Tuple[Dict[int, int], Dict[int, int]]:
+        """Snapshot (per-key round counters, per-key store sizes) — what a
+        surviving controller adopts when this worker's owner dies."""
+        with self._vlock:
+            return dict(self._versions), dict(self._key_nbytes)
+
+    def adopt_rounds(self, versions: Dict[int, int],
+                     nbytes: Dict[int, int]) -> None:
+        """Seed round counters/store sizes from a dead owner's worker.
+
+        Owner failover differs from PR3's SERVER failover: the summation
+        server — and its per-(worker, key) replay watermark — survives an
+        owner death, so the surviving controller must CONTINUE the pod's
+        round numbering (all of a pod's controllers push under the pod's
+        worker_id). A fresh counter would mint versions at/below the
+        server's watermark and every later round would be dropped as a
+        replay. Adopting the max also keeps a round the dead owner had
+        pushed-but-not-pulled replayable: the stage retry re-sends the
+        pinned version through this worker and the dedupe recognizes it.
+        """
+        with self._vlock:
+            for k, v in versions.items():
+                if v > self._versions.get(k, 0):
+                    self._versions[k] = v
+            for k, nb in nbytes.items():
+                self._key_nbytes.setdefault(k, nb)
+
     # -- data plane ---------------------------------------------------------
     def init_key(self, key: int, nbytes: int) -> None:
         with self._vlock:
@@ -474,12 +569,48 @@ class PSWorker:
             return
 
         def attempt(s):
-            # 'init' only matches server-scoped rules (down windows) —
-            # push/pull-scoped loss rules target the data plane proper
-            self._inject_pre("init", s)
+            # 'init'/server-scoped rules only (down windows, init-ack
+            # loss) — push/pull loss rules target the data plane proper
+            inj = self._inject_pre("init", s)
+            if inj is not None and inj.kind == "corrupt":
+                inj = None  # nothing summable to corrupt in an init
             self._conn(s).init_key(key, nbytes)
+            if inj is not None and inj.kind == "timeout":
+                # the init WAS applied (and is idempotent); lose the ack
+                # so the caller's retry/stage-retry path re-inits
+                self._kill_conn(s)
+                raise InjectedTimeout(
+                    f"injected: init ack for key {key} lost (server {s})")
 
         self._retry_loop("init", key, attempt)
+
+    def mint_version(self, key: int, pinned: Optional[int] = None) -> int:
+        """Reserve the round number a push will carry, BEFORE the wire
+        attempt — the push stages pin it on their task so a stage retry
+        re-sends the SAME round even when the first attempt died before
+        ``push_bytes`` could return it. That pin is what keeps the
+        server's per-key round sequence gapless across an owner failover:
+        the counter increments at mint time, so a push that never reached
+        the server still consumed its round number, and a survivor that
+        adopted this worker's counters would otherwise mint one PAST the
+        round the server is still waiting for — a permanent stall (the
+        server can't complete round v without v's push, and the pull for
+        v+1 waits on v). Re-sending the pinned round is safe in both
+        failure modes: never-applied → the server sums it as round v;
+        applied-but-ack-lost → the (worker, key, version) dedupe drops
+        it. A pin that exceeds the current counter (it predates a server
+        failover's counter reset) is discarded and a fresh round minted,
+        exactly like ``push_bytes``'s own rule."""
+        with self._vlock:
+            if self._fenced:
+                raise FailedOverError(
+                    f"owner worker fenced (failed over); re-resolve the "
+                    f"owner for key {key}")
+            cur = self._versions.get(key, 0)
+            if pinned is None or pinned > cur:
+                pinned = cur + 1
+                self._versions[key] = pinned
+            return pinned
 
     def push_bytes(self, key: int, buf: np.ndarray,
                    codec: int = WIRE_RAW,
@@ -629,6 +760,25 @@ class PSWorker:
         server_ns, rtt = self.ping(sidx)
         return server_ns + rtt // 2 - time.time_ns()
 
+    def close(self) -> None:
+        """Drop every connection WITHOUT the kShutdown round. For the
+        extra per-controller NICs of a sharded pod (DcnCore
+        ``pod_controllers``): servers count shutdowns against
+        DMLC_NUM_WORKER and all of a pod's controllers share the pod's
+        worker id, so exactly one of them — worker 0's ``shutdown()`` —
+        may say goodbye."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._health is not None:
+            self._health.stop(join=True)
+        with self._conn_lock:
+            conns = list(self._all_conns)
+            self._all_conns.clear()
+        for c in conns:
+            c.close()
+        self._tls.conns = {}
+
     def shutdown(self) -> None:
         """Tell every server this worker is done (server exits once all
         workers said so), then drop connections."""
@@ -640,13 +790,7 @@ class PSWorker:
             # tearing down: the monitor owns its probe connections, but a
             # fail_over it triggers mid-shutdown would race the teardown
             self._health.stop(join=True)
-        # export the robustness counters into the chrome trace so a retry
-        # storm / failover is visible beside the dPRO timeline
-        counters = self.get_counters()
-        if any(counters.values()):
-            tracer = get_tracer()
-            tracer.metadata.setdefault("robustness", {})[
-                f"worker{self._worker_id}"] = counters
+        self.export_counters()
         # one shutdown per server (not per connection): servers count
         # shutdowns against DMLC_NUM_WORKER. Use this thread's pool
         # (creating connections as needed), then close EVERY connection
@@ -689,6 +833,17 @@ class PSWorker:
             for k, v in self._plan.counters().items():
                 out[f"injected_{k}"] = v
         return out
+
+    def export_counters(self, tag: Optional[str] = None) -> None:
+        """Fold the robustness counters into the chrome-trace metadata so
+        a retry storm / failover is visible beside the dPRO timeline.
+        Extra pod-controller NICs share the pod's worker id, so callers
+        closing them pass a ``worker<id>.nic<rank>`` tag — the plain
+        ``worker<id>`` key belongs to NIC 0's ``shutdown()``."""
+        counters = self.get_counters()
+        if any(counters.values()):
+            get_tracer().metadata.setdefault("robustness", {})[
+                tag or f"worker{self._worker_id}"] = counters
 
 
 class _HealthMonitor:

@@ -1,6 +1,7 @@
 #include "client.h"
 
 #include <chrono>
+#include <cstdio>
 #include <cstring>
 #include <thread>
 #include <vector>
@@ -12,17 +13,22 @@
 namespace bps {
 
 namespace {
-int ConnectOnce(const std::string& host, uint16_t port) {
+int ConnectOnce(const std::string& host, uint16_t port, const char** why) {
   addrinfo hints{};
   hints.ai_family = AF_INET;
   hints.ai_socktype = SOCK_STREAM;
   addrinfo* res = nullptr;
-  if (::getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints,
-                    &res) != 0) {
+  int grc = ::getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints,
+                          &res);
+  if (grc != 0) {
+    *why = ::gai_strerror(grc);
     return -1;
   }
   int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
-  if (fd >= 0 && ::connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+  if (fd < 0) {
+    *why = ::strerror(errno);
+  } else if (::connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+    *why = ::strerror(errno);
     ::close(fd);
     fd = -1;
   }
@@ -45,8 +51,9 @@ int Client::Connect(const std::string& host, uint16_t port, int timeout_ms,
                     int recv_timeout_ms) {
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
+  const char* why = "unknown";
   for (;;) {
-    int fd = ConnectOnce(host, port);
+    int fd = ConnectOnce(host, port, &why);
     if (fd >= 0) {
       set_nodelay(fd);
       set_bufsizes(fd);
@@ -54,7 +61,16 @@ int Client::Connect(const std::string& host, uint16_t port, int timeout_ms,
       fd_ = fd;
       return 0;
     }
-    if (std::chrono::steady_clock::now() >= deadline) return -1;
+    if (std::chrono::steady_clock::now() >= deadline) {
+      // surfaced via stderr because there is no client handle yet for
+      // last_error(); "refused for the whole budget while the port looks
+      // bound" has meant a dead accept loop before — name the errno so
+      // the next person doesn't have to strace a flake
+      std::fprintf(stderr, "bps client: connect %s:%u gave up after %d ms"
+                   " (last error: %s)\n", host.c_str(), port, timeout_ms,
+                   why);
+      return -1;
+    }
     std::this_thread::sleep_for(std::chrono::milliseconds(50));
   }
 }

@@ -363,7 +363,18 @@ class Server {
   void AcceptLoop() {
     while (running_) {
       int fd = ::accept(listen_fd_, nullptr, nullptr);
-      if (fd < 0) break;
+      if (fd < 0) {
+        // EINTR: a signal (the embedding process — jax/XLA, profilers —
+        // delivers them to arbitrary threads) interrupted accept;
+        // ECONNABORTED: the peer gave up while queued. Neither means the
+        // listening socket is done — exiting here silently stops the
+        // server accepting ANYTHING while clients still see the port as
+        // bound (their connects then fail for their whole retry budget).
+        // Only a real teardown (Stop() closes listen_fd_ → EBADF) or an
+        // unrecoverable socket error ends the loop.
+        if (errno == EINTR || errno == ECONNABORTED) continue;
+        break;
+      }
       set_nodelay(fd);
       set_bufsizes(fd);
       auto c = std::make_shared<Conn>();

@@ -177,6 +177,13 @@ class NativeClient:
         # can race PSWorker.shutdown() on another, and bps_client_free
         # must run at most once (double delete = heap corruption)
         self._teardown_lock = threading.Lock()
+        # held across every native wire op so close() cannot free the
+        # handle UNDER an in-flight call (use-after-free; observed as a
+        # teardown segfault when a scheduler shutdown raced a blocked
+        # pull). Uncontended in normal operation — the class contract is
+        # one client per pool thread — so the only time it waits is
+        # close() draining a straggler, bounded by the recv timeout.
+        self._op_lock = threading.Lock()
         self._h: Optional[int] = self._lib.bps_client_connect(
             host.encode(), port, timeout_ms, recv_timeout_ms
         )
@@ -184,8 +191,10 @@ class NativeClient:
             raise ConnectionError(f"cannot reach bps server {host}:{port}")
 
     def init_key(self, key: int, nbytes: int) -> None:
-        self._check(self._lib.bps_client_init_key(self._h, key, nbytes),
-                    "init")
+        with self._op_lock:
+            self._require_open()
+            self._check(self._lib.bps_client_init_key(self._h, key, nbytes),
+                        "init")
 
     def push(self, key: int, data, codec: int = WIRE_RAW,
              worker_id: int = 0, version: int = 0, crc: int = 0) -> None:
@@ -195,14 +204,15 @@ class NativeClient:
         :func:`~byteps_tpu.server.wire_crc32`) is verified server-side
         before the payload is summed."""
         buf = np.ascontiguousarray(data)
-        self._require_open()
-        self._check(
-            self._lib.bps_client_push2(
-                self._h, key, buf.ctypes.data, buf.nbytes, codec,
-                worker_id, version, crc,
-            ),
-            "push",
-        )
+        with self._op_lock:
+            self._require_open()
+            self._check(
+                self._lib.bps_client_push2(
+                    self._h, key, buf.ctypes.data, buf.nbytes, codec,
+                    worker_id, version, crc,
+                ),
+                "push",
+            )
 
     def pull(self, key: int, out: np.ndarray, version: int,
              codec: int = WIRE_RAW, want_crc: bool = False) -> int:
@@ -210,57 +220,70 @@ class NativeClient:
         ``(bytes, crc)`` when ``want_crc`` — the caller verifies, so the
         fault-injection layer can corrupt the buffer in between)."""
         assert out.flags.c_contiguous
-        self._require_open()
-        got = ctypes.c_uint64(0)
-        if want_crc:
-            crc = ctypes.c_uint32(0)
+        with self._op_lock:
+            self._require_open()
+            got = ctypes.c_uint64(0)
+            if want_crc:
+                crc = ctypes.c_uint32(0)
+                self._check(
+                    self._lib.bps_client_pull2(
+                        self._h, key, out.ctypes.data, out.nbytes, version,
+                        codec, 1, ctypes.byref(got), ctypes.byref(crc),
+                    ),
+                    "pull",
+                )
+                return int(got.value), int(crc.value)
             self._check(
-                self._lib.bps_client_pull2(
+                self._lib.bps_client_pull(
                     self._h, key, out.ctypes.data, out.nbytes, version,
-                    codec, 1, ctypes.byref(got), ctypes.byref(crc),
+                    codec, ctypes.byref(got),
                 ),
                 "pull",
             )
-            return int(got.value), int(crc.value)
-        self._check(
-            self._lib.bps_client_pull(
-                self._h, key, out.ctypes.data, out.nbytes, version, codec,
-                ctypes.byref(got),
-            ),
-            "pull",
-        )
-        return int(got.value)
+            return int(got.value)
 
     def barrier(self) -> None:
-        self._require_open()
-        self._check(self._lib.bps_client_barrier(self._h), "barrier")
+        with self._op_lock:
+            self._require_open()
+            self._check(self._lib.bps_client_barrier(self._h), "barrier")
 
     def ping(self) -> Tuple[int, int]:
         """(server CLOCK_REALTIME ns, round-trip ns) — clock alignment."""
-        self._require_open()
-        sns = ctypes.c_int64(0)
-        rtt = ctypes.c_int64(0)
-        self._check(
-            self._lib.bps_client_ping(
-                self._h, ctypes.byref(sns), ctypes.byref(rtt)
-            ),
-            "ping",
-        )
-        return int(sns.value), int(rtt.value)
+        with self._op_lock:
+            self._require_open()
+            sns = ctypes.c_int64(0)
+            rtt = ctypes.c_int64(0)
+            self._check(
+                self._lib.bps_client_ping(
+                    self._h, ctypes.byref(sns), ctypes.byref(rtt)
+                ),
+                "ping",
+            )
+            return int(sns.value), int(rtt.value)
 
     def is_dead(self) -> bool:
-        """True once a timeout/desync closed the underlying socket; the
-        owner should discard this client and connect a fresh one."""
-        return bool(self._h) and bool(self._lib.bps_client_is_dead(self._h))
+        """True once a timeout/desync closed the underlying socket (or the
+        client itself was closed); the owner should discard this client
+        and connect a fresh one. Holds the op lock like every other
+        native call — close() frees the handle under it, and a retiring
+        NIC closes clients owned by other pool threads."""
+        with self._op_lock:
+            if not self._h:
+                return True
+            return bool(self._lib.bps_client_is_dead(self._h))
 
     def shutdown(self) -> None:
-        with self._teardown_lock:
-            if self._h:
-                self._lib.bps_client_shutdown(self._h)
+        with self._op_lock:
+            with self._teardown_lock:
+                if self._h:
+                    self._lib.bps_client_shutdown(self._h)
 
     def close(self) -> None:
-        with self._teardown_lock:
-            h, self._h = self._h, None
+        # op lock first: wait out any in-flight wire op (freeing under
+        # one is a use-after-free); a later op finds _h None and raises
+        with self._op_lock:
+            with self._teardown_lock:
+                h, self._h = self._h, None
         if h:
             self._lib.bps_client_free(h)
 

@@ -33,6 +33,7 @@ run --mode dcn-profile                   # host component ceilings
 run --mode throttled                     # compression race on emulated slow DCN
 run --mode tune                          # joint (partition, credit) auto-tune
 run --mode chaos                         # goodput vs fault rate (+BENCH_chaos.json)
+run --mode hybrid                        # sharded-wire hierarchical race (+BENCH_hybrid.json)
 
 echo "collected $(wc -l < "$OUT") results in $OUT" >&2
 cat "$OUT"

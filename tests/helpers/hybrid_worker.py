@@ -117,13 +117,13 @@ def main():
     # periodic-broadcast workload: repeated broadcasts must REUSE the fixed
     # signature-keyed families — registry entries and server keys bounded,
     # no per-call growth (round-1/2 leak: fresh c{N} names every call)
-    n_names = len(bps._state.registry._by_name)
+    n_names = len(bps._state.registry)
     n_keys = len(bps._state.inited_keys)
     for _ in range(25):
         got = bps.broadcast_parameters(params, root_rank=5)
         bps.broadcast_parameters(opt_like, root_rank=0)
     np.testing.assert_allclose(np.asarray(got["w"]), 5.0, rtol=1e-6)
-    assert len(bps._state.registry._by_name) == n_names, "registry grew"
+    assert len(bps._state.registry) == n_names, "registry grew"
     assert len(bps._state.inited_keys) == n_keys, "server keys grew"
 
     # multi-partition tensor (exercises partitioned DCN pipeline): with
