@@ -1550,6 +1550,11 @@ def bench_chaos(payload_mb: int = 8, rounds: int = 4, reps: int = 3) -> dict:
     * ``server_down``: server 1 is unreachable from the start; the ping
       health monitor marks it dead and its keys fail over to server 0 —
       the cost is halved server capacity plus the retry/failover bumps.
+    * ``worker_death`` (vs its own ``clean2w`` baseline): one of TWO
+      workers is killed mid-run (``worker:kill`` + the server's
+      membership lease); the survivor completes every round — one round
+      stalls ~one lease until the eviction re-targets it, the rest run
+      at surviving-membership speed. Graceful degradation, not a cliff.
 
     Per-config medians of ``reps`` timed blocks (each ``rounds``
     push_pulls of a ``payload_mb`` MB gradient) with [min, max] spreads,
@@ -1653,12 +1658,140 @@ def bench_chaos(payload_mb: int = 8, rounds: int = 4, reps: int = 3) -> dict:
             r = results[fname][cname]
             r["goodput_vs_clean"] = round(
                 clean / r["sec_per_round_med"], 3)
-    worst = min(results[f][c]["goodput_vs_clean"]
-                for f, _ in configs for c, _ in codecs)
+
+    # ---- worker-death leg: {kill one of 2 workers mid-run} × codecs ------
+    # Elastic membership (docs/robustness.md): two DcnCore workers against
+    # a 2-worker server with the lease armed; worker 1 dies (worker:kill)
+    # a third of the way through. The survivor must COMPLETE every round —
+    # the one stalled round costs ~one lease until the eviction re-targets
+    # it (graceful), then survivor-only rounds run at 1-worker speed.
+    # Measured against a clean 2-worker run of the same shape; per-round
+    # times expose the stall as a max, not a cliff across the whole run.
+    import threading
+
+    lease_ms = 800
+    wd_rounds = max(6, 2 * rounds)
+    n_parts = -(-dense_bytes // base_cfg.partition_bytes)
+    kill_at = wd_rounds // 3
+    # victim plan ops: init per partition, then {push, pull} per
+    # partition per round → first push of round kill_at (0-based)
+    kill_step = n_parts + 2 * n_parts * kill_at + 1
+    for leg, spec in (("clean2w", None),
+                      ("worker_death",
+                       f"worker:kill@step={kill_step}..")):
+        results[leg] = {}
+        for cname, mk in codecs:
+            p0 = base_port + run_id * 2
+            run_id += 1
+            cfg = _dc.replace(
+                base_cfg, num_worker=2, num_server=1,
+                retry_limit=8, retry_backoff_ms=10,
+                worker_lease_ms=lease_ms,
+            )
+            config_mod.set_config(cfg)
+            start_server(port=p0, num_workers=2, engine_threads=4,
+                         async_mode=False, lease_ms=lease_ms)
+            servers = [("127.0.0.1", p0)]
+            flat1 = np.random.default_rng(1).standard_normal(
+                nelems).astype(np.float32)
+            round_times = []
+            counters = {}
+            worker_errs = []
+            gate = threading.Barrier(2, timeout=300)
+
+            def survivor_body(codec_mk=mk):
+                core = DcnCore(servers=servers, worker_id=0,
+                               health_interval_ms=50)
+                try:
+                    gate.wait()
+                    for _ in range(wd_rounds):
+                        t0 = time.perf_counter()
+                        h = core.push_pull_async(flat, name="wd",
+                                                 codec=codec_mk())
+                        DcnCore.assemble(h, timeout=600.0)
+                        round_times.append(time.perf_counter() - t0)
+                    counters.update(core.worker.get_counters())
+                except BaseException as e:  # noqa: BLE001 - surfaced below
+                    worker_errs.append(e)
+                finally:
+                    core.shutdown()
+
+            def victim_body(codec_mk=mk, victim_spec=spec):
+                core = DcnCore(
+                    servers=servers, worker_id=1,
+                    fault_specs=[victim_spec] if victim_spec else None,
+                    health_interval_ms=0 if victim_spec else 50)
+                try:
+                    gate.wait()
+                    for _ in range(wd_rounds):
+                        h = core.push_pull_async(flat1, name="wd",
+                                                 codec=codec_mk())
+                        DcnCore.assemble(h, timeout=600.0)
+                except BaseException as e:  # noqa: BLE001
+                    if not victim_spec:
+                        # clean2w leg: this thread is HALF the measured
+                        # baseline — a real failure here silently
+                        # corrupts the number worker_death is judged
+                        # against, so it must surface, not vanish
+                        worker_errs.append(e)
+                    # injected-death leg: the kill is the expected exit
+                finally:
+                    if victim_spec:
+                        # process death: no goodbye, just drop sockets
+                        core.scheduler.shutdown()
+                        for w in core.workers:
+                            w.close()
+                    else:
+                        core.shutdown()
+
+            ts = [threading.Thread(target=survivor_body),
+                  threading.Thread(target=victim_body)]
+            try:
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(timeout=600)
+                    assert not t.is_alive(), (
+                        f"worker thread hung in the {leg} leg — the "
+                        "stall the lease should have resolved")
+                if worker_errs:
+                    raise worker_errs[0]
+                assert round_times, f"no rounds completed in the {leg} leg"
+            finally:
+                stop_server()
+                config_mod.reset_config()
+            srt = sorted(round_times)
+            med = float(np.median(round_times))
+            results[leg][cname] = {
+                "sec_per_round_med": round(med, 4),
+                "sec_per_round_max": round(srt[-1], 4),  # the stall round
+                "sec_spread": [round(srt[0], 4), round(srt[-1], 4)],
+                "rounds": wd_rounds,
+                "kill_at_round": kill_at if spec else None,
+                "lease_ms": lease_ms if spec else None,
+                "counters": {k: v for k, v in counters.items() if v},
+            }
+            _log(f"chaos {leg:>12} {cname:>6}: {med*1e3:7.1f} ms/round "
+                 f"[{srt[0]*1e3:.1f}, {srt[-1]*1e3:.1f}], "
+                 f"counters={results[leg][cname]['counters']}")
+        if leg == "worker_death":
+            for cname, _ in codecs:
+                r = results[leg][cname]
+                clean = results["clean2w"][cname]["sec_per_round_med"]
+                r["goodput_vs_clean"] = round(
+                    clean / r["sec_per_round_med"], 3)
+
+    worst = min(
+        [results[f][c]["goodput_vs_clean"]
+         for f, _ in configs for c, _ in codecs]
+        + [results["worker_death"][c]["goodput_vs_clean"]
+           for c, _ in codecs])
     return {
-        "metric": ("chaos goodput degradation (DcnCore, 1 worker + 2 "
-                   "servers, fault injection: clean / 5% push-ack loss / "
-                   "one server down)"),
+        "metric": ("chaos goodput degradation (DcnCore, fault injection: "
+                   "clean / 5% push-ack loss / one server down on a "
+                   "1-worker+2-server matrix, plus a worker-death leg — "
+                   "kill 1 of 2 workers mid-run under the membership "
+                   "lease, survivor vs clean 2-worker baseline)"),
         "value": worst,
         "unit": "x of clean goodput (worst chaos config)",
         "vs_baseline": worst,

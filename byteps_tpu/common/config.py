@@ -160,6 +160,21 @@ class Config:
     # With no live server left: True degrades push_pull to the pod-local
     # (pure-ICI) sum with a loud log + counters; False fails the handle.
     degraded_ok: bool = True
+    # Elastic worker membership (docs/robustness.md): > 0 arms worker
+    # LEASES on the summation servers — a worker silent past this many ms
+    # (no push/pull/heartbeat) is EVICTED: the membership epoch bumps,
+    # open rounds re-target the live worker set (partial sums scaled to
+    # the survivors so the global average stays unbiased), stuck barriers
+    # release, and the server can exit without the dead worker's goodbye.
+    # Workers heartbeat through the health monitor's kPing (enable
+    # BYTEPS_HEALTH_INTERVAL_MS well below the lease). 0 = fixed
+    # membership (legacy: one dead worker stalls every peer).
+    worker_lease_ms: int = 0
+    # > 0 caps EVERY Handle.wait() at this many ms: a would-be infinite
+    # wait (peer death with no lease, total stall) raises a diagnosable
+    # StallError carrying per-stage/per-server counters instead of
+    # blocking forever. 0 = only the caller's own timeout applies.
+    handle_deadline_ms: int = 0
 
     # --- tracing (SURVEY §5.1) ---------------------------------------------
     trace_on: bool = False
@@ -219,6 +234,8 @@ class Config:
             health_interval_ms=_env_int("BYTEPS_HEALTH_INTERVAL_MS", 0),
             health_miss_limit=_env_int("BYTEPS_HEALTH_MISS_LIMIT", 3),
             degraded_ok=_env_bool("BYTEPS_DEGRADED_OK", True),
+            worker_lease_ms=_env_int("BYTEPS_WORKER_LEASE_MS", 0),
+            handle_deadline_ms=_env_int("BYTEPS_HANDLE_DEADLINE_MS", 0),
             trace_on=_env_bool("BYTEPS_TRACE_ON"),
             trace_dir=_env_str("BYTEPS_TRACE_DIR", "./traces"),
             trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 1),

@@ -36,6 +36,7 @@ from byteps_tpu.server import (
     FailedOverError,
     NoLiveServersError,
     PSWorker,
+    WorkerEvictedError,
     hand_off_owner,
     retire_nic,
 )
@@ -87,6 +88,27 @@ def remap_dead_owner(task, owner: int, owners, fail_owner, owner_of,
             f"{owner_of(task.partition.key)}")
         err.retryable = True
         raise err from cause
+
+
+def stall_diag(workers, owners, scheduler):
+    """Assemble a ``Handle.diag`` payload — ONE definition shared by
+    DcnCore and the jax hybrid tier, so StallError reports from the two
+    pipelines never drift: per-NIC robustness/health counters, live
+    server/owner sets, and the scheduler's credit/busy state (what a
+    stall report needs to show WHY retry/failover did or didn't fire)."""
+    return {
+        "workers": {f"nic{r}": w.get_counters()
+                    for r, w in enumerate(workers)},
+        "live_servers": {f"nic{r}": sorted(w.live_servers())
+                         for r, w in enumerate(workers)},
+        "live_owners": (sorted(owners.live())
+                        if owners is not None else None),
+        "credit_pools": (scheduler.credit_pools()
+                         if scheduler is not None else None),
+        "stage_busy": ({s.name: b for s, b in
+                        zip(scheduler.stages, scheduler._busy)}
+                       if scheduler is not None else None),
+    }
 
 
 class DegradedLocal:
@@ -169,6 +191,7 @@ class DcnCore:
     def __init__(self, servers=None, worker_id=None,
                  pod_controllers: Optional[int] = None,
                  fault_specs: Optional[Sequence[Optional[str]]] = None,
+                 health_interval_ms: Optional[int] = None,
                  ) -> None:
         """``pod_controllers`` > 1 turns on the sharded-wire hierarchical
         mode (BytePS "use every link"): the pod is modeled as that many
@@ -204,7 +227,8 @@ class DcnCore:
         # under the same pod id (PSWorker.adopt_rounds).
         self.workers: List[PSWorker] = [
             PSWorker(servers=servers, worker_id=worker_id,
-                     fault_plan=plans[o])
+                     fault_plan=plans[o],
+                     health_interval_ms=health_interval_ms)
             for o in range(pod_controllers)
         ]
         self.worker = self.workers[0]  # back-compat accounting handle
@@ -372,6 +396,13 @@ class DcnCore:
                 p.key, task.payload, codec_id,
                 version=task.push_version)
         except BaseException as e:  # noqa: BLE001 - owner-death classify
+            if isinstance(e, WorkerEvictedError):
+                # the pinned round predates the eviction; the rejoin
+                # (already performed by the retry loop) adopted the
+                # server's watermarks, so the stage retry must mint a
+                # FRESH round — a stale pin at/below the watermark would
+                # be silently dedupe-dropped (permanent per-key stall)
+                task.push_version = None
             self._owner_giveup(task, owner, e)
         task.push_version = version
         return version
@@ -386,10 +417,24 @@ class DcnCore:
         codec_id = plan.pull_codec_id if plan is not None else 0
         owner = self._owner_of(p.key)
         try:
-            return self.workers[owner].pull_bytes(
+            out = self.workers[owner].pull_bytes(
                 p.key, capacity, task.payload, codec_id)
         except BaseException as e:  # noqa: BLE001 - owner-death classify
             self._owner_giveup(task, owner, e)
+        # record the round's OWN live count per partition (from the
+        # response's epoch stamp) so averaging consumers (torch/tf
+        # synchronize) divide each slice by the membership its round
+        # actually closed under — handles can be MIXED across an
+        # eviction, exactly like degraded_parts
+        live = self.workers[owner].last_round_live()
+        if live is not None:
+            with task.handle._lock:
+                parts = getattr(task.handle, "part_live", None)
+                if parts is None:
+                    parts = {}
+                    task.handle.part_live = parts
+                parts[p.part_idx] = (p.offset, p.length, live)
+        return out
 
     def _decompress_stage(self, task: PartitionTask):
         """Wire decode of the pulled round result (reference DECOMPRESS),
@@ -405,6 +450,19 @@ class DcnCore:
             # format never existed for this round)
             return plan.codec.decode(buf, p.length, seed)
         return plan.decode_pull(buf, p.length, seed)
+
+    # -- observability ------------------------------------------------------
+    def live_size(self) -> int:
+        """Live worker (pod) count per the most recently adopted
+        membership epoch — the divisor averaging consumers use instead of
+        the static DMLC_NUM_WORKER under elastic membership. Min over the
+        pod's NICs: they converge on the same epoch, and between
+        adoptions the smaller view is the safe (already-shrunk) one."""
+        return max(1, min(w.live_pods() for w in self.workers))
+
+    def _stall_diag(self):
+        """Handle.diag callback (shared assembly: :func:`stall_diag`)."""
+        return stall_diag(self.workers, self.owners, self.scheduler)
 
     # -- public -------------------------------------------------------------
     def push_pull_async(self, flat: np.ndarray, name: str,
@@ -432,6 +490,7 @@ class DcnCore:
             for p in ctx.partitions
         ]
         handle = Handle(name, len(ctx.partitions))
+        handle.diag = self._stall_diag  # StallError diagnostics
         shared = {"flat": flat, "plans": plans, "version": version}
         tasks = []
         for p in ctx.partitions:

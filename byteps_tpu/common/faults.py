@@ -12,17 +12,24 @@ Spec grammar (semicolon-separated rules)::
 
     BYTEPS_FAULT_SPEC = rule (';' rule)*
     rule   = scope ':' kind ['@' cond (',' cond)*]
-    scope  = 'push' | 'pull' | 'init' | 'all' | 'server<N>'
+    scope  = 'push' | 'pull' | 'init' | 'all' | 'server<N>' | 'worker'
              # push/pull/all match DATA-PLANE ops only ('all' = push+pull);
              # 'init' matches key-init attempts only (kill = the init
              # never reached the server; timeout = applied, ack lost);
              # server<N> matches every op against that server, including
-             # init and the health monitor's pings
-    kind   = 'timeout' | 'kill' | 'slow' | 'corrupt' | 'down'
+             # init and the health monitor's pings; 'worker' targets THIS
+             # worker process itself (peer-death simulation): kill = the
+             # worker dies at that plan op (every later op fails
+             # WorkerKilledError, heartbeats stop — the server lease
+             # evicts it); hang = the worker wedges for ms= milliseconds
+             # (ops block then time out, heartbeats stop) and then may
+             # rejoin
+    kind   = 'timeout' | 'kill' | 'slow' | 'corrupt' | 'down' | 'hang'
     cond   = 'p=' FLOAT          # per-op Bernoulli (seeded RNG)
            | 'op=' A ['..' [B]]  # plan-op window, inclusive; open end ok
            | 'step=' ...         # alias of op=
-           | 'ms=' INT           # slow: injected latency (default 50)
+           | 'ms=' INT           # slow/hang: injected latency
+                                 # (default 50 slow / 300000 hang)
 
 Examples: ``push:timeout@p=0.05`` — 5% of push attempts lose their
 response; ``server1:down@step=40..55`` — every op against server 1 fails
@@ -71,11 +78,12 @@ log = get_logger("faults")
 
 __all__ = [
     "FaultRule", "FaultPlan", "Injection", "InjectedTimeout",
-    "InjectedConnectionError", "ServerDownError", "parse_fault_spec",
-    "plan_from_env",
+    "InjectedConnectionError", "ServerDownError", "WorkerKilledError",
+    "parse_fault_spec", "rules_to_spec", "plan_from_env",
 ]
 
-KINDS = ("timeout", "kill", "slow", "corrupt", "down")
+KINDS = ("timeout", "kill", "slow", "corrupt", "down", "hang")
+SCOPES = ("push", "pull", "all", "init", "worker")
 
 
 class InjectedTimeout(TimeoutError):
@@ -90,14 +98,38 @@ class ServerDownError(ConnectionError):
     """Injected server-down window: the server is unreachable."""
 
 
+class WorkerKilledError(RuntimeError):
+    """Injected worker death (``worker:kill``): THIS worker process is
+    simulated dead — every wire op fails with this error and heartbeats
+    stop, so the server's lease eviction fires exactly as it would for a
+    real crash. Never retryable: a dead process retries nothing."""
+
+    retryable = False
+
+
 @dataclasses.dataclass(frozen=True)
 class FaultRule:
-    scope: str                 # 'push' | 'pull' | 'init' | 'all' | 'server<N>'
+    scope: str                 # one of SCOPES, or 'server<N>'
     kind: str                  # one of KINDS
     p: Optional[float] = None  # per-op probability (None = always/window)
     window: Optional[Tuple[int, Optional[int]]] = None  # [a, b] op window
-    latency_ms: int = 50       # for kind == 'slow'
+    latency_ms: int = 50       # for kind == 'slow' / 'hang'
     server: Optional[int] = None  # parsed from 'server<N>' scopes
+
+    def to_spec(self) -> str:
+        """Render back to the BYTEPS_FAULT_SPEC grammar (round-trip:
+        ``parse_fault_spec(rule.to_spec())`` reproduces the rule)."""
+        conds = []
+        if self.p is not None:
+            conds.append(f"p={self.p}")
+        if self.window is not None and self.window != (0, None):
+            a, b = self.window
+            conds.append(f"op={a}" if b == a else
+                         f"op={a}.." + ("" if b is None else str(b)))
+        if self.latency_ms != (300000 if self.kind == "hang" else 50):
+            conds.append(f"ms={self.latency_ms}")
+        head = f"{self.scope}:{self.kind}"
+        return head + ("@" + ",".join(conds) if conds else "")
 
     def matches(self, op: str, sidx: int, step: int, rng) -> bool:
         if self.server is not None:
@@ -106,6 +138,10 @@ class FaultRule:
             # 'down' window trip the monitor)
             if sidx != self.server:
                 return False
+        elif self.scope == "worker":
+            # worker scopes simulate THIS process's death/wedge, so they
+            # match every wire attempt regardless of target server or op
+            pass
         elif self.scope == "init":
             if op != "init":
                 return False
@@ -136,6 +172,17 @@ class Injection:
     corrupt_at: int = 0
 
 
+def _parse_num(value: str, cast, what: str):
+    """Cast a condition value, naming the grammar on failure instead of
+    leaking a bare ``invalid literal for int()``."""
+    try:
+        return cast(value)
+    except ValueError:
+        raise ValueError(
+            f"{what} (got {value!r}; grammar: docs/robustness.md)"
+        ) from None
+
+
 def parse_fault_spec(spec: str) -> List[FaultRule]:
     rules: List[FaultRule] = []
     for part in spec.split(";"):
@@ -148,30 +195,51 @@ def parse_fault_spec(spec: str) -> List[FaultRule]:
             scope = scope.strip().lower()
             kind = kind.strip().lower()
             if kind not in KINDS:
-                raise ValueError(f"unknown fault kind {kind!r}")
+                raise ValueError(
+                    f"unknown fault kind {kind!r} (expected one of "
+                    f"{'|'.join(KINDS)})")
+            if kind == "hang" and scope != "worker":
+                raise ValueError(
+                    "'hang' simulates THIS worker wedging and only takes "
+                    "the 'worker' scope (worker:hang@...)")
             server = None
-            if scope.startswith("server"):
-                server = int(scope[len("server"):])
-            elif scope not in ("push", "pull", "all", "init"):
-                raise ValueError(f"unknown fault scope {scope!r}")
+            if scope.startswith("server") and scope not in SCOPES:
+                idx = scope[len("server"):]
+                if not idx.isdigit():
+                    # 'serverX:down' / 'server:down' must name the
+                    # grammar, not surface a bare int() ValueError
+                    raise ValueError(
+                        f"bad server index {idx!r} in scope {scope!r} "
+                        "(expected server<N>, e.g. server1)")
+                server = int(idx)
+            elif scope not in SCOPES:
+                raise ValueError(
+                    f"unknown fault scope {scope!r} (expected one of "
+                    f"{'|'.join(SCOPES)} or server<N>)")
             p = None
             window = None
-            latency_ms = 50
+            latency_ms = 300000 if kind == "hang" else 50
             for cond in filter(None, (c.strip() for c in conds.split(","))):
                 k, _, v = cond.partition("=")
                 k = k.strip().lower()
+                v = v.strip()
                 if k == "p":
-                    p = float(v)
+                    p = _parse_num(v, float,
+                                   "p= needs a float probability")
                 elif k in ("op", "step"):
                     a, dots, b = v.partition("..")
-                    lo = int(a)
+                    lo = _parse_num(a, int, f"{k}= needs an int op index")
                     hi = None if (dots and not b.strip()) else (
-                        int(b) if dots else lo)
+                        _parse_num(b, int, f"{k}= window end needs an int")
+                        if dots else lo)
                     window = (lo, hi)
                 elif k == "ms":
-                    latency_ms = int(v)
+                    latency_ms = _parse_num(
+                        v, int, "ms= needs an int millisecond latency")
                 else:
-                    raise ValueError(f"unknown fault condition {k!r}")
+                    raise ValueError(
+                        f"unknown fault condition {k!r} (expected "
+                        "p=|op=|step=|ms=)")
             if p is None and window is None:
                 # bare rule: always fires (e.g. 'server1:down')
                 window = (0, None)
@@ -182,6 +250,12 @@ def parse_fault_spec(spec: str) -> List[FaultRule]:
             raise ValueError(
                 f"bad BYTEPS_FAULT_SPEC rule {part!r}: {e}") from None
     return rules
+
+
+def rules_to_spec(rules: List[FaultRule]) -> str:
+    """Inverse of :func:`parse_fault_spec` (each rule via
+    :meth:`FaultRule.to_spec`) — pinned by the grammar round-trip test."""
+    return ";".join(r.to_spec() for r in rules)
 
 
 class FaultPlan:

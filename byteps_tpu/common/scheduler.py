@@ -40,6 +40,37 @@ from byteps_tpu.common.tracing import TraceRecorder
 log = get_logger("scheduler")
 
 
+class StallError(TimeoutError):
+    """A Handle.wait() that did not complete in time — including a wait
+    capped by ``BYTEPS_HANDLE_DEADLINE_MS``, which converts a would-be
+    infinite wait (a dead peer worker with no lease armed, a wedged
+    server) into THIS diagnosable error instead of a silent hang.
+
+    Carries what a stall report needs: which partitions completed, and —
+    when the owning pipeline attached a ``handle.diag`` callback — the
+    per-stage/per-server robustness counters at the moment of the stall
+    (retries, timeouts, failovers, live servers, health-probe ages, credit
+    pools), so the report shows WHY fail-over/retry did or did not fire.
+    """
+
+    def __init__(self, handle_name: str, waited_s: Optional[float],
+                 done_parts: List[int], total_parts: int,
+                 diag: Optional[Dict[str, Any]] = None,
+                 deadline_capped: bool = False):
+        cap = (" (BYTEPS_HANDLE_DEADLINE_MS cap)" if deadline_capped
+               else "")
+        waited = "?" if waited_s is None else f"{waited_s:.1f}"
+        super().__init__(
+            f"handle '{handle_name}' stalled: {len(done_parts)}/"
+            f"{total_parts} partition(s) done after {waited}s{cap}; "
+            f"diagnostics: {diag if diag is not None else 'none attached'}")
+        self.handle_name = handle_name
+        self.done_parts = done_parts
+        self.total_parts = total_parts
+        self.diag = diag
+        self.deadline_capped = deadline_capped
+
+
 class PartitionFailure(RuntimeError):
     """A handle failed because one partition's pipeline failed.
 
@@ -78,11 +109,16 @@ class Handle:
 
     def __init__(self, name: str, num_partitions: int) -> None:
         self.name = name
+        self._num_partitions = num_partitions
         self._remaining = num_partitions
         self._event = threading.Event()
         self._lock = threading.Lock()
         self._error: Optional[BaseException] = None
         self.results: Dict[int, Any] = {}  # part_idx -> stage-pipeline output
+        # Optional stall-diagnostics callback attached by the owning
+        # pipeline: () -> dict of per-stage/per-server counters, folded
+        # into the StallError a timed-out wait() raises.
+        self.diag: Optional[Callable[[], Dict[str, Any]]] = None
 
     def _partition_done(self, part_idx: int, result: Any) -> None:
         with self._lock:
@@ -108,8 +144,34 @@ class Handle:
         return self._error is not None
 
     def wait(self, timeout: Optional[float] = None) -> Dict[int, Any]:
-        if not self._event.wait(timeout):
-            raise TimeoutError(f"handle '{self.name}' not done within {timeout}s")
+        # BYTEPS_HANDLE_DEADLINE_MS is a hard ceiling on EVERY wait —
+        # including timeout=None callers — so no configuration can turn a
+        # dead peer into an infinite block; the expiry is a diagnosable
+        # StallError, not a silent hang.
+        from byteps_tpu.common.config import get_config
+
+        deadline_ms = get_config().handle_deadline_ms
+        effective = timeout
+        capped = False
+        if deadline_ms and deadline_ms > 0:
+            cap_s = deadline_ms / 1e3
+            if effective is None or cap_s < effective:
+                effective = cap_s
+                capped = True
+        if not self._event.wait(effective):
+            diag = None
+            if self.diag is not None:
+                try:
+                    diag = self.diag()
+                except Exception as e:  # noqa: BLE001 - diagnostics are
+                    # best-effort; a failing callback must not mask the
+                    # stall itself
+                    diag = {"diag_error": f"{type(e).__name__}: {e}"}
+            with self._lock:
+                done = sorted(self.results)
+            raise StallError(self.name, effective, done,
+                             self._num_partitions, diag,
+                             deadline_capped=capped)
         if self._error is not None:
             raise self._error
         return self.results

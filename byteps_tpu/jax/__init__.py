@@ -536,6 +536,15 @@ def _owner_of(key: int) -> int:
     return _state.owners.owner(key) if _state.owners is not None else 0
 
 
+def _stall_diag():
+    """Handle.diag callback for the hybrid tier — the same assembly as
+    DcnCore's (`dcn_adapter.stall_diag`), so StallError reports from the
+    two pipelines carry identical diagnostics."""
+    from byteps_tpu.common.dcn_adapter import stall_diag
+
+    return stall_diag(_state.psworkers, _state.owners, _state.scheduler)
+
+
 def _fail_owner(rank: int, cause: Optional[BaseException] = None) -> bool:
     """Jax-side owner failover (mirrors DcnCore.fail_owner; the shared
     fence → export → adopt → shrink critical section is
@@ -649,6 +658,13 @@ def _dcn_push_stage(task: PartitionTask):
             p.key, task.payload, codec_id,
             version=task.push_version)
     except BaseException as e:  # noqa: BLE001 - owner-death classify
+        from byteps_tpu.server import WorkerEvictedError
+
+        if isinstance(e, WorkerEvictedError):
+            # rejoin adopted the server watermarks; the stage retry must
+            # mint a FRESH round (a stale pin would be dedupe-dropped —
+            # see DcnCore._push_stage)
+            task.push_version = None
         _owner_giveup(task, owner, e)
     task.push_version = version
     return version
@@ -665,11 +681,17 @@ def _dcn_pull_stage(task: PartitionTask):
     worker = _state.psworkers[owner]
     try:
         if plan is None:
-            return worker.pull_bytes(p.key, p.length * 4, task.payload, 0)
-        return worker.pull_bytes(
-            p.key, plan.pull_capacity(p.length), task.payload,
-            plan.pull_codec_id,
-        )
+            out = worker.pull_bytes(p.key, p.length * 4, task.payload, 0)
+        else:
+            out = worker.pull_bytes(
+                p.key, plan.pull_capacity(p.length), task.payload,
+                plan.pull_codec_id,
+            )
+        # the round's OWN live count (from its response's epoch stamp):
+        # the averaging divisor for THIS partition, even if the current
+        # membership has already moved on
+        task.round_live = worker.last_round_live()
+        return out
     except BaseException as e:  # noqa: BLE001 - owner-death classify
         _owner_giveup(task, owner, e)
 
@@ -690,6 +712,20 @@ def _decompress_stage(task: PartitionTask):
                             _wire_seed(task))
 
 
+def _live_size() -> int:
+    """Global participant count under ELASTIC membership: pod devices ×
+    live pods per the most recently adopted membership epoch. Equals
+    ``size()`` while the membership is full; after an eviction the pull
+    results are sums over the live set (the server's quorum scaling keeps
+    them unbiased), so averaging must divide by the live count — every
+    worker adopts the same epoch, so the rescale is consistent across the
+    survivors."""
+    if _state.cfg.jax_distributed or not _state.psworkers:
+        return size()
+    return pod_size() * max(1, min(w.live_pods()
+                                   for w in _state.psworkers))
+
+
 def _average_h2d(task: PartitionTask, out: jnp.ndarray) -> jnp.ndarray:
     if task.context["average"]:
         if getattr(task, "degraded", False):
@@ -698,7 +734,13 @@ def _average_h2d(task: PartitionTask, out: jnp.ndarray) -> jnp.ndarray:
             # pod-sums of the same expected scale)
             out = out / pod_size()
         else:
-            out = out / size()  # global worker-device count
+            # divisor = the pulled round's OWN live membership (its
+            # response carried the epoch it closed under); fall back to
+            # the currently adopted count for non-elastic paths
+            live = getattr(task, "round_live", None)
+            d = (pod_size() * max(1, live) if live is not None
+                 else _live_size())
+            out = out / d
     return out
 
 
@@ -848,6 +890,8 @@ def push_pull_async(
     handle = Handle(name, len(ctx.partitions))
     handle.inner_shape = inner_shape  # type: ignore[attr-defined]
     handle.dtype = x.dtype            # type: ignore[attr-defined]
+    if _state.psworkers:
+        handle.diag = _stall_diag  # StallError diagnostics (hybrid tier)
     shared = {
         "x2d": x2d,
         "spec": spec,

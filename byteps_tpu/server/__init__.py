@@ -34,6 +34,7 @@ from byteps_tpu.common.faults import (
     InjectedConnectionError,
     InjectedTimeout,
     ServerDownError,
+    WorkerKilledError,
     plan_from_env,
 )
 from byteps_tpu.common.logging import get_logger
@@ -42,6 +43,7 @@ from byteps_tpu.server.native import (
     WIRE_RAW,
     NativeClient,
     WireCorruption,
+    WorkerEvictedError,
     load_lib,
     reduce_sum_f32,
 )
@@ -53,7 +55,8 @@ __all__ = [
     "start_server", "start_server_any_port", "stop_server",
     "serve_forever", "server_addresses",
     "PSWorker", "reduce_sum_f32", "DcnPacer", "FailedOverError",
-    "NoLiveServersError", "WireCorruption", "wire_crc32",
+    "NoLiveServersError", "WireCorruption", "WorkerEvictedError",
+    "WorkerKilledError", "wire_crc32",
 ]
 
 
@@ -149,8 +152,15 @@ def start_server(
     server_id: int = 0,
     pull_timeout_ms: Optional[int] = None,
     enable_schedule: Optional[bool] = None,
+    lease_ms: Optional[int] = None,
 ) -> int:
-    """Start the native summation service in this process (non-blocking)."""
+    """Start the native summation service in this process (non-blocking).
+
+    ``lease_ms`` (default ``BYTEPS_WORKER_LEASE_MS``) > 0 arms elastic
+    worker membership: a worker silent past the lease is evicted, the
+    membership epoch bumps, open rounds re-target the live worker set,
+    and stuck barriers release (docs/robustness.md §elastic membership).
+    """
     global _INPROC_SERVER_ID
     cfg = get_config()
     lib = load_lib()
@@ -167,6 +177,7 @@ def start_server(
         server_id,
         1 if (enable_schedule if enable_schedule is not None
               else cfg.server_enable_schedule) else 0,
+        lease_ms if lease_ms is not None else cfg.worker_lease_ms,
     )
     if rc != 0:
         raise RuntimeError(f"bps_server_start failed (rc={rc}, port={port})")
@@ -263,7 +274,11 @@ class PSWorker:
         use_ipc: Optional[bool] = None,
         throttle_mbps: Optional[float] = None,
         fault_plan: Optional[FaultPlan] = None,
+        health_interval_ms: Optional[int] = None,
     ):
+        """``health_interval_ms`` overrides BYTEPS_HEALTH_INTERVAL_MS for
+        THIS worker (chaos tests arm a heartbeating survivor beside a
+        monitor-less victim in one process; None = the config value)."""
         cfg = get_config()
         self._servers = list(servers) if servers else server_addresses()
         self._timeout = timeout_ms
@@ -302,16 +317,41 @@ class PSWorker:
         self._live: Set[int] = set(range(len(self._servers)))
         self._epoch = 0  # bumped per failover; in-flight ops self-abort
         self._key_nbytes: Dict[int, int] = {}  # for post-failover re-init
+        # --- elastic worker membership (docs/robustness.md) ----------------
+        # per-server membership epoch (low 16 bits, stamped on every
+        # response) this worker has ADOPTED; a mismatch on any op
+        # triggers a kMembers query + adoption
+        self._epoch_seen: Dict[int, int] = {}
+        # (server, epoch16) -> live worker count at that epoch: pull
+        # responses carry the epoch their ROUND closed under, and the
+        # averaging divisor must be THAT epoch's live count — a round
+        # closed at full membership but delivered after an eviction must
+        # still divide by the full count. Seeded with epoch 0 = the
+        # configured membership.
+        self._epoch_live: Dict[Tuple[int, int], int] = {
+            (s, 0): max(1, cfg.num_worker)
+            for s in range(len(self._servers))
+        }
+        # live worker (pod) count per the most recent adoption — what
+        # averaging consumers divide by instead of the static
+        # DMLC_NUM_WORKER once the membership shrinks/grows
+        self._live_pods = max(1, cfg.num_worker)
+        # injected self-death (worker:kill) / wedge window (worker:hang)
+        self._self_killed = False
+        self._wedged_until = 0.0
         self.counters: Dict[str, int] = {
             "retries": 0, "timeouts": 0, "conn_errors": 0,
             "crc_errors": 0, "reinits": 0, "give_ups": 0,
             "failovers": 0, "ici_fallbacks": 0,
+            "membership_events": 0, "rejoins": 0,
         }
         self._counter_lock = threading.Lock()
         self._health: Optional[_HealthMonitor] = None
-        if cfg.health_interval_ms > 0 and len(self._servers) > 0:
+        hb_ms = (health_interval_ms if health_interval_ms is not None
+                 else cfg.health_interval_ms)
+        if hb_ms > 0 and len(self._servers) > 0:
             self._health = _HealthMonitor(
-                self, interval_ms=cfg.health_interval_ms,
+                self, interval_ms=hb_ms,
                 miss_limit=max(1, cfg.health_miss_limit))
             self._health.start()
 
@@ -335,12 +375,53 @@ class PSWorker:
     def _inject_pre(self, op: str, sidx: int):
         """Evaluate the fault plan for one wire attempt. 'kill'/'down'
         raise here (the request never leaves); 'timeout'/'corrupt' are
-        returned for the caller to act on around the real op."""
+        returned for the caller to act on around the real op. Worker-scope
+        rules simulate THIS process's death ('worker:kill' — sticky, every
+        later op refuses) or wedge ('worker:hang' — ops block out the
+        window, then report a lost response); both stop the lease
+        heartbeat so the server's eviction fires as for a real crash."""
+        if self._self_killed:
+            raise WorkerKilledError(
+                f"worker {self._worker_id} is dead (injected worker:kill); "
+                f"{op} refused")
+        rest = self._wedged_until - time.time()
+        if rest > 0:
+            time.sleep(rest)
+            self._kill_conn(sidx)
+            raise InjectedTimeout(
+                f"injected: worker {self._worker_id} wedged through {op} "
+                "(worker:hang window)")
         if self._plan is None:
             return None
         inj = self._plan.intercept(op, sidx)
         if inj is None:
             return None
+        if inj.rule.scope == "worker":
+            if inj.kind == "kill":
+                self._self_killed = True
+                self._trace_fault("worker_kill", op=op,
+                                  step=self._plan.step)
+                log.warning(
+                    "worker %d killed by injection at plan step %d",
+                    self._worker_id, self._plan.step)
+                # a dead process's sockets die with it
+                for s in list(getattr(self._tls, "conns", {})):
+                    self._kill_conn(s)
+                raise WorkerKilledError(
+                    f"injected: worker {self._worker_id} killed during "
+                    f"{op} (plan step {self._plan.step})")
+            if inj.kind == "hang":
+                self._wedged_until = (time.time()
+                                      + inj.rule.latency_ms / 1e3)
+                self._trace_fault("worker_hang", op=op,
+                                  ms=inj.rule.latency_ms)
+                time.sleep(inj.rule.latency_ms / 1e3)
+                self._kill_conn(sidx)
+                raise InjectedTimeout(
+                    f"injected: worker {self._worker_id} wedged for "
+                    f"{inj.rule.latency_ms} ms during {op}")
+            # other kinds under worker scope fall through to the generic
+            # handling below (e.g. worker:timeout = lose own responses)
         if inj.kind == "down":
             self._kill_conn(sidx)
             raise ServerDownError(
@@ -351,6 +432,12 @@ class PSWorker:
             raise InjectedConnectionError(
                 f"injected: connection to server {sidx} killed before {op}")
         return inj
+
+    def is_wedged(self) -> bool:
+        """True while a worker:hang window is open (the health monitor
+        stops heartbeating so the server lease can expire, exactly as a
+        really-wedged process would go silent)."""
+        return self._self_killed or self._wedged_until > time.time()
 
     def has_live_servers(self) -> bool:
         return bool(self._live)
@@ -415,6 +502,152 @@ class PSWorker:
         with self._vlock:
             live = set(self._live)
         return self._server_for_live(key, live)
+
+    # -- elastic worker membership (epoch adoption + rejoin) ----------------
+    def live_pods(self) -> int:
+        """Live WORKER (pod) count per the most recently adopted
+        membership epoch — what averaging consumers divide by instead of
+        the static DMLC_NUM_WORKER once a peer is evicted or rejoins."""
+        with self._vlock:
+            return max(1, self._live_pods)
+
+    def _note_epoch(self, sidx: int) -> None:
+        """Per-op membership-change detection: every server response
+        stamps the current epoch (header reserved field); on a mismatch
+        with the adopted one, query the live set and adopt it. Costs one
+        ctypes read per op — no extra round trip until a change."""
+        try:
+            if self._is_local(sidx):
+                e = int(load_lib().bps_server_epoch()) & 0xFFFF
+            else:
+                conn = getattr(self._tls, "conns", {}).get(sidx)
+                if conn is None:
+                    return
+                e = conn.epoch()
+        except Exception:  # noqa: BLE001 - detection is best-effort; the
+            return         # next op retries it
+        with self._vlock:
+            seen = self._epoch_seen.get(sidx, 0)
+        # adopt only a NEWER epoch (mod-2^16 window): a connection idle
+        # across the bump still reports the old stamp on its last parsed
+        # response, and adopting backwards would flap the live count
+        if e != seen and ((e - seen) & 0xFFFF) < 0x8000:
+            self._adopt_membership(sidx)
+
+    def _adopt_membership(self, sidx: int) -> None:
+        """Adopt a new membership epoch from server ``sidx`` (kMembers
+        query): refresh the live pod count (pull results under the new
+        epoch are sums over the LIVE set, so averaging must rescale
+        consistently), record the query's own (epoch, live) pair in the
+        divisor history, count the event, and land a MembershipEvent on
+        the chrome trace's FAULT track. Failure leaves the old epoch
+        adopted — the next op re-detects and retries."""
+        try:
+            if self._is_local(sidx):
+                import ctypes
+
+                lib = load_lib()
+                ep = ctypes.c_uint64(0)
+                live = ctypes.c_uint32(0)
+                bitmap = (ctypes.c_uint8 * 1024)()
+                n = lib.bps_server_members(
+                    ctypes.byref(ep), ctypes.byref(live), bitmap, 1024)
+                if n < 0:
+                    return
+                q_epoch = int(ep.value)
+                live_count = int(live.value)
+                bits = bytes(bitmap[: min(n, 1024)])
+            else:
+                q_epoch, live_count, bits = self._conn(sidx).members()
+        except Exception as e:  # noqa: BLE001 - adoption retried next op
+            log.debug("membership query on server %d failed: %s", sidx, e)
+            return
+        # the (epoch, live) pair must come from the QUERY's atomic view:
+        # the trigger stamp `epoch16` may be older than the membership
+        # the query answered for (another change landed in between), and
+        # caching the new count under the old epoch would poison that
+        # epoch's averaging divisor permanently
+        q_epoch16 = q_epoch & 0xFFFF
+        # plain bool: bits is a numpy array and an np.bool_ leaking into
+        # the trace args breaks the chrome-trace JSON dump
+        evicted_self = bool(self._worker_id < len(bits)
+                            and bits[self._worker_id] == 0)
+        with self._vlock:
+            self._epoch_live[(sidx, q_epoch16)] = max(1, int(live_count))
+            seen = self._epoch_seen.get(sidx, 0)
+            if (q_epoch16 == seen
+                    or ((q_epoch16 - seen) & 0xFFFF) >= 0x8000):
+                return  # another pool thread already adopted this epoch
+            self._epoch_seen[sidx] = q_epoch16
+            self._live_pods = max(1, int(live_count))
+        self._count("membership_events")
+        self._trace_fault("membership", server=sidx, epoch=q_epoch16,
+                          live_pods=int(live_count),
+                          evicted_self=evicted_self)
+        log.warning(
+            "membership epoch %d adopted from server %d: %d live "
+            "worker(s)%s", q_epoch16, sidx, live_count,
+            " — THIS worker is evicted (rejoin on next push)"
+            if evicted_self else "")
+
+    def _live_at(self, sidx: int, epoch16: int) -> int:
+        """Live worker count at ``epoch16`` on server ``sidx`` — the
+        divisor for a round that CLOSED under that epoch. Unknown epochs
+        (the round's close was the first sign of a membership change)
+        adopt the current membership and retry the lookup; the final
+        fallback is the currently adopted live count."""
+        with self._vlock:
+            v = self._epoch_live.get((sidx, epoch16))
+        if v is not None:
+            return v
+        self._note_epoch(sidx)
+        with self._vlock:
+            return self._epoch_live.get((sidx, epoch16),
+                                        max(1, self._live_pods))
+
+    def last_round_live(self) -> Optional[int]:
+        """Live worker count of the round the calling thread's most
+        recent :meth:`pull_bytes` returned — what averaging consumers
+        divide by for THAT round (``None`` before any pull). Thread-local,
+        like the connections themselves."""
+        return getattr(self._tls, "round_live", None)
+
+    def sync_rounds(self, sidx: int) -> None:
+        """Adopt server ``sidx``'s per-key (round, nbytes) watermarks —
+        the restart/rejoin half of the ``export_rounds``/``adopt_rounds``
+        handshake: the server's store (and its (worker, key, version)
+        replay-dedupe watermark) outlives this worker, so a fresh round
+        counter would mint versions the dedupe silently drops — a
+        permanent per-key stall. Max-merge via :meth:`adopt_rounds`;
+        sizes seed the lazy re-init of inherited keys."""
+        trips = self._conn(sidx).rounds()
+        self.adopt_rounds(
+            {int(k): int(v) for k, v, _ in trips},
+            {int(k): int(nb) for k, _, nb in trips},
+        )
+
+    def rejoin(self) -> None:
+        """Re-register with every live server after an eviction or a
+        process restart: heartbeat with the worker id (the server
+        re-admits and bumps the epoch), then adopt round watermarks so
+        the next mint continues the server's round sequence. Invoked
+        automatically when a push is refused with 'worker evicted'; also
+        the public entry for a restarted process resuming from a
+        checkpoint against a still-running server tier."""
+        with self._vlock:
+            live = sorted(self._live)
+        for sidx in live:
+            try:
+                self.ping(sidx)        # heartbeat: re-admit + epoch bump
+                self.sync_rounds(sidx)
+                self._note_epoch(sidx)
+            except Exception as e:  # noqa: BLE001 - a dead server cannot
+                # block the rejoin against the live ones; its own
+                # failover path owns it
+                log.warning("rejoin against server %d failed: %s: %s",
+                            sidx, type(e).__name__, e)
+        self._count("rejoins")
+        self._trace_fault("rejoin", servers=live)
 
     # -- connection management ----------------------------------------------
     def _conn(self, sidx: int) -> NativeClient:
@@ -481,8 +714,23 @@ class PSWorker:
                     f"{op} key {key}: placement moved {sidx0}->{sidx} "
                     f"(failover epoch {epoch}); round abandoned")
             try:
-                return attempt_fn(sidx)
+                result = attempt_fn(sidx)
+                self._note_epoch(sidx)
+                return result
             except BaseException as e:  # noqa: BLE001 - classified below
+                self._note_epoch(sidx)
+                if isinstance(e, WorkerEvictedError):
+                    # the server refuses this worker until it rejoins:
+                    # heartbeat re-admit + round-watermark adoption here,
+                    # then escalate stage-retryably — the op's pinned
+                    # round predates the adopted watermarks, so the stage
+                    # re-run must mint afresh (push stages clear the pin
+                    # on this error class)
+                    log.warning(
+                        "%s key %d refused: worker %d evicted; rejoining",
+                        op, key, self._worker_id)
+                    self.rejoin()
+                    raise
                 if (isinstance(e, RuntimeError) and "before init" in str(e)
                         and key in self._key_nbytes
                         and attempt < self._retry_limit):
@@ -649,6 +897,10 @@ class PSWorker:
                     self._worker_id, key, codec, version,
                     b.ctypes.data, b.nbytes,
                 )
+                if rc == -11:
+                    raise WorkerEvictedError(
+                        f"local push of key {key} rejected: worker "
+                        f"{self._worker_id} evicted; rejoin required")
                 if rc != 0:
                     raise RuntimeError(f"local push failed (rc={rc})")
                 return
@@ -683,22 +935,32 @@ class PSWorker:
         def attempt(sidx):
             out = np.empty(capacity, np.uint8)
             if self._is_local(sidx):
-                got = load_lib().bps_local_pull(
+                import ctypes
+
+                ep = ctypes.c_uint64(0)
+                got = load_lib().bps_local_pull2(
                     key, codec, version, self._recv_timeout,
-                    out.ctypes.data, out.nbytes,
+                    out.ctypes.data, out.nbytes, ctypes.byref(ep),
                 )
                 if got < 0:
                     raise RuntimeError(f"local pull failed (rc={got})")
                 if self.pacer is not None:
                     self.pacer.throttle_recv(int(got))
+                # same divisor contract as the TCP header stamp: the
+                # epoch the returned ROUND closed under
+                self._tls.round_live = self._live_at(
+                    sidx, int(ep.value) & 0xFFFF)
                 return out, int(got)
             inj = self._inject_pre("pull", sidx)
             conn = self._conn(sidx)
             if self._crc:
                 got, resp_crc = conn.pull(key, out, version, codec,
-                                          want_crc=True)
+                                          want_crc=True,
+                                          worker_id=self._worker_id)
             else:
-                got, resp_crc = conn.pull(key, out, version, codec), 0
+                got, resp_crc = conn.pull(
+                    key, out, version, codec,
+                    worker_id=self._worker_id), 0
             if self.pacer is not None:
                 # book the response's transmission time per ATTEMPT
                 # (downstream direction): a lost/corrupted response still
@@ -716,6 +978,11 @@ class PSWorker:
                 raise WireCorruption(
                     f"pull response for key {key} failed CRC "
                     f"(server {sidx}); retrying")
+            # the response header carries the epoch this ROUND closed
+            # under — resolve the round's own live count (divisor
+            # authority for averaging; the current epoch may be newer)
+            self._tls.round_live = self._live_at(sidx,
+                                                 conn.last_pull_epoch())
             return out, int(got)
 
         out, got = self._retry_loop("pull", key, attempt)
@@ -741,17 +1008,21 @@ class PSWorker:
     def barrier(self) -> None:
         """Global worker barrier through the lowest LIVE server (server 0
         while healthy — reference: ps-lite Postoffice::Barrier via the
-        scheduler; after a failover the survivors host it)."""
+        scheduler; after a failover the survivors host it). Carries the
+        worker id: a barrier wait can outlast a short membership lease,
+        and the arrival itself refreshes it."""
         with self._vlock:
             sidx = min(self._live) if self._live else 0
-        self._conn(sidx).barrier()
+        self._conn(sidx).barrier(self._worker_id)
 
     def ping(self, sidx: int = 0) -> Tuple[int, int]:
         """(server CLOCK_REALTIME ns, rtt ns) for clock alignment of merged
         worker/server traces (SURVEY §5.1 dPRO clock-offset capability).
-        Also the health monitor's probe — injected down windows fail it."""
+        Also the health monitor's probe — injected down windows fail it —
+        and, carrying the worker id, the membership lease HEARTBEAT (an
+        evicted worker's ping re-admits it)."""
         self._inject_pre("ping", sidx)
-        return self._conn(sidx).ping()
+        return self._conn(sidx).ping(self._worker_id)
 
     def clock_offset_ns(self, sidx: int = 0) -> int:
         """Estimated server_clock − local_clock in ns (RTT/2 method)."""
@@ -809,7 +1080,10 @@ class PSWorker:
                     c = NativeClient(host, port, 2000, self._recv_timeout)
                     with self._conn_lock:
                         self._all_conns.append(c)
-                c.shutdown()
+                # identified goodbye: the membership layer marks this
+                # worker DEPARTED, so the server can exit even if a PEER
+                # died without one (departed + evicted covers everyone)
+                c.shutdown(self._worker_id)
             except Exception as e:  # noqa: BLE001 - server may already be
                 # gone (it stops itself once every worker said shutdown,
                 # and a chaos run may have killed it outright) — expected
@@ -826,12 +1100,17 @@ class PSWorker:
 
     def get_counters(self) -> Dict[str, int]:
         """Robustness counters (+ per-kind injected counts when a fault
-        plan is armed) — what the chaos smoke and the bench assert on."""
+        plan is armed, + the health monitor's last-probe age and
+        per-server miss counts so a stall report shows WHY failover did
+        or did not fire) — what the chaos smoke and the bench assert on."""
         with self._counter_lock:
             out = dict(self.counters)
+        out["live_pods"] = self.live_pods()
         if self._plan is not None:
             for k, v in self._plan.counters().items():
                 out[f"injected_{k}"] = v
+        if self._health is not None:
+            out.update(self._health.debug_counters())
         return out
 
     def export_counters(self, tag: Optional[str] = None) -> None:
@@ -871,10 +1150,35 @@ class _HealthMonitor:
         self._probe_ms = max(500, 4 * interval_ms)
         self._miss_limit = miss_limit
         self._misses: Dict[int, int] = {}
+        # debuggability (stall reports): per-server CUMULATIVE miss count
+        # and the monotonic time of the last finished probe attempt.
+        # _dbg_lock guards these against debug_counters() readers — a
+        # stall report must never crash on "dict changed during
+        # iteration" while the monitor records its first miss.
+        self._total_misses: Dict[int, int] = {}
+        self._last_probe: Dict[int, float] = {}
+        self._dbg_lock = threading.Lock()
         self._conns: Dict[int, NativeClient] = {}
         self._stop_ev = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="bps-health", daemon=True)
+
+    def debug_counters(self) -> Dict[str, int]:
+        """Folded into PSWorker.get_counters(): per-server consecutive +
+        cumulative miss counts and the age of the newest probe — a stall
+        report then shows whether the monitor was even looking, and how
+        close each server sat to the miss limit."""
+        now = time.monotonic()
+        out: Dict[str, int] = {}
+        with self._dbg_lock:
+            for sidx, n in sorted(self._misses.items()):
+                out[f"health_consec_miss_s{sidx}"] = n
+            for sidx, n in sorted(self._total_misses.items()):
+                out[f"health_misses_s{sidx}"] = n
+            if self._last_probe:
+                age = now - max(self._last_probe.values())
+                out["health_last_probe_age_ms"] = int(age * 1e3)
+        return out
 
     def start(self) -> None:
         self._thread.start()
@@ -895,20 +1199,36 @@ class _HealthMonitor:
             host, port = self._worker._servers[sidx]
             c = NativeClient(host, port, self._probe_ms, self._probe_ms)
             self._conns[sidx] = c
-        c.ping()
+        # the probe doubles as this worker's membership lease HEARTBEAT
+        # (and re-admits it after an eviction, e.g. a worker:hang window
+        # that outlasted the lease)
+        c.ping(self._worker._worker_id)
 
     def _run(self) -> None:
         try:
             while not self._stop_ev.wait(self._interval):
+                if self._worker.is_wedged():
+                    # a dead/wedged process heartbeats nothing: going
+                    # silent here is exactly what lets the server lease
+                    # evict this worker on schedule
+                    continue
                 for sidx in sorted(self._worker.live_servers()):
                     if self._stop_ev.is_set():
                         return
                     try:
                         self._probe(sidx)
-                        self._misses[sidx] = 0
+                        with self._dbg_lock:
+                            self._last_probe[sidx] = time.monotonic()
+                            self._misses[sidx] = 0
+                    except WorkerKilledError:
+                        return  # injected process death: no more probes
                     except Exception as e:  # noqa: BLE001 - miss
-                        n = self._misses.get(sidx, 0) + 1
-                        self._misses[sidx] = n
+                        with self._dbg_lock:
+                            self._last_probe[sidx] = time.monotonic()
+                            n = self._misses.get(sidx, 0) + 1
+                            self._misses[sidx] = n
+                            self._total_misses[sidx] = (
+                                self._total_misses.get(sidx, 0) + 1)
                         log.debug(
                             "heartbeat miss %d/%d for server %d (%s)",
                             n, self._miss_limit, sidx, e)

@@ -13,9 +13,20 @@ extern "C" {
 
 int bps_server_start(uint16_t port, int num_workers, int engine_threads,
                      int async_mode, int pull_timeout_ms, int server_id,
-                     int enable_schedule) {
+                     int enable_schedule, int lease_ms) {
   return bps::StartServer(port, num_workers, engine_threads, async_mode != 0,
-                          pull_timeout_ms, server_id, enable_schedule != 0);
+                          pull_timeout_ms, server_id, enable_schedule != 0,
+                          lease_ms);
+}
+
+// Elastic-membership observability: the in-process server's epoch and
+// live worker set (the IPC analog of the epoch every TCP response
+// carries).
+uint64_t bps_server_epoch() { return bps::ServerEpoch(); }
+
+int bps_server_members(uint64_t* epoch, uint32_t* live_count,
+                       uint8_t* bitmap, uint32_t cap) {
+  return bps::ServerMembers(epoch, live_count, bitmap, cap);
 }
 
 void bps_server_wait() { bps::WaitServer(); }
@@ -66,6 +77,21 @@ int64_t bps_local_pull(uint64_t key, uint8_t codec, uint64_t version,
   return static_cast<int64_t>(blob.size());
 }
 
+// As bps_local_pull, additionally surfacing the membership epoch the
+// returned ROUND closed under (the IPC analog of the TCP response
+// header's stamp — the averaging divisor authority).
+int64_t bps_local_pull2(uint64_t key, uint8_t codec, uint64_t version,
+                        int timeout_ms, void* out, uint64_t cap,
+                        uint64_t* out_epoch) {
+  std::vector<char> blob;
+  int rc = bps::LocalPull(key, codec, version, timeout_ms, &blob,
+                          out_epoch);
+  if (rc != 0) return rc;
+  if (blob.size() > cap) return -5;
+  std::memcpy(out, blob.data(), blob.size());
+  return static_cast<int64_t>(blob.size());
+}
+
 // ---- TCP client -----------------------------------------------------------
 void* bps_client_connect(const char* host, uint16_t port, int timeout_ms,
                          int recv_timeout_ms) {
@@ -106,23 +132,56 @@ int bps_client_pull(void* client, uint64_t key, void* data, uint64_t nbytes,
 // Checksummed pull: want_crc != 0 asks the server to checksum the
 // response; *out_crc receives it (caller verifies — kept out of the C
 // layer so the fault-injection harness can corrupt the buffer first).
+// `worker_id` >= 0 refreshes the worker's membership lease server-side;
+// *out_epoch receives the membership epoch the pulled ROUND closed
+// under (low 16 bits — the divisor authority for averaging).
 int bps_client_pull2(void* client, uint64_t key, void* data,
                      uint64_t nbytes, uint64_t version, uint8_t codec,
-                     int want_crc, uint64_t* out_bytes, uint32_t* out_crc) {
-  return static_cast<bps::Client*>(client)->Pull(
-      key, data, nbytes, version, codec, out_bytes, want_crc != 0, out_crc);
+                     int want_crc, uint64_t* out_bytes, uint32_t* out_crc,
+                     int worker_id, uint32_t* out_epoch) {
+  uint16_t ep = 0;
+  int rc = static_cast<bps::Client*>(client)->Pull(
+      key, data, nbytes, version, codec, out_bytes, want_crc != 0, out_crc,
+      worker_id, &ep);
+  if (out_epoch != nullptr) *out_epoch = ep;
+  return rc;
 }
 
-int bps_client_barrier(void* client) {
-  return static_cast<bps::Client*>(client)->Barrier();
+// `worker_id` >= 0 identifies the worker to the server's membership
+// layer (lease refresh on barrier, DEPARTED marking on shutdown, lease
+// heartbeat + rejoin on ping); -1 keeps the anonymous legacy frame.
+int bps_client_barrier(void* client, int worker_id) {
+  return static_cast<bps::Client*>(client)->Barrier(worker_id);
 }
 
-int bps_client_shutdown(void* client) {
-  return static_cast<bps::Client*>(client)->Shutdown();
+int bps_client_shutdown(void* client, int worker_id) {
+  return static_cast<bps::Client*>(client)->Shutdown(worker_id);
 }
 
-int bps_client_ping(void* client, int64_t* server_ns, int64_t* rtt_ns) {
-  return static_cast<bps::Client*>(client)->Ping(server_ns, rtt_ns);
+int bps_client_ping(void* client, int64_t* server_ns, int64_t* rtt_ns,
+                    int worker_id) {
+  return static_cast<bps::Client*>(client)->Ping(server_ns, rtt_ns,
+                                                 worker_id);
+}
+
+// Membership epoch (low 16 bits) stamped on the last response this client
+// parsed — polled per op by the worker to detect membership changes.
+int bps_client_epoch(void* client) {
+  return static_cast<int>(static_cast<bps::Client*>(client)->epoch());
+}
+
+int bps_client_members(void* client, uint64_t* epoch, uint32_t* live_count,
+                       uint32_t* num_workers, uint8_t* bitmap,
+                       uint32_t cap) {
+  return static_cast<bps::Client*>(client)->Members(
+      epoch, live_count, num_workers, bitmap, cap);
+}
+
+// Per-key (u64 key, u64 round, u64 nbytes) watermark triples into `out`;
+// *got = bytes written. The rejoin round-adoption handshake.
+int bps_client_rounds(void* client, void* out, uint64_t cap,
+                      uint64_t* got) {
+  return static_cast<bps::Client*>(client)->Rounds(out, cap, got);
 }
 
 const char* bps_client_last_error(void* client) {

@@ -1,5 +1,6 @@
 #include "client.h"
 
+#include <algorithm>
 #include <chrono>
 #include <cstdio>
 #include <cstring>
@@ -93,7 +94,8 @@ int Client::Roundtrip(Cmd cmd, uint64_t key, uint64_t version,
                       const void* req, uint32_t req_len, void* in,
                       uint64_t in_cap, uint64_t* got, uint8_t flags,
                       uint16_t reserved, uint64_t* resp_version,
-                      uint32_t req_crc, uint32_t* resp_crc) {
+                      uint32_t req_crc, uint32_t* resp_crc,
+                      uint16_t* resp_reserved) {
   if (fd_ < 0) return -2;
   if (!send_frame(fd_, cmd, key, version, req, req_len, flags, reserved,
                   req_crc)) {
@@ -116,6 +118,12 @@ int Client::Roundtrip(Cmd cmd, uint64_t key, uint64_t version,
     Kill();
     return -6;
   }
+  // every server response stamps a membership epoch into reserved (pull
+  // responses: the epoch their ROUND closed under; everything else: the
+  // current epoch); remember it so the owner can detect evictions and
+  // rejoins per op
+  epoch_.store(h.reserved, std::memory_order_relaxed);
+  if (resp_reserved != nullptr) *resp_reserved = h.reserved;
   if (h.cmd == kErr) {
     std::vector<char> msg(h.len);
     if (h.len > 0 && !recv_all(fd_, msg.data(), h.len)) {
@@ -169,38 +177,83 @@ int Client::Push(uint64_t key, const void* data, uint64_t nbytes,
 
 int Client::Pull(uint64_t key, void* data, uint64_t nbytes, uint64_t version,
                  uint8_t codec, uint64_t* out_bytes, bool want_crc,
-                 uint32_t* out_crc) {
+                 uint32_t* out_crc, int worker_id, uint16_t* out_epoch) {
   std::lock_guard<std::mutex> lk(mu_);
+  const uint16_t wid =
+      worker_id >= 0 ? static_cast<uint16_t>(worker_id + 1) : 0;
   // request crc = 1 is the "checksum the response" marker (any nonzero
   // value works; the pull request itself has no payload to checksum)
   return Roundtrip(kPull, key, version, nullptr, 0, data, nbytes,
-                   out_bytes, codec, 0, nullptr, want_crc ? 1u : 0u,
-                   out_crc);
+                   out_bytes, codec, wid, nullptr, want_crc ? 1u : 0u,
+                   out_crc, out_epoch);
 }
 
-int Client::Barrier() {
+int Client::Barrier(int worker_id) {
   std::lock_guard<std::mutex> lk(mu_);
+  const uint16_t wid =
+      worker_id >= 0 ? static_cast<uint16_t>(worker_id + 1) : 0;
   return Roundtrip(kBarrier, 0, 0, nullptr, 0, nullptr, 0, nullptr, 0,
-                   0, nullptr);
+                   wid, nullptr);
 }
 
-int Client::Shutdown() {
+int Client::Shutdown(int worker_id) {
   std::lock_guard<std::mutex> lk(mu_);
+  const uint16_t wid =
+      worker_id >= 0 ? static_cast<uint16_t>(worker_id + 1) : 0;
   return Roundtrip(kShutdown, 0, 0, nullptr, 0, nullptr, 0, nullptr, 0,
-                   0, nullptr);
+                   wid, nullptr);
 }
 
-int Client::Ping(int64_t* server_ns, int64_t* rtt_ns) {
+int Client::Ping(int64_t* server_ns, int64_t* rtt_ns, int worker_id) {
   std::lock_guard<std::mutex> lk(mu_);
   const int64_t t0 = steady_ns();
   uint64_t sv = 0;
+  const uint16_t wid =
+      worker_id >= 0 ? static_cast<uint16_t>(worker_id + 1) : 0;
   int rc = Roundtrip(kPing, 0, 0, nullptr, 0, nullptr, 0, nullptr, 0,
-                     0, &sv);
+                     wid, &sv);
   if (rc == 0) {
     if (server_ns != nullptr) *server_ns = static_cast<int64_t>(sv);
     if (rtt_ns != nullptr) *rtt_ns = steady_ns() - t0;
   }
   return rc;
+}
+
+int Client::Members(uint64_t* epoch, uint32_t* live_count,
+                    uint32_t* num_workers, uint8_t* bitmap, uint32_t cap) {
+  std::lock_guard<std::mutex> lk(mu_);
+  // payload: u32 live_count | u32 num_workers | u8 live[num_workers]
+  std::vector<char> buf(8 + 65536);
+  uint64_t got = 0;
+  uint64_t ep = 0;
+  int rc = Roundtrip(kMembers, 0, 0, nullptr, 0, buf.data(), buf.size(),
+                     &got, 0, 0, &ep);
+  if (rc != 0) return rc;
+  if (got < 8) {
+    Kill();
+    return -4;
+  }
+  uint32_t live = 0;
+  uint32_t nw = 0;
+  std::memcpy(&live, buf.data(), 4);
+  std::memcpy(&nw, buf.data() + 4, 4);
+  if (got < 8 + nw) {
+    Kill();
+    return -4;
+  }
+  if (epoch != nullptr) *epoch = ep;
+  if (live_count != nullptr) *live_count = live;
+  if (num_workers != nullptr) *num_workers = nw;
+  if (bitmap != nullptr && nw > 0) {
+    std::memcpy(bitmap, buf.data() + 8, std::min(nw, cap));
+  }
+  return 0;
+}
+
+int Client::Rounds(void* out, uint64_t cap, uint64_t* got) {
+  std::lock_guard<std::mutex> lk(mu_);
+  return Roundtrip(kRounds, 0, 0, nullptr, 0, out, cap, got, 0, 0,
+                   nullptr);
 }
 
 }  // namespace bps

@@ -17,6 +17,7 @@
 // with -2; the owner reconnects or reports.
 #pragma once
 
+#include <atomic>
 #include <cstdint>
 #include <mutex>
 #include <string>
@@ -49,14 +50,35 @@ class Client {
   // server-computed wire_crc of the payload (0 when not requested) for
   // the CALLER to verify — verification is deliberately not done here so
   // the fault-injection layer can corrupt the buffer in between.
+  // `worker_id` >= 0 rides the request so the server refreshes that
+  // worker's membership lease (a worker blocked in a long pull is alive).
+  // *out_epoch receives the membership epoch the pulled ROUND closed
+  // under (its header stamp) — the divisor authority for averaging.
   int Pull(uint64_t key, void* data, uint64_t nbytes, uint64_t version,
            uint8_t codec, uint64_t* out_bytes, bool want_crc = false,
-           uint32_t* out_crc = nullptr);
-  int Barrier();
-  int Shutdown();
+           uint32_t* out_crc = nullptr, int worker_id = -1,
+           uint16_t* out_epoch = nullptr);
+  // `worker_id` >= 0 rides the barrier/shutdown frame so the server can
+  // refresh the worker's lease (barrier) or mark it DEPARTED (shutdown);
+  // -1 keeps the anonymous legacy frame.
+  int Barrier(int worker_id = -1);
+  int Shutdown(int worker_id = -1);
   // Clock-offset probe: *server_ns = server CLOCK_REALTIME at serve time,
   // *rtt_ns = local round-trip (offset ≈ server_ns + rtt/2 − local_now).
-  int Ping(int64_t* server_ns, int64_t* rtt_ns);
+  // `worker_id` >= 0 makes the probe the worker's membership lease
+  // HEARTBEAT (and the rejoin signal for an evicted worker).
+  int Ping(int64_t* server_ns, int64_t* rtt_ns, int worker_id = -1);
+  // Membership query: *epoch, *live_count, and up to `cap` bytes of the
+  // per-worker live bitmap; *num_workers = configured worker count.
+  int Members(uint64_t* epoch, uint32_t* live_count, uint32_t* num_workers,
+              uint8_t* bitmap, uint32_t cap);
+  // Per-key round watermarks (u64 key, u64 round, u64 nbytes triples)
+  // into `out` (cap bytes); *got = actual bytes. The rejoin handshake.
+  int Rounds(void* out, uint64_t cap, uint64_t* got);
+  // Membership epoch (low 16 bits) carried by the LAST response this
+  // client parsed — workers poll it per op to detect membership changes
+  // without an extra round trip.
+  uint16_t epoch() const { return epoch_.load(std::memory_order_relaxed); }
   const char* last_error() const { return last_err_.c_str(); }
   // True once a desynchronizing error closed the socket; the owner should
   // drop this client and connect a fresh one.
@@ -66,7 +88,8 @@ class Client {
   int Roundtrip(Cmd cmd, uint64_t key, uint64_t version, const void* req,
                 uint32_t req_len, void* in, uint64_t in_cap, uint64_t* got,
                 uint8_t flags, uint16_t reserved, uint64_t* resp_version,
-                uint32_t req_crc = 0, uint32_t* resp_crc = nullptr);
+                uint32_t req_crc = 0, uint32_t* resp_crc = nullptr,
+                uint16_t* resp_reserved = nullptr);
   // Close the socket after a stream-desynchronizing error; later calls
   // return -2 instead of misparsing stale frames.
   void Kill();
@@ -74,6 +97,7 @@ class Client {
   int fd_ = -1;
   std::mutex mu_;
   std::string last_err_;
+  std::atomic<uint16_t> epoch_{0};
 };
 
 }  // namespace bps

@@ -16,10 +16,23 @@
 //             from the worker retry engine instead of double-summing),
 //             crc = wire_crc of payload (0 = unchecked)
 //   kPull     flags = desired response codec, version = min round,
-//             crc != 0 requests a checksummed response
+//             reserved = worker_id + 1 (0 = anonymous; nonzero refreshes
+//             the worker's membership lease), crc != 0 requests a
+//             checksummed response
 //   kResp     flags = codec, version = round, payload = encoded result,
 //             crc = wire_crc of payload when the pull asked for it
-//   kPing     -> kAck with version = server CLOCK_REALTIME ns (clock align)
+//   kPing     reserved = worker_id + 1 (0 = anonymous clock probe;
+//             nonzero is the worker's lease HEARTBEAT and re-admits an
+//             evicted worker) -> kAck with version = server
+//             CLOCK_REALTIME ns (clock align)
+//   kMembers  -> kResp with version = membership epoch, payload =
+//             u32 live_count | u32 num_workers | u8 live[num_workers]
+//   kRounds   -> kResp, payload = (u64 key, u64 round, u64 nbytes)*
+//             for every key store — the rejoin round-watermark handshake
+//
+// Every server->worker frame carries the current membership EPOCH in the
+// header's reserved field (low 16 bits): workers learn of membership
+// changes on their next op and query kMembers for the full live set.
 #pragma once
 
 #include <array>
@@ -55,7 +68,9 @@ enum Cmd : uint8_t {
   kShutdown = 6,  // connection is done
   kAck = 7,       // empty acknowledgement
   kErr = 8,       // payload = error string
-  kPing = 9,      // clock-offset probe
+  kPing = 9,      // clock-offset probe / worker lease heartbeat
+  kMembers = 10,  // membership query: epoch + live worker bitmap
+  kRounds = 11,   // per-key round watermarks (rejoin adoption)
 };
 
 #pragma pack(push, 1)

@@ -113,7 +113,8 @@ void local_body(std::atomic<int>* failures) {
 int main() {
   if (bps::StartServer(kPort, kWorkers, /*engine_threads=*/2,
                        /*async=*/false, /*pull_timeout_ms=*/20000,
-                       /*server_id=*/0, /*schedule=*/true) != 0) {
+                       /*server_id=*/0, /*schedule=*/true,
+                       /*lease_ms=*/5000) != 0) {
     std::fprintf(stderr, "server start failed\n");
     return 1;
   }
@@ -129,6 +130,52 @@ int main() {
   {
     bps::Client c;
     if (c.Connect("127.0.0.1", kPort, 5000, 20000) != 0) {
+      failures.fetch_add(1);
+    }
+  }
+
+  // lease eviction under live traffic: worker 1 goes silent, worker 0
+  // heartbeats (kPing with worker id) while its pull blocks on a round
+  // worker 1 will never push — the sweep thread's eviction must close the
+  // round over the live set and answer the pull, with membership state
+  // (lease refresh / epoch stamp / Members query) racing the data plane
+  {
+    bps::Client c;
+    if (c.Connect("127.0.0.1", kPort, 5000, 30000) == 0) {
+      const uint64_t key = 3000;
+      std::vector<float> data(kElems, 5.0f);
+      std::vector<float> out(kElems);
+      if (c.InitKey(key, kElems * 4) != 0 ||
+          c.Push(key, data.data(), kElems * 4, 0, /*worker=*/0,
+                 /*version=*/1) != 0) {
+        failures.fetch_add(1);
+      } else {
+        std::atomic<bool> hb_stop{false};
+        std::thread hb([&hb_stop] {
+          bps::Client h;
+          if (h.Connect("127.0.0.1", kPort, 5000, 5000) != 0) return;
+          while (!hb_stop.load()) {
+            int64_t sns = 0, rtt = 0;
+            h.Ping(&sns, &rtt, /*worker_id=*/0);
+            uint64_t ep = 0;
+            uint32_t live = 0, nw = 0;
+            uint8_t bitmap[16] = {0};
+            h.Members(&ep, &live, &nw, bitmap, sizeof(bitmap));
+            std::this_thread::sleep_for(std::chrono::milliseconds(100));
+          }
+        });
+        uint64_t got = 0;
+        int rc = c.Pull(key, out.data(), kElems * 4, 1, 0, &got);
+        hb_stop.store(true);
+        hb.join();
+        if (rc != 0 || got != kElems * 4 || out[0] != 5.0f) {
+          std::fprintf(stderr,
+                       "lease phase: pull rc=%d got=%llu out0=%f\n", rc,
+                       static_cast<unsigned long long>(got), out[0]);
+          failures.fetch_add(1);
+        }
+      }
+    } else {
       failures.fetch_add(1);
     }
   }

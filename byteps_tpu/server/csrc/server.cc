@@ -112,6 +112,12 @@ struct DeferredPush {
 struct KeyStore {
   std::mutex mu;
   std::condition_variable cv;  // local (in-process) pulls wait here
+  // Membership epoch at the moment `result`'s round CLOSED: pull
+  // responses are stamped with THIS (not the send-time epoch), so a
+  // survivor averaging a round that closed under the old membership
+  // divides by the old live count even when the response is delivered
+  // after a later eviction bumped the epoch.
+  uint64_t result_epoch = 0;
   // Dense element count, immutable after creation. Validation MUST read
   // this, not accum.size(): a closing round MOVES accum out and
   // reallocates it under mu, so an unlocked accum.size() can observe 0
@@ -153,8 +159,10 @@ enum TraceStage : uint8_t {
   kTrSum = 1,
   kTrPullResp = 2,
   kTrRound = 3,
+  kTrMember = 4,  // key = worker id, len = live count, codec = 1 rejoin
 };
-const char* kTraceStageName[] = {"PUSH_RECV", "SUM", "PULL_RESP", "ROUND"};
+const char* kTraceStageName[] = {"PUSH_RECV", "SUM", "PULL_RESP", "ROUND",
+                                 "MEMBER"};
 
 struct TraceEv {
   int64_t ts_us;
@@ -170,12 +178,25 @@ constexpr size_t kMaxTraceEvents = 1u << 21;
 class Server {
  public:
   int Start(uint16_t port, int num_workers, int engine_threads, bool async,
-            int pull_timeout_ms, int server_id, bool schedule) {
+            int pull_timeout_ms, int server_id, bool schedule,
+            int lease_ms) {
     num_workers_ = num_workers;
     async_ = async;
     pull_timeout_ms_ = pull_timeout_ms;
     server_id_ = server_id;
     schedule_ = schedule;
+    lease_ms_ = lease_ms;
+    // membership starts fully live even with the lease disabled, so every
+    // live-set consumer (round completion, barriers, shutdown gate) reads
+    // one uniform source of truth
+    member_state_.assign(num_workers_, kLive);
+    last_seen_ms_.assign(num_workers_, steady_ms());
+    live_workers_.store(num_workers_);
+    epoch_.store(0);
+    {
+      std::lock_guard<std::mutex> lk(members_mu_);
+      PublishMembersLocked();
+    }
     engine_ = std::make_unique<ThreadPool>(engine_threads);
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     if (listen_fd_ < 0) return -1;
@@ -196,10 +217,27 @@ class Server {
     }
     running_ = true;
     accept_thread_ = std::thread([this] { AcceptLoop(); });
-    if (pull_timeout_ms_ > 0) {
+    if (pull_timeout_ms_ > 0 || lease_ms_ > 0) {
       sweep_thread_ = std::thread([this] { SweepLoop(); });
     }
     return 0;
+  }
+
+  uint64_t Epoch() const { return epoch_.load(); }
+
+  int MembersInfo(uint64_t* epoch, uint32_t* live_count, uint8_t* bitmap,
+                  uint32_t cap) {
+    auto m = Members();
+    // the SNAPSHOT's epoch, never a fresh epoch_.load(): a concurrent
+    // membership change must not label an old live count with a new
+    // epoch (workers cache epoch->live as the averaging divisor)
+    if (epoch != nullptr) *epoch = m->epoch;
+    if (live_count != nullptr) *live_count = m->count;
+    if (bitmap != nullptr && !m->live.empty()) {
+      std::memcpy(bitmap, m->live.data(),
+                  std::min<size_t>(cap, m->live.size()));
+    }
+    return static_cast<int>(m->live.size());
   }
 
   void Wait() {
@@ -306,6 +344,18 @@ class Server {
     KeyStore* ks = Get(key);
     if (ks == nullptr) return -1;
     if (!async_ && worker >= num_workers_) return -2;
+    // IPC analog of the TCP path's "worker evicted" kErr
+    if (!async_ && !WorkerLive(worker)) return -11;
+    if (!async_ && lease_ms_ > 0 && version != 0) {
+      // stale-round guard (see the kPush handler): a round the worker
+      // was evicted out of closed without it — reject, don't sum
+      std::lock_guard<std::mutex> lk(ks->mu);
+      if (version <= ks->version && worker < ks->applied_version.size() &&
+          version > ks->applied_version[worker]) {
+        return -11;
+      }
+    }
+    Touch(worker, /*admit=*/false);
     const int64_t n = static_cast<int64_t>(ks->n_elems);
     if (!validate_payload(codec, buf, len, n)) return -3;
     auto owned = std::make_shared<RawBuf>(buf, buf + len);
@@ -314,13 +364,15 @@ class Server {
   }
 
   int LocalPull(uint64_t key, uint8_t codec, uint64_t version,
-                int timeout_ms, std::vector<char>* out) {
+                int timeout_ms, std::vector<char>* out,
+                uint64_t* out_epoch) {
     if (!running_) return -10;
     KeyStore* ks = Get(key);
     if (ks == nullptr) return -1;
     std::shared_ptr<const FloatBuf> snap;
     CodecHint hint;
     uint64_t v = 0;
+    uint64_t epoch = 0;
     {
       std::unique_lock<std::mutex> lk(ks->mu);
       const auto deadline = std::chrono::steady_clock::now() +
@@ -336,11 +388,14 @@ class Server {
       if (async_) {
         snap = std::make_shared<const FloatBuf>(ks->accum);
         hint = ks->hint;
+        epoch = epoch_.load();
       } else {
         snap = ks->result;
         hint = ks->result_hint;
+        epoch = ks->result_epoch;
       }
     }
+    if (out_epoch != nullptr) *out_epoch = epoch;
     *out = *EncodeResponse(ks, snap, hint, v, codec);
     return 0;
   }
@@ -495,12 +550,240 @@ class Server {
     }
   }
 
+  // ---- elastic worker membership (leases + epochs) ------------------------
+  // Reference failure story: ps-lite's scheduler heartbeat. The csrc
+  // server completes a key's sum only when every expected worker arrived
+  // and releases a barrier only at the full worker count, so ONE dead or
+  // wedged worker deadlocks every key, every barrier, and every surviving
+  // worker's wait() forever. With `lease_ms_` > 0 each worker holds a
+  // lease refreshed by its pushes/pulls/heartbeats; expiry EVICTS it —
+  // the membership epoch bumps (carried in every response header so
+  // workers learn on their next op), open rounds re-target the live set,
+  // and stuck barriers release over the survivors.
+  enum MemberState : uint8_t { kEvicted = 0, kLive = 1, kDeparted = 2 };
+
+  struct Membership {
+    std::vector<uint8_t> live;  // 1 = live, indexed by worker id
+    uint32_t count = 0;
+    uint64_t epoch = 0;  // epoch this snapshot was published under —
+                         // round closes stamp THIS, keeping the quorum
+                         // scale and the epoch label consistent even
+                         // when an eviction publishes mid-close
+  };
+
+  // Lock-free snapshot for the data plane: every push consults the
+  // membership (round-completion targeting), and taking the global
+  // members_mu_ + allocating a fresh vector under each per-key mutex
+  // would serialize pushes to DIFFERENT keys on one lock. Membership
+  // changes are rare; publishers rebuild the immutable snapshot under
+  // members_mu_, readers atomic-load the shared_ptr.
+  std::shared_ptr<const Membership> Members() {
+    return std::atomic_load(&members_snap_);
+  }
+
+  // call with members_mu_ held
+  void PublishMembersLocked() {
+    auto snap = std::make_shared<Membership>();
+    snap->live.resize(member_state_.size());
+    for (size_t i = 0; i < member_state_.size(); ++i) {
+      snap->live[i] = member_state_[i] == kLive ? 1 : 0;
+    }
+    const int live = live_workers_.load();
+    snap->count = static_cast<uint32_t>(live > 0 ? live : 0);
+    snap->epoch = epoch_.load();
+    std::atomic_store(&members_snap_,
+                      std::shared_ptr<const Membership>(std::move(snap)));
+  }
+
+  bool WorkerLive(uint16_t worker) {
+    if (lease_ms_ <= 0 || worker >= member_state_.size()) return true;
+    std::lock_guard<std::mutex> lk(members_mu_);
+    return member_state_[worker] == kLive;
+  }
+
+  // Refresh `worker`'s lease. With `admit`, an evicted/departed worker is
+  // RE-ADMITTED (the kPing-heartbeat rejoin path): the epoch bumps and
+  // the worker is expected in rounds again. Pushes/pulls deliberately do
+  // NOT admit — an evicted worker must first adopt the current epoch and
+  // round watermarks (kMembers/kRounds) or its stale rounds would leak
+  // into post-eviction sums.
+  bool Touch(uint16_t worker, bool admit) {
+    if (lease_ms_ <= 0 || worker >= member_state_.size()) return false;
+    bool rejoined = false;
+    {
+      std::lock_guard<std::mutex> lk(members_mu_);
+      last_seen_ms_[worker] = steady_ms();
+      if (member_state_[worker] != kLive && admit) {
+        member_state_[worker] = kLive;
+        live_workers_.fetch_add(1);
+        epoch_.fetch_add(1);
+        PublishMembersLocked();
+        rejoined = true;
+      }
+    }
+    if (rejoined) {
+      Trace(kTrMember, worker,
+            static_cast<uint32_t>(live_workers_.load()), 1, realtime_ns());
+    }
+    return rejoined;
+  }
+
+  // Sweep-thread eviction: every live worker silent past the lease is
+  // marked dead, then open rounds / barriers / the exit gate reconcile.
+  void EvictExpired() {
+    std::vector<uint16_t> dead;
+    {
+      std::lock_guard<std::mutex> lk(members_mu_);
+      const int64_t now = steady_ms();
+      for (size_t w = 0; w < member_state_.size(); ++w) {
+        if (member_state_[w] == kLive &&
+            now - last_seen_ms_[w] > lease_ms_) {
+          member_state_[w] = kEvicted;
+          live_workers_.fetch_sub(1);
+          epoch_.fetch_add(1);
+          dead.push_back(static_cast<uint16_t>(w));
+        }
+      }
+      if (!dead.empty()) PublishMembersLocked();
+    }
+    if (dead.empty()) return;
+    for (uint16_t w : dead) {
+      Trace(kTrMember, w,
+            static_cast<uint32_t>(live_workers_.load()), 0, realtime_ns());
+    }
+    ReconcileAfterMembershipShrink(dead);
+  }
+
+  // A worker's clean goodbye under elastic membership: mark it DEPARTED
+  // (it is no longer expected in rounds/barriers but is not an eviction)
+  // and reconcile. Returns true when every worker is now accounted for
+  // (departed or evicted) so the caller may stop the server.
+  bool Depart(uint16_t worker) {
+    if (lease_ms_ <= 0 || worker >= member_state_.size()) return false;
+    bool shrank = false;
+    {
+      std::lock_guard<std::mutex> lk(members_mu_);
+      if (member_state_[worker] == kLive) {
+        live_workers_.fetch_sub(1);
+        epoch_.fetch_add(1);
+        shrank = true;
+      }
+      member_state_[worker] = kDeparted;
+      if (shrank) PublishMembersLocked();
+    }
+    if (shrank) ReconcileAfterMembershipShrink({worker});
+    return AllAccountedFor();
+  }
+
+  bool AllAccountedFor() {
+    std::lock_guard<std::mutex> lk(members_mu_);
+    int departed = 0;
+    for (auto s : member_state_) departed += s == kDeparted ? 1 : 0;
+    // all-evicted with zero goodbyes is treated as a transient outage
+    // (workers may rejoin), not a completed job. Anonymous (legacy)
+    // kShutdowns can't mark a DEPARTED slot but still count as
+    // goodbyes, so a mixed fleet that all said goodbye anonymously
+    // stops once the lease has evicted the silent slots.
+    return live_workers_.load() <= 0 &&
+           (departed > 0 || shutdown_count_.load() > 0);
+  }
+
+  // Membership shrank: drop the dead workers' deferred (pipelined
+  // next-round) pushes, close any round now complete over the live set —
+  // answering its pending pulls — release barriers the dead can no
+  // longer satisfy, and stop the server once every worker is departed or
+  // evicted with at least one proper goodbye.
+  void ReconcileAfterMembershipShrink(const std::vector<uint16_t>& dead) {
+    std::vector<std::pair<uint64_t, KeyStore*>> stores;
+    {
+      std::lock_guard<std::mutex> lk(store_mu_);
+      stores.reserve(store_.size());
+      for (auto& [k, ks] : store_) stores.emplace_back(k, ks.get());
+    }
+    for (auto& [key, ks] : stores) {
+      std::vector<ReadyResp> ready;
+      {
+        std::lock_guard<std::mutex> lk(ks->mu);
+        auto it = ks->deferred.begin();
+        while (it != ks->deferred.end()) {
+          bool drop = false;
+          for (uint16_t w : dead) drop = drop || it->worker == w;
+          it = drop ? ks->deferred.erase(it) : it + 1;
+        }
+        if (!async_) {
+          auto memb = Members();
+          if (RoundCompleteLocked(ks, *memb)) {
+            CloseRoundLocked(ks, *memb, &ready);
+          }
+        }
+        ks->cv.notify_all();
+      }
+      DispatchReady(key, ks, ready);
+    }
+    ReleaseBarrierIfReady();
+    if (AllAccountedFor()) {
+      // detached: the sweep thread cannot join itself through Stop()
+      std::thread([this] { Stop(); }).detach();
+    }
+  }
+
+  // Barrier over the LIVE set: released as soon as the waiters cover
+  // every live worker — on arrival (HandleBarrier) and again on every
+  // membership shrink, so a dead worker cannot strand a barrier. Only
+  // waiters that are anonymous (legacy frames) or still LIVE count
+  // toward the target: a worker that barriered and then got evicted
+  // must not stand in for a live peer that never arrived (its stale
+  // arrival predates the membership the survivors are synchronizing).
+  void ReleaseBarrierIfReady() {
+    std::vector<ConnPtr> release;
+    {
+      std::lock_guard<std::mutex> lk(barrier_mu_);
+      int target = live_workers_.load();
+      if (target <= 0) target = 1;
+      auto memb = Members();
+      int counted = 0;
+      for (auto& p : barrier_conns_) {
+        const uint16_t wid1 = p.second;
+        const bool anon = wid1 == 0;
+        const bool live =
+            !anon && static_cast<size_t>(wid1 - 1) < memb->live.size() &&
+            memb->live[wid1 - 1];
+        counted += (anon || live) ? 1 : 0;
+      }
+      if (counted > 0 && counted >= target) {
+        // release EVERY waiter (stale ones included — their acks land
+        // on dead conns harmlessly, and leaving them queued would leak
+        // them into the next barrier round)
+        release.reserve(barrier_conns_.size());
+        for (auto& p : barrier_conns_) release.push_back(p.first);
+        barrier_conns_.clear();
+      }
+    }
+    for (auto& rc : release) SendFrame(rc, kAck, 0, 0, nullptr, 0);
+  }
+
+  // Response frame with an explicit reserved stamp — pull responses
+  // carry the epoch their ROUND closed under (a survivor must average a
+  // pre-eviction round by the pre-eviction live count, even when the
+  // response is delivered after the epoch bumped).
+  void SendFrameStamped(const ConnPtr& c, Cmd cmd, uint64_t key,
+                        uint64_t version, const void* payload, uint32_t len,
+                        uint8_t flags, uint32_t crc, uint16_t reserved) {
+    std::lock_guard<std::mutex> lk(c->send_mu);
+    if (c->closed) return;  // peer went away; response is moot
+    send_frame(c->fd, cmd, key, version, payload, len, flags, reserved,
+               crc);
+  }
+
   void SendFrame(const ConnPtr& c, Cmd cmd, uint64_t key, uint64_t version,
                  const void* payload, uint32_t len, uint8_t flags = 0,
                  uint32_t crc = 0) {
-    std::lock_guard<std::mutex> lk(c->send_mu);
-    if (c->closed) return;  // peer went away; response is moot
-    send_frame(c->fd, cmd, key, version, payload, len, flags, 0, crc);
+    // every response carries the CURRENT membership epoch (low 16 bits):
+    // workers learn of evictions/rejoins on their next op, no extra
+    // round trip
+    SendFrameStamped(
+        c, cmd, key, version, payload, len, flags, crc,
+        static_cast<uint16_t>(epoch_.load(std::memory_order_relaxed)));
   }
 
   void SendErr(const ConnPtr& c, uint64_t key, const char* msg) {
@@ -538,7 +821,73 @@ class Server {
     uint64_t version;
     std::shared_ptr<const FloatBuf> snap;
     CodecHint hint;
+    uint64_t epoch;  // membership epoch the round CLOSED under
   };
+
+  // Round completion over the LIVE membership: closed when every live
+  // worker contributed. Contributions from workers evicted mid-round may
+  // already sit in accum — the close-time quorum scaling handles them.
+  // Never closes an empty round: accum is uninitialized until the first
+  // push of the round lands.
+  bool RoundCompleteLocked(KeyStore* ks, const Membership& m) {
+    if (m.count == 0 || ks->arrived == 0) return false;
+    for (size_t w = 0; w < m.live.size() && w < ks->pushed.size(); ++w) {
+      if (m.live[w] && !ks->pushed[w]) return false;
+    }
+    return true;
+  }
+
+  // Close the open round: snapshot by MOVE, fresh accumulator, answer the
+  // pulls this round satisfies, then re-apply deferred next-round pushes.
+  void CloseRoundLocked(KeyStore* ks, const Membership& memb,
+                        std::vector<ReadyResp>* ready) {
+    // Quorum scaling: a worker evicted mid-round may have contributed to
+    // accum, but the survivors will average this sum over the LIVE count
+    // (the membership their epoch adoption reports). Scale the sum to
+    // the survivors so the global *average* stays unbiased. A clean
+    // round (contributors == live) takes no multiply at all — healthy
+    // and post-eviction epochs stay bit-exact.
+    if (memb.count > 0 && ks->arrived > memb.count) {
+      const float s = static_cast<float>(memb.count) /
+                      static_cast<float>(ks->arrived);
+      for (auto& v : ks->accum) v *= s;
+    }
+    // the codec hint is frozen with the result so deferred next-round
+    // pushes below cannot change how THIS round's responses are encoded
+    auto snap = std::make_shared<FloatBuf>(std::move(ks->accum));
+    // moved-from accum is empty; resize on the no-init allocator
+    // allocates WITHOUT the 4 MB zero-fill (the next round's first
+    // push overwrites or zero+sums — ApplyPushLocked's start-of-round
+    // branch)
+    ks->accum.resize(snap->size());
+    ks->result = std::move(snap);
+    ks->result_hint = ks->hint;
+    ks->result_epoch = memb.epoch;
+    ks->version++;
+    ks->arrived = 0;
+    std::fill(ks->pushed.begin(), ks->pushed.end(), 0);
+    ks->cache_codec = 0xFF;
+    ks->cv.notify_all();
+    // hand this round's snapshot to the pulls it satisfies BEFORE
+    // applying deferred pushes (which may immediately close the next
+    // round and overwrite ks->result)
+    auto it = ks->pending.begin();
+    while (it != ks->pending.end()) {
+      if (ks->version >= it->version) {
+        ready->push_back({it->conn, it->codec, it->want_crc, ks->version,
+                          ks->result, ks->result_hint, ks->result_epoch});
+        it = ks->pending.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    auto deferred = std::move(ks->deferred);
+    ks->deferred.clear();
+    for (auto& d : deferred) {
+      ApplyPushLocked(ks, memb, d.worker, d.codec, d.version,
+                      std::move(d.buf), ready);
+    }
+  }
 
   // Decode+sum one arrived push under ks->mu. A worker that pushes round
   // v+1 before round v closed (pipelined pushes are legal — the ack no
@@ -547,14 +896,30 @@ class Server {
   // round's snapshot. `version` != 0 arms replay dedupe: a (worker,
   // version) at or below the already-applied watermark — or already
   // sitting in the deferred queue — is a retry-engine re-send whose
-  // original landed, and is dropped instead of double-summed.
-  void ApplyPushLocked(KeyStore* ks, uint16_t worker, uint8_t codec,
-                       uint64_t version, std::shared_ptr<RawBuf> buf,
+  // original landed, and is dropped instead of double-summed. `memb` is
+  // the live membership the round targets (snapshotted under ks->mu, so
+  // an eviction either lands before this push — visible here — or its
+  // reconcile sweep sees this contribution; a completable round can
+  // never be missed between the two).
+  void ApplyPushLocked(KeyStore* ks, const Membership& memb,
+                       uint16_t worker, uint8_t codec, uint64_t version,
+                       std::shared_ptr<RawBuf> buf,
                        std::vector<ReadyResp>* ready) {
     const int64_t n = static_cast<int64_t>(ks->n_elems);
     if (version != 0 && worker < ks->applied_version.size() &&
         version <= ks->applied_version[worker]) {
       return;  // duplicate of an already-summed push
+    }
+    if (lease_ms_ > 0 && !async_ && version != 0 &&
+        version <= ks->version) {
+      // Stale round, re-checked ATOMICALLY with the round state: the
+      // kPush handler's pre-ack guard races the eviction sweep (the
+      // round can close between the check and this apply), and a round
+      // that closed without this worker must never have the worker's
+      // payload credited to the NEXT round. Dropped silently (the ack
+      // already went out); the worker learns via the epoch stamp / its
+      // next push's kErr and rejoins.
+      return;
     }
     if (!async_ && ks->pushed[worker]) {
       if (version != 0) {
@@ -594,42 +959,20 @@ class Server {
       return;
     }
     ks->pushed[worker] = 1;
-    if (++ks->arrived == static_cast<uint32_t>(num_workers_)) {
-      // round complete: snapshot by MOVE, fresh zeroed accumulator; the
-      // codec hint is frozen with the result so deferred next-round pushes
-      // below cannot change how THIS round's responses are encoded
-      auto snap = std::make_shared<FloatBuf>(std::move(ks->accum));
-      // moved-from accum is empty; resize on the no-init allocator
-      // allocates WITHOUT the 4 MB zero-fill (the next round's first
-      // push overwrites or zero+sums — ApplyPushLocked's start-of-round
-      // branch)
-      ks->accum.resize(snap->size());
-      ks->result = std::move(snap);
-      ks->result_hint = ks->hint;
-      ks->version++;
-      ks->arrived = 0;
-      std::fill(ks->pushed.begin(), ks->pushed.end(), 0);
-      ks->cache_codec = 0xFF;
-      ks->cv.notify_all();
-      // hand this round's snapshot to the pulls it satisfies BEFORE
-      // applying deferred pushes (which may immediately close the next
-      // round and overwrite ks->result)
-      auto it = ks->pending.begin();
-      while (it != ks->pending.end()) {
-        if (ks->version >= it->version) {
-          ready->push_back({it->conn, it->codec, it->want_crc, ks->version,
-                            ks->result, ks->result_hint});
-          it = ks->pending.erase(it);
-        } else {
-          ++it;
-        }
-      }
-      auto deferred = std::move(ks->deferred);
-      ks->deferred.clear();
-      for (auto& d : deferred) {
-        ApplyPushLocked(ks, d.worker, d.codec, d.version, std::move(d.buf),
-                        ready);
-      }
+    ++ks->arrived;
+    if (RoundCompleteLocked(ks, memb)) {
+      CloseRoundLocked(ks, memb, ready);
+    }
+  }
+
+  void DispatchReady(uint64_t key, KeyStore* ks,
+                     std::vector<ReadyResp>& ready) {
+    for (auto& p : ready) {
+      // parallel fan-out: each response encodes+sends on its own engine slot
+      SubmitEngine(key, [this, ks, key, p = std::move(p)] {
+        RespondPull(p.conn, key, ks, p.codec, p.want_crc, p.version, p.snap,
+                    p.hint, p.epoch);
+      });
     }
   }
 
@@ -640,26 +983,22 @@ class Server {
     std::vector<ReadyResp> ready;
     {
       std::lock_guard<std::mutex> lk(ks->mu);
-      ApplyPushLocked(ks, worker, codec, version, std::move(buf), &ready);
+      auto memb = Members();
+      ApplyPushLocked(ks, *memb, worker, codec, version, std::move(buf),
+                      &ready);
       if (async_) {
         auto it = ks->pending.begin();
         while (it != ks->pending.end()) {
           ready.push_back(
               {it->conn, it->codec, it->want_crc, ks->version,
                std::make_shared<const FloatBuf>(ks->accum),
-               ks->hint});
+               ks->hint, memb->epoch});
           it = ks->pending.erase(it);
         }
       }
     }
     Trace(kTrSum, key, len, codec, t0);
-    for (auto& p : ready) {
-      // parallel fan-out: each response encodes+sends on its own engine slot
-      SubmitEngine(key, [this, ks, key, p = std::move(p)] {
-        RespondPull(p.conn, key, ks, p.codec, p.want_crc, p.version, p.snap,
-                    p.hint);
-      });
-    }
+    DispatchReady(key, ks, ready);
   }
 
   // Encode the round result for one pull. Cached per (version, codec) so a
@@ -689,25 +1028,31 @@ class Server {
     return blob;
   }
 
+  // `epoch` = membership epoch the round closed under; stamped into the
+  // response header so the puller averages by the round's OWN live count
+  // (not the possibly-newer current membership).
   void RespondPull(const ConnPtr& c, uint64_t key, KeyStore* ks,
                    uint8_t codec, bool want_crc, uint64_t version,
                    std::shared_ptr<const FloatBuf> snap,
-                   const CodecHint& hint) {
+                   const CodecHint& hint, uint64_t epoch) {
     const int64_t t0 = realtime_ns();
+    const uint16_t stamp = static_cast<uint16_t>(epoch);
     if (codec == kCodecRaw) {
       // zero-copy from the immutable snapshot
       const uint32_t len =
           static_cast<uint32_t>(snap->size() * sizeof(float));
       const uint32_t crc = want_crc ? wire_crc(snap->data(), len) : 0;
-      SendFrame(c, kResp, key, version, snap->data(), len, kCodecRaw, crc);
+      SendFrameStamped(c, kResp, key, version, snap->data(), len,
+                       kCodecRaw, crc, stamp);
       Trace(kTrPullResp, key, len, kCodecRaw, t0);
       return;
     }
     auto blob = EncodeResponse(ks, snap, hint, version, codec);
     const uint32_t crc =
         want_crc ? wire_crc(blob->data(), blob->size()) : 0;
-    SendFrame(c, kResp, key, version, blob->data(),
-              static_cast<uint32_t>(blob->size()), codec, crc);
+    SendFrameStamped(c, kResp, key, version, blob->data(),
+                     static_cast<uint32_t>(blob->size()), codec, crc,
+                     stamp);
     Trace(kTrPullResp, key, static_cast<uint32_t>(blob->size()), codec, t0);
   }
 
@@ -720,6 +1065,7 @@ class Server {
     }
     bool ready;
     uint64_t v = 0;
+    uint64_t epoch = 0;
     std::shared_ptr<const FloatBuf> snap;
     CodecHint hint;
     {
@@ -732,37 +1078,44 @@ class Server {
         if (async_) {
           snap = std::make_shared<const FloatBuf>(ks->accum);
           hint = ks->hint;
+          epoch = epoch_.load();
         } else {
           snap = ks->result;
           hint = ks->result_hint;
+          epoch = ks->result_epoch;
         }
       }
     }
     if (ready) {
-      SubmitEngine(key, [this, c, key, ks, codec, want_crc, v, hint,
+      SubmitEngine(key, [this, c, key, ks, codec, want_crc, v, hint, epoch,
                          snap = std::move(snap)] {
-        RespondPull(c, key, ks, codec, want_crc, v, snap, hint);
+        RespondPull(c, key, ks, codec, want_crc, v, snap, hint, epoch);
       });
     }
   }
 
-  void HandleBarrier(const ConnPtr& c) {
-    std::vector<ConnPtr> release;
+  void HandleBarrier(const ConnPtr& c, uint16_t reserved) {
+    if (reserved > 0) Touch(static_cast<uint16_t>(reserved - 1), false);
     {
       std::lock_guard<std::mutex> lk(barrier_mu_);
-      barrier_conns_.push_back(c);
-      if (static_cast<int>(barrier_conns_.size()) == num_workers_) {
-        release.swap(barrier_conns_);
-      }
+      barrier_conns_.emplace_back(c, reserved);
     }
-    for (auto& rc : release) SendFrame(rc, kAck, 0, 0, nullptr, 0);
+    ReleaseBarrierIfReady();
   }
 
-  // Expire pulls stuck past the deadline: a dead worker otherwise leaves
-  // its peers blocked forever (reference failure story: ps-lite heartbeat).
+  // Expire pulls stuck past the deadline (a dead worker otherwise leaves
+  // its peers blocked forever — reference failure story: ps-lite
+  // heartbeat) and, with the lease armed, evict workers whose lease
+  // expired. The tick shortens with the lease so eviction latency stays
+  // a small multiple of BYTEPS_WORKER_LEASE_MS.
   void SweepLoop() {
+    const int tick_ms =
+        lease_ms_ > 0 ? std::max(20, std::min(200, lease_ms_ / 4)) : 200;
     while (running_) {
-      std::this_thread::sleep_for(std::chrono::milliseconds(200));
+      std::this_thread::sleep_for(std::chrono::milliseconds(tick_ms));
+      if (!running_) break;
+      if (lease_ms_ > 0) EvictExpired();
+      if (pull_timeout_ms_ <= 0) continue;
       const int64_t now = steady_ms();
       std::vector<std::pair<uint64_t, KeyStore*>> stores;
       {
@@ -828,6 +1181,40 @@ class Server {
             SendErr(c, h.key, "worker id out of range");
             break;
           }
+          if (!async_ && !WorkerLive(h.reserved)) {
+            // an evicted worker's stale round must not leak into the
+            // post-eviction sums; it rejoins first (kPing heartbeat +
+            // kRounds watermark adoption) and re-sends under the new
+            // epoch (the worker-side WorkerEvictedError path)
+            SendErr(c, h.key, "worker evicted: rejoin required");
+            break;
+          }
+          if (!async_ && lease_ms_ > 0 && h.version != 0) {
+            // Stale-round guard: a worker evicted MID-ROUND whose
+            // heartbeat already re-admitted it (monitor rejoin after a
+            // wedge) may still re-send the round it was evicted out of.
+            // That round CLOSED without it — summing the payload now
+            // would credit a stale gradient to the currently open
+            // round. Detectably stale: version at/below the key's
+            // closed-round watermark yet above the worker's applied
+            // watermark (a true replay is at/below applied and is
+            // dedupe-dropped as before). Reject like an eviction so the
+            // worker rejoins, adopts watermarks, and re-mints.
+            bool stale;
+            {
+              std::lock_guard<std::mutex> lk(ks->mu);
+              stale = h.version <= ks->version &&
+                      h.reserved < ks->applied_version.size() &&
+                      h.version > ks->applied_version[h.reserved];
+            }
+            if (stale) {
+              SendErr(c, h.key,
+                      "worker evicted mid-round (stale round): rejoin "
+                      "required");
+              break;
+            }
+          }
+          Touch(h.reserved, /*admit=*/false);
           if (!validate_payload(h.flags, payload->data(), h.len,
                                 static_cast<int64_t>(ks->n_elems))) {
             SendErr(c, h.key, "payload does not match store size");
@@ -860,19 +1247,94 @@ class Server {
           break;
         }
         case kPull:
+          if (h.reserved > 0) {
+            Touch(static_cast<uint16_t>(h.reserved - 1), /*admit=*/false);
+          }
           HandlePull(c, h.key, h.version, h.flags, h.crc != 0);
           break;
         case kBarrier:
-          HandleBarrier(c);
+          HandleBarrier(c, h.reserved);
           break;
         case kPing:
+          // reserved = worker_id + 1 turns the clock probe into the
+          // worker's lease heartbeat — and the REJOIN signal: an evicted
+          // worker's heartbeat re-admits it (epoch bumps; the worker then
+          // adopts round watermarks via kRounds before pushing again)
+          if (h.reserved > 0 && h.reserved - 1 < num_workers_) {
+            Touch(static_cast<uint16_t>(h.reserved - 1), /*admit=*/true);
+          }
           SendFrame(c, kAck, h.key,
                     static_cast<uint64_t>(realtime_ns()), nullptr, 0);
           break;
+        case kMembers: {
+          auto m = Members();
+          std::vector<char> pay(8 + m->live.size());
+          const uint32_t live = m->count;
+          const uint32_t nw = static_cast<uint32_t>(m->live.size());
+          std::memcpy(pay.data(), &live, 4);
+          std::memcpy(pay.data() + 4, &nw, 4);
+          if (!m->live.empty()) {
+            std::memcpy(pay.data() + 8, m->live.data(), m->live.size());
+          }
+          // version = the SNAPSHOT's epoch (see MembersInfo): the live
+          // set and its epoch label must come from one atomic view
+          SendFrame(c, kResp, h.key, m->epoch, pay.data(),
+                    static_cast<uint32_t>(pay.size()));
+          break;
+        }
+        case kRounds: {
+          // per-key round watermarks for the rejoin handshake: a
+          // restarted/evicted worker adopts these so its next mint
+          // continues the server's round sequence (a fresh counter would
+          // mint versions at/below the replay-dedupe watermark and every
+          // later round would be dropped as a replay)
+          std::vector<std::pair<uint64_t, KeyStore*>> stores;
+          {
+            std::lock_guard<std::mutex> lk(store_mu_);
+            stores.reserve(store_.size());
+            for (auto& [k, ks] : store_) stores.emplace_back(k, ks.get());
+          }
+          std::vector<char> pay;
+          pay.reserve(stores.size() * 24);
+          for (auto& [k, ks] : stores) {
+            uint64_t trip[3];
+            trip[0] = k;
+            {
+              std::lock_guard<std::mutex> lk(ks->mu);
+              trip[1] = ks->version;
+              trip[2] = static_cast<uint64_t>(ks->n_elems) * 4;
+            }
+            const char* p = reinterpret_cast<const char*>(trip);
+            pay.insert(pay.end(), p, p + sizeof(trip));
+          }
+          SendFrame(c, kResp, h.key, epoch_.load(), pay.data(),
+                    static_cast<uint32_t>(pay.size()));
+          break;
+        }
         case kShutdown: {
           SendFrame(c, kAck, 0, 0, nullptr, 0);
           int count = ++shutdown_count_;
-          if (count >= num_workers_) stop_server_after = true;
+          if (lease_ms_ <= 0) {
+            // legacy gate: every configured worker said goodbye. Only
+            // without the lease — a raw frame COUNT is wrong under
+            // elastic membership, where one worker id can legitimately
+            // say goodbye twice (depart → replacement rejoins → depart)
+            // while a peer is still training.
+            if (count >= num_workers_) stop_server_after = true;
+          } else if (h.reserved > 0 && h.reserved - 1 < num_workers_) {
+            // elastic gate: an identified goodbye marks the worker
+            // DEPARTED; the server exits once every worker is departed
+            // or evicted — a dead worker cannot hold up teardown, and a
+            // live one cannot be stranded by double goodbyes
+            if (Depart(static_cast<uint16_t>(h.reserved - 1))) {
+              stop_server_after = true;
+            }
+          } else if (AllAccountedFor()) {
+            // anonymous goodbye under the lease: counted (see
+            // AllAccountedFor) but cannot name its slot — the lease
+            // sweep evicts it and the exit gate re-checks there
+            stop_server_after = true;
+          }
           done = true;
           break;
         }
@@ -900,6 +1362,19 @@ class Server {
   bool schedule_ = false;
   int pull_timeout_ms_ = 0;
   int server_id_ = 0;
+  int lease_ms_ = 0;
+  // elastic membership (see the helper block above): per-worker lease +
+  // state under members_mu_; live count and epoch are atomics so the
+  // data plane (SendFrame's epoch stamp, barrier targets) reads them
+  // without taking the membership lock
+  std::mutex members_mu_;
+  std::vector<uint8_t> member_state_;  // MemberState, indexed by worker id
+  std::vector<int64_t> last_seen_ms_;  // steady clock, guarded by members_mu_
+  std::atomic<int> live_workers_{1};
+  std::atomic<uint64_t> epoch_{0};
+  // immutable snapshot for lock-free data-plane reads (see Members())
+  std::shared_ptr<const Membership> members_snap_ =
+      std::make_shared<const Membership>();
   std::atomic<bool> running_{false};
   std::atomic<int> shutdown_count_{0};
   std::unique_ptr<ThreadPool> engine_;
@@ -914,7 +1389,9 @@ class Server {
   std::mutex store_mu_;
   std::unordered_map<uint64_t, std::unique_ptr<KeyStore>> store_;
   std::mutex barrier_mu_;
-  std::vector<ConnPtr> barrier_conns_;
+  // (conn, worker_id + 1) — 0 = anonymous legacy frame; identity lets
+  // the release target ignore waiters evicted while queued
+  std::vector<std::pair<ConnPtr, uint16_t>> barrier_conns_;
   std::mutex stop_mu_;
   std::mutex done_mu_;
   std::condition_variable done_cv_;
@@ -941,7 +1418,7 @@ Server* GetServer() {
 
 int StartServer(uint16_t port, int num_workers, int engine_threads,
                 bool async, int pull_timeout_ms, int server_id,
-                bool schedule) {
+                bool schedule, int lease_ms) {
   std::lock_guard<std::mutex> lk(g_server_mu);
   if (g_server != nullptr) {
     if (g_server->IsRunning()) return -10;  // already running
@@ -953,7 +1430,7 @@ int StartServer(uint16_t port, int num_workers, int engine_threads,
   }
   auto* s = new Server();
   int rc = s->Start(port, num_workers, engine_threads, async,
-                    pull_timeout_ms, server_id, schedule);
+                    pull_timeout_ms, server_id, schedule, lease_ms);
   if (rc != 0) {
     delete s;  // never published: no other thread can hold it
     return rc;
@@ -986,6 +1463,18 @@ void ServerTraceEnable(bool on) {
   if (s != nullptr) s->TraceEnable(on);
 }
 
+uint64_t ServerEpoch() {
+  Server* s = GetServer();
+  return s != nullptr ? s->Epoch() : 0;
+}
+
+int ServerMembers(uint64_t* epoch, uint32_t* live_count, uint8_t* bitmap,
+                  uint32_t cap) {
+  Server* s = GetServer();
+  if (s == nullptr) return -10;
+  return s->MembersInfo(epoch, live_count, bitmap, cap);
+}
+
 int ServerTraceDump(const char* path) {
   Server* s = GetServer();
   if (s == nullptr) {
@@ -1010,10 +1499,11 @@ int LocalPush(uint16_t worker, uint64_t key, uint8_t codec,
 }
 
 int LocalPull(uint64_t key, uint8_t codec, uint64_t version, int timeout_ms,
-              std::vector<char>* out) {
+              std::vector<char>* out, uint64_t* out_epoch) {
   Server* s = GetServer();
-  return s != nullptr ? s->LocalPull(key, codec, version, timeout_ms, out)
-                      : -10;
+  return s != nullptr
+             ? s->LocalPull(key, codec, version, timeout_ms, out, out_epoch)
+             : -10;
 }
 
 }  // namespace bps

@@ -20,9 +20,29 @@ namespace bps {
 // (dead-worker fail-fast; reference analog: ps-lite heartbeat/resender,
 // SURVEY §5.3). `server_id` labels trace output. `schedule` enables
 // priority-ordered engine work by key (BYTEPS_SERVER_ENABLE_SCHEDULE).
+// `lease_ms` > 0 arms ELASTIC WORKER MEMBERSHIP (BYTEPS_WORKER_LEASE_MS):
+// every worker holds a lease refreshed by its pushes/pulls and kPing
+// heartbeats; a worker silent past the lease is EVICTED — the membership
+// epoch bumps, open rounds re-target the live worker set (partial sums
+// with contributions from the dead worker are scaled by live/contributors
+// so the global *average* stays unbiased), stuck barriers release over
+// the live set, and the server exits once every worker is departed or
+// evicted (a dead worker can no longer stall its peers' pulls, barriers,
+// or teardown). A later heartbeat from an evicted worker RE-ADMITS it
+// (epoch bumps again); pushes from an evicted worker are rejected with a
+// "worker evicted" kErr until it rejoins, so its stale rounds can never
+// leak into a post-eviction sum. 0 = fixed membership (legacy).
 int StartServer(uint16_t port, int num_workers, int engine_threads,
                 bool async, int pull_timeout_ms, int server_id,
-                bool schedule);
+                bool schedule, int lease_ms);
+// Current membership epoch of the in-process server (0 if none running) —
+// the IPC-path analog of the epoch carried in every TCP response header.
+uint64_t ServerEpoch();
+// Membership snapshot of the in-process server: *epoch, *live_count, and
+// up to `cap` bytes of the per-worker live bitmap. Returns num_workers,
+// or -10 when no server runs in this process.
+int ServerMembers(uint64_t* epoch, uint32_t* live_count, uint8_t* bitmap,
+                  uint32_t cap);
 // Blocks until the server stops (all workers sent kShutdown, or StopServer).
 void WaitServer();
 void StopServer();
@@ -44,8 +64,10 @@ int LocalInit(uint64_t key, uint64_t nbytes);
 int LocalPush(uint16_t worker, uint64_t key, uint8_t codec,
               uint64_t version, const char* buf, size_t len);
 // Blocks up to timeout_ms for round `version`; fills `out` with the
-// response encoded as `codec`.
+// response encoded as `codec`. *out_epoch (optional) receives the
+// membership epoch the returned ROUND closed under — the averaging
+// divisor authority, same contract as the TCP response header stamp.
 int LocalPull(uint64_t key, uint8_t codec, uint64_t version, int timeout_ms,
-              std::vector<char>* out);
+              std::vector<char>* out, uint64_t* out_epoch = nullptr);
 
 }  // namespace bps

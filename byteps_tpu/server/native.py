@@ -41,6 +41,18 @@ class WireCorruption(RuntimeError):
     the data was detected bad, never summed or consumed."""
 
 
+class WorkerEvictedError(RuntimeError):
+    """The server's membership layer evicted this worker's lease (it went
+    silent past BYTEPS_WORKER_LEASE_MS) and rejected the op. NOT a wire
+    retry candidate — re-sending the same round cannot help while the
+    server refuses the worker. The PSWorker rejoins (heartbeat re-admit +
+    kRounds watermark adoption) and raises this stage-retryably: the
+    stage re-run drops its pinned round and mints a fresh one under the
+    adopted epoch."""
+
+    retryable = True  # stage-level, after the in-line rejoin
+
+
 def _build() -> None:
     log.info("building native server library (one-time)…")
     subprocess.run(
@@ -65,9 +77,31 @@ def load_lib() -> ctypes.CDLL:
             os.remove(_SO)
             _build()
             lib = ctypes.CDLL(_SO)
+        try:
+            # staleness probe: a prebuilt .so predating the elastic
+            # membership API would otherwise be dlopen'd with a
+            # mismatched bps_server_start signature
+            lib.bps_client_members
+        except AttributeError:
+            log.warning("native library predates membership API; rebuilding")
+            os.remove(_SO)
+            _build()
+            lib = ctypes.CDLL(_SO)
+            try:
+                lib.bps_client_members
+            except AttributeError:
+                # dlopen matched the ALREADY-MAPPED stale object by path
+                # (nothing dlcloses the first handle), so the rebuild
+                # cannot take effect in this process — fail loudly
+                # instead of crashing on the argtypes below
+                raise RuntimeError(
+                    "stale libbyteps_tpu_server.so was already mapped "
+                    "into this process and cannot be replaced by a "
+                    "rebuild; restart the process (the rebuilt library "
+                    "now on disk will load cleanly)") from None
         lib.bps_server_start.argtypes = [
             ctypes.c_uint16, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ]
         lib.bps_server_start.restype = ctypes.c_int
         lib.bps_server_wait.argtypes = []
@@ -79,6 +113,13 @@ def load_lib() -> ctypes.CDLL:
         lib.bps_float_to_fp8.restype = ctypes.c_uint8
         lib.bps_server_trace_dump.argtypes = [ctypes.c_char_p]
         lib.bps_server_trace_dump.restype = ctypes.c_int
+        lib.bps_server_epoch.argtypes = []
+        lib.bps_server_epoch.restype = ctypes.c_uint64
+        lib.bps_server_members.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32,
+        ]
+        lib.bps_server_members.restype = ctypes.c_int
         lib.bps_local_init.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
         lib.bps_local_init.restype = ctypes.c_int
         lib.bps_local_push.argtypes = [
@@ -96,6 +137,12 @@ def load_lib() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_uint64,
         ]
         lib.bps_local_pull.restype = ctypes.c_int64
+        lib.bps_local_pull2.argtypes = [
+            ctypes.c_uint64, ctypes.c_uint8, ctypes.c_uint64, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.bps_local_pull2.restype = ctypes.c_int64
         lib.bps_client_connect.argtypes = [
             ctypes.c_char_p, ctypes.c_uint16, ctypes.c_int, ctypes.c_int,
         ]
@@ -125,18 +172,32 @@ def load_lib() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
             ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint8,
             ctypes.c_int, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_int,
             ctypes.POINTER(ctypes.c_uint32),
         ]
         lib.bps_client_pull2.restype = ctypes.c_int
-        lib.bps_client_barrier.argtypes = [ctypes.c_void_p]
+        lib.bps_client_barrier.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.bps_client_barrier.restype = ctypes.c_int
-        lib.bps_client_shutdown.argtypes = [ctypes.c_void_p]
+        lib.bps_client_shutdown.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.bps_client_shutdown.restype = ctypes.c_int
         lib.bps_client_ping.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
         ]
         lib.bps_client_ping.restype = ctypes.c_int
+        lib.bps_client_epoch.argtypes = [ctypes.c_void_p]
+        lib.bps_client_epoch.restype = ctypes.c_int
+        lib.bps_client_members.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32,
+        ]
+        lib.bps_client_members.restype = ctypes.c_int
+        lib.bps_client_rounds.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.bps_client_rounds.restype = ctypes.c_int
         lib.bps_client_last_error.argtypes = [ctypes.c_void_p]
         lib.bps_client_last_error.restype = ctypes.c_char_p
         lib.bps_client_is_dead.argtypes = [ctypes.c_void_p]
@@ -184,6 +245,7 @@ class NativeClient:
         # one client per pool thread — so the only time it waits is
         # close() draining a straggler, bounded by the recv timeout.
         self._op_lock = threading.Lock()
+        self._last_pull_epoch = 0
         self._h: Optional[int] = self._lib.bps_client_connect(
             host.encode(), port, timeout_ms, recv_timeout_ms
         )
@@ -215,51 +277,110 @@ class NativeClient:
             )
 
     def pull(self, key: int, out: np.ndarray, version: int,
-             codec: int = WIRE_RAW, want_crc: bool = False) -> int:
+             codec: int = WIRE_RAW, want_crc: bool = False,
+             worker_id: int = -1) -> int:
         """Pull into `out` (capacity buffer); returns actual bytes (or
         ``(bytes, crc)`` when ``want_crc`` — the caller verifies, so the
-        fault-injection layer can corrupt the buffer in between)."""
+        fault-injection layer can corrupt the buffer in between).
+        ``worker_id`` >= 0 refreshes that worker's membership lease
+        server-side (a worker blocked in a long pull is still alive).
+        The epoch the pulled ROUND closed under is retained on this
+        client (:meth:`last_pull_epoch`) — the averaging divisor
+        authority under elastic membership."""
         assert out.flags.c_contiguous
         with self._op_lock:
             self._require_open()
             got = ctypes.c_uint64(0)
-            if want_crc:
-                crc = ctypes.c_uint32(0)
-                self._check(
-                    self._lib.bps_client_pull2(
-                        self._h, key, out.ctypes.data, out.nbytes, version,
-                        codec, 1, ctypes.byref(got), ctypes.byref(crc),
-                    ),
-                    "pull",
-                )
-                return int(got.value), int(crc.value)
+            crc = ctypes.c_uint32(0)
+            ep = ctypes.c_uint32(0)
             self._check(
-                self._lib.bps_client_pull(
+                self._lib.bps_client_pull2(
                     self._h, key, out.ctypes.data, out.nbytes, version,
-                    codec, ctypes.byref(got),
+                    codec, 1 if want_crc else 0, ctypes.byref(got),
+                    ctypes.byref(crc), worker_id, ctypes.byref(ep),
                 ),
                 "pull",
             )
+            self._last_pull_epoch = int(ep.value)
+            if want_crc:
+                return int(got.value), int(crc.value)
             return int(got.value)
 
-    def barrier(self) -> None:
+    def last_pull_epoch(self) -> int:
+        """Membership epoch (low 16 bits) the most recently pulled round
+        CLOSED under — see :meth:`pull`."""
+        return self._last_pull_epoch
+
+    def barrier(self, worker_id: int = -1) -> None:
+        """``worker_id`` >= 0 also refreshes that worker's membership
+        lease server-side (barrier waits can outlast a short lease)."""
         with self._op_lock:
             self._require_open()
-            self._check(self._lib.bps_client_barrier(self._h), "barrier")
+            self._check(self._lib.bps_client_barrier(self._h, worker_id),
+                        "barrier")
 
-    def ping(self) -> Tuple[int, int]:
-        """(server CLOCK_REALTIME ns, round-trip ns) — clock alignment."""
+    def ping(self, worker_id: int = -1) -> Tuple[int, int]:
+        """(server CLOCK_REALTIME ns, round-trip ns) — clock alignment.
+        ``worker_id`` >= 0 makes the probe that worker's membership lease
+        HEARTBEAT (and the rejoin signal when it was evicted)."""
         with self._op_lock:
             self._require_open()
             sns = ctypes.c_int64(0)
             rtt = ctypes.c_int64(0)
             self._check(
                 self._lib.bps_client_ping(
-                    self._h, ctypes.byref(sns), ctypes.byref(rtt)
+                    self._h, ctypes.byref(sns), ctypes.byref(rtt),
+                    worker_id,
                 ),
                 "ping",
             )
             return int(sns.value), int(rtt.value)
+
+    def epoch(self) -> int:
+        """Membership epoch (low 16 bits) stamped on the last response
+        this connection parsed — cheap per-op change detection; query
+        :meth:`members` for the full live set on a change."""
+        with self._op_lock:
+            if not self._h:
+                return 0
+            return int(self._lib.bps_client_epoch(self._h))
+
+    def members(self) -> Tuple[int, int, "np.ndarray"]:
+        """(epoch, live_count, live bitmap[num_workers]) from the server's
+        membership layer."""
+        with self._op_lock:
+            self._require_open()
+            ep = ctypes.c_uint64(0)
+            live = ctypes.c_uint32(0)
+            nw = ctypes.c_uint32(0)
+            bitmap = (ctypes.c_uint8 * 1024)()
+            self._check(
+                self._lib.bps_client_members(
+                    self._h, ctypes.byref(ep), ctypes.byref(live),
+                    ctypes.byref(nw), bitmap, 1024,
+                ),
+                "members",
+            )
+            n = min(int(nw.value), 1024)
+            return (int(ep.value), int(live.value),
+                    np.frombuffer(bytes(bitmap[:n]), np.uint8).copy())
+
+    def rounds(self) -> "np.ndarray":
+        """Per-key round watermarks as an (n, 3) uint64 array of
+        (key, round, nbytes) — the rejoin adoption handshake."""
+        with self._op_lock:
+            self._require_open()
+            cap = 1 << 20  # 43k keys per fetch; far above real key counts
+            out = np.empty(cap, np.uint8)
+            got = ctypes.c_uint64(0)
+            self._check(
+                self._lib.bps_client_rounds(
+                    self._h, out.ctypes.data, out.nbytes, ctypes.byref(got),
+                ),
+                "rounds",
+            )
+            n = int(got.value) // 24
+            return out[: n * 24].view(np.uint64).reshape(n, 3).copy()
 
     def is_dead(self) -> bool:
         """True once a timeout/desync closed the underlying socket (or the
@@ -272,11 +393,13 @@ class NativeClient:
                 return True
             return bool(self._lib.bps_client_is_dead(self._h))
 
-    def shutdown(self) -> None:
+    def shutdown(self, worker_id: int = -1) -> None:
+        """``worker_id`` >= 0 marks the worker DEPARTED in the server's
+        membership layer (a clean goodbye, distinct from an eviction)."""
         with self._op_lock:
             with self._teardown_lock:
                 if self._h:
-                    self._lib.bps_client_shutdown(self._h)
+                    self._lib.bps_client_shutdown(self._h, worker_id)
 
     def close(self) -> None:
         # op lock first: wait out any in-flight wire op (freeing under
@@ -298,7 +421,14 @@ class NativeClient:
                 raise WireCorruption(
                     f"bps {op} rejected: {msg.decode()} (detected, "
                     "not applied; retryable)")
+            if b"worker evicted" in msg:
+                raise WorkerEvictedError(
+                    f"bps {op} rejected: {msg.decode()}")
             raise RuntimeError(f"bps {op} rejected: {msg.decode()}")
+        if rc == -11:
+            raise WorkerEvictedError(
+                f"bps {op} rejected: worker evicted (local/IPC path); "
+                "rejoin required")
         if rc == -7:
             raise TimeoutError(
                 f"bps {op} receive timeout (server dead or stalled); "

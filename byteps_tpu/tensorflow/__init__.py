@@ -112,11 +112,18 @@ def synchronize(handle: Handle, timeout: Optional[float] = 120.0) -> tf.Tensor:
     if handle.average:  # type: ignore[attr-defined]
         # degraded slices = LOCAL contributions (no live servers): their
         # average over the available contributions is themselves; only
-        # global slices divide by size() — handles can be MIXED when the
-        # last server died between partitions (docs/robustness.md)
-        flat = flat / size()
+        # global slices divide by the LIVE worker count (== size() at
+        # full membership; after a lease eviction the sums cover the
+        # survivors) — handles can be MIXED when the last server died or
+        # the membership changed between partitions: each slice divides
+        # by the membership ITS round closed under (handle.part_live)
+        d = _state.core.live_size() if _state.core is not None else size()
+        flat = flat / d
+        for off, ln, live in getattr(handle, "part_live", {}).values():
+            if live != d:
+                flat[off:off + ln] *= d / np.float32(live)
         for off, ln in getattr(handle, "degraded_parts", {}).values():
-            flat[off:off + ln] *= size()
+            flat[off:off + ln] *= d
     out = tf.reshape(tf.convert_to_tensor(flat), handle.shape)  # type: ignore[attr-defined]
     return tf.cast(out, handle.dtype)  # type: ignore[attr-defined]
 

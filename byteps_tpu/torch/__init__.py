@@ -128,11 +128,20 @@ def synchronize(handle: Handle, timeout: Optional[float] = 120.0) -> torch.Tenso
         # Degraded partitions (no live summation servers mid-handle,
         # docs/robustness.md) resolved to the LOCAL contribution, whose
         # average over the available contributions is itself; only the
-        # globally-aggregated slices divide by size(). A handle can be
-        # MIXED when the last server died between partitions.
-        flat = flat / size()
+        # globally-aggregated slices divide by the LIVE worker count
+        # (== size() at full membership; after a lease eviction the sums
+        # cover — and the server's quorum scaling normalizes to — the
+        # survivors). A handle can be MIXED when the last server died or
+        # the membership changed between partitions: each slice divides
+        # by the membership ITS round closed under (handle.part_live,
+        # from the pull response's epoch stamp).
+        d = _state.core.live_size() if _state.core is not None else size()
+        flat = flat / d
+        for off, ln, live in getattr(handle, "part_live", {}).values():
+            if live != d:
+                flat[off:off + ln] *= d / np.float32(live)
         for off, ln in getattr(handle, "degraded_parts", {}).values():
-            flat[off:off + ln] *= size()
+            flat[off:off + ln] *= d
     tensor: torch.Tensor = handle.tensor  # type: ignore[attr-defined]
     out = torch.from_numpy(flat).view(tensor.shape).to(tensor.dtype)
     with torch.no_grad():

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# One-shot ThreadSanitizer race smoke for the native DCN summation tier
+# (byteps_tpu/server/csrc/race_smoke.cc): rebuilds server+client+IPC with
+# -fsanitize=thread and hammers every concurrency surface — engine pool,
+# per-(key,worker) strands, reconnects, the elastic-membership lease
+# sweep racing live pushes, and Stop vs traffic. Run it after ANY
+# server-side concurrency change (the membership state lives under its
+# own mutex beside the per-key slot mutexes — exactly the kind of
+# cross-lock interplay TSAN exists for).
+#
+# Exit codes: 0 = clean, 77 = no TSAN toolchain (callers should skip),
+# anything else = build failure or a detected race/assertion.
+set -u
+cd "$(dirname "$0")/../byteps_tpu/server/csrc"
+
+if ! echo 'int main(){return 0;}' | \
+    "${CXX:-g++}" -fsanitize=thread -x c++ -std=c++17 - -o /dev/null \
+    2>/dev/null; then
+  echo "race_smoke: no ThreadSanitizer toolchain; skipping" >&2
+  exit 77
+fi
+
+exec make tsan

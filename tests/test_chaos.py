@@ -1,16 +1,22 @@
 """Chaos-hardened DCN data plane (docs/robustness.md).
 
-Tier-1 part (runs every CI pass): fault-spec grammar, plan determinism,
-the csrc replay-dedupe golden test, CRC corruption detection, the
-dead-socket shutdown branch, and the chaos SMOKE — a fixed-seed DcnCore
+Tier-1 part (runs every CI pass): fault-spec grammar (incl. the
+structured-error + round-trip pins), cross-process plan determinism, the
+csrc replay-dedupe golden test, CRC corruption detection, the
+dead-socket shutdown branch, the chaos SMOKE — a fixed-seed DcnCore
 push_pull run under two injected fault kinds that must converge to the
-clean values with retry counters > 0 and zero credit leak.
+clean values with retry counters > 0 and zero credit leak — and the
+ELASTIC MEMBERSHIP pins: the lease/eviction/quorum-scaling golden test,
+the worker-death chaos smoke (2 workers, one killed mid-run; the
+survivor completes with post-eviction sums bit-identical to a 1-worker
+clean run), the Handle deadline (StallError), and the TSAN race smoke
+when a toolchain is present.
 
 Slow tier: the acceptance sweep (5% timeouts + a 15-step server-down
 window, bit-identical sums vs the clean run), health-monitor failover
-onto the surviving server, and the graceful pure-local degradation when
-every server is dead. The goodput-vs-fault-rate measurement lives in
-``bench.py --mode chaos``.
+onto the surviving server, graceful pure-local degradation when every
+server is dead, and the eviction→rejoin round-trip. The
+goodput-vs-fault-rate measurement lives in ``bench.py --mode chaos``.
 """
 
 import dataclasses
@@ -24,9 +30,11 @@ from byteps_tpu.common.faults import (
     FaultPlan,
     FaultRule,
     parse_fault_spec,
+    rules_to_spec,
 )
 from byteps_tpu.server import (
     PSWorker,
+    WorkerEvictedError,
     start_server,
     stop_server,
     wire_crc32,
@@ -60,6 +68,89 @@ def test_parse_fault_spec_grammar():
                 "push:timeout@p=x"):
         with pytest.raises(ValueError, match="bad BYTEPS_FAULT_SPEC"):
             parse_fault_spec(bad)
+
+
+def test_parse_fault_spec_structured_errors():
+    """Satellite: a malformed server index must surface as the structured
+    'bad BYTEPS_FAULT_SPEC rule' error NAMING the grammar — not a bare
+    ``invalid literal for int()`` — and so must every cond-value typo."""
+    for bad, hint in [
+        ("serverX:down", "server<N>"),
+        ("server:down", "server<N>"),
+        ("server1x:down", "server<N>"),
+        ("push:timeout@p=x", "float"),
+        ("push:kill@op=x", "int"),
+        ("server1:down@step=1..y", "int"),
+        ("all:slow@ms=fast", "int"),
+        ("pull:hang", "worker"),  # hang is a worker-scope-only kind
+    ]:
+        with pytest.raises(ValueError) as ei:
+            parse_fault_spec(bad)
+        msg = str(ei.value)
+        assert "bad BYTEPS_FAULT_SPEC rule" in msg, (bad, msg)
+        assert hint in msg, (bad, msg)
+        assert "invalid literal" not in msg, (bad, msg)
+
+
+def test_fault_spec_round_trip_every_documented_form():
+    """Satellite: parse → render (``rules_to_spec``) → parse reproduces
+    every documented rule form exactly."""
+    forms = [
+        "push:timeout@p=0.05",
+        "pull:corrupt@p=0.01",
+        "server1:down@step=40..55",
+        "server1:down",
+        "server2:down@step=100..",
+        "all:slow@p=0.5,ms=20",
+        "init:kill@op=1",
+        "push:kill@op=7",
+        "worker:kill@step=8..",
+        "worker:hang@step=3,ms=250",
+        "worker:hang@step=3",  # default hang latency
+    ]
+    for form in forms:
+        rules = parse_fault_spec(form)
+        rendered = rules_to_spec(rules)
+        assert parse_fault_spec(rendered) == rules, (form, rendered)
+    # and the full multi-rule spec round-trips as a whole
+    spec = ";".join(forms)
+    rules = parse_fault_spec(spec)
+    assert parse_fault_spec(rules_to_spec(rules)) == rules
+
+
+def test_fault_plan_bit_identical_across_processes():
+    """Satellite: same spec + seed + worker id ⇒ bit-identical injection
+    schedule across two FRESH processes (the chaos smokes assume this;
+    in-process determinism alone would miss hash-seed / env leakage)."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import json\n"
+        "from byteps_tpu.common.faults import FaultPlan, parse_fault_spec\n"
+        "plan = FaultPlan(parse_fault_spec("
+        "'push:timeout@p=0.3;pull:corrupt@p=0.2;server0:down@op=50..60'),"
+        " seed=11, worker_id=3)\n"
+        "sched = []\n"
+        "for i in range(300):\n"
+        "    inj = plan.intercept('push' if i % 2 == 0 else 'pull', i % 2)\n"
+        "    sched.append(None if inj is None else"
+        " [inj.kind, inj.corrupt_at])\n"
+        "print(json.dumps([sched, plan.counters()], sort_keys=True))\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    outs = []
+    for _ in range(2):
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, timeout=120,
+            env={**os.environ, "PYTHONPATH": repo,
+                 "PYTHONHASHSEED": "random"},
+        )
+        assert r.returncode == 0, r.stderr.decode()
+        outs.append(r.stdout)
+    assert outs[0] == outs[1]
+    assert b"timeout" in outs[0]  # sanity: the schedule actually fired
 
 
 def test_fault_plan_deterministic_from_seed():
@@ -447,6 +538,404 @@ def test_degraded_local_fallback_when_all_servers_dead(monkeypatch):
             DcnCore.assemble(h, 30.0)
     finally:
         core.shutdown()
+
+
+# ---- elastic worker membership (leases, epochs, quorum sums) ----------------
+def test_lease_eviction_quorum_scaling_and_rejoin_golden():
+    """Golden pin of the csrc membership layer end to end: (a) a worker
+    that contributed to the open round and then went silent is evicted
+    after BYTEPS_WORKER_LEASE_MS and the round closes QUORUM-SCALED
+    (sum × live/contributors — the global average stays unbiased);
+    (b) survivor-only rounds are bit-identical to a 1-worker clean run
+    (no scaling multiply on clean rounds); (c) the survivor adopts the
+    bumped epoch from the response headers (one membership event, live
+    count 1); (d) a restarted worker's first push is REFUSED with
+    'worker evicted', auto-rejoins (heartbeat re-admit + kRounds
+    watermark adoption), and the next rounds sum both workers again;
+    (e) the server exits once every worker departed or was evicted."""
+    port = BASE_PORT + 12
+    start_server(port=port, num_workers=2, engine_threads=2,
+                 async_mode=False, lease_ms=400)
+    servers = [("127.0.0.1", port)]
+    lib = load_lib()
+    rng = np.random.default_rng(7)
+    x0 = rng.standard_normal(64).astype(np.float32)
+    x1 = rng.standard_normal(64).astype(np.float32)
+
+    w0 = PSWorker(servers=servers, worker_id=0, health_interval_ms=50)
+    w1 = PSWorker(servers=servers, worker_id=1, health_interval_ms=0)
+    try:
+        w0.init_key(0, 256)
+        w1.init_key(0, 256)
+        v0 = w0.push(0, x0)
+        w1.push(0, x1)
+        np.testing.assert_array_equal(w0.pull(0, 64, v0), x0 + x1)
+
+        # w1 contributes the next round, then "dies" (silent)
+        w1.push(0, x1)
+        w1.close()
+        deadline = time.time() + 10
+        while time.time() < deadline and lib.bps_server_epoch() == 0:
+            time.sleep(0.05)
+        assert lib.bps_server_epoch() == 1, "lease eviction never fired"
+
+        # the open round closes scaled to the survivors: (x0+x1) · 1/2
+        v0 = w0.push(0, x0)
+        np.testing.assert_array_equal(
+            w0.pull(0, 64, v0), (x0 + x1) * np.float32(0.5))
+
+        # surviving epoch: bit-identical to a 1-worker clean run, and the
+        # round's OWN live count (from the response's epoch stamp) is the
+        # survivor membership
+        for _ in range(3):
+            v0 = w0.push(0, x0)
+            np.testing.assert_array_equal(w0.pull(0, 64, v0), x0)
+        assert w0.last_round_live() == 1
+        c = w0.get_counters()
+        assert c["membership_events"] == 1, c
+        assert c["live_pods"] == 1, c
+        assert w0.live_pods() == 1
+
+        # restarted worker 1 (fresh process state): push refused, inline
+        # rejoin (ping re-admit + sync_rounds), stage-level re-mint works
+        w1b = PSWorker(servers=servers, worker_id=1, health_interval_ms=0)
+        with pytest.raises(WorkerEvictedError):
+            w1b.push(0, x1)
+        cb = w1b.get_counters()
+        assert cb["rejoins"] == 1, cb
+        # watermarks adopted: the next mint continues the server sequence
+        versions, nbytes = w1b.export_rounds()
+        assert versions.get(0, 0) >= 5 and nbytes.get(0) == 256, (versions,
+                                                                  nbytes)
+        w1b.push(0, x1)
+        v0 = w0.push(0, x0)
+        np.testing.assert_array_equal(w0.pull(0, 64, v0), x0 + x1)
+        assert w0.live_pods() == 2  # rejoin epoch adopted
+
+        # teardown: one departed (w0's goodbye) + one evicted is enough
+        # for the server to exit — kill w1b silently again first
+        w1b.close()
+        deadline = time.time() + 10
+        while time.time() < deadline and lib.bps_server_epoch() < 3:
+            time.sleep(0.05)
+        w0.shutdown()
+        deadline = time.time() + 10
+        while time.time() < deadline and lib.bps_local_init(9, 32) != -10:
+            time.sleep(0.05)
+        assert lib.bps_local_init(9, 32) == -10, (
+            "server must exit without the evicted worker's goodbye")
+    finally:
+        for w in (w0, w1):
+            try:
+                w.close()
+            except Exception:
+                pass
+        stop_server()
+
+
+def test_round_epoch_stamp_and_stale_round_guard(monkeypatch):
+    """Two review-hardening pins on the membership layer. (a) A round
+    that CLOSED under the old membership but is PULLED after an eviction
+    is stamped with its round-close epoch, so the puller's averaging
+    divisor is the OLD live count — not the shrunken current one (a
+    2-worker sum divided by 1 would double that step's gradient).
+    (b) A worker evicted mid-round whose heartbeat already re-admitted
+    it (monitor rejoin after a wedge) may re-send the round it was
+    evicted out of; that round closed WITHOUT it, so the push is REFUSED
+    as stale ('worker evicted mid-round') instead of crediting a stale
+    gradient to the currently open round."""
+    from byteps_tpu.common import config as config_mod
+
+    monkeypatch.setenv("DMLC_NUM_WORKER", "2")
+    config_mod.reset_config()  # epoch-0 live seed = configured membership
+    port = BASE_PORT + 18
+    start_server(port=port, num_workers=2, engine_threads=2,
+                 async_mode=False, lease_ms=500)
+    servers = [("127.0.0.1", port)]
+    lib = load_lib()
+    x0 = np.linspace(0, 1, 64, dtype=np.float32)
+    x1 = np.linspace(2, 3, 64, dtype=np.float32)
+    w0 = PSWorker(servers=servers, worker_id=0, health_interval_ms=50)
+    w1 = PSWorker(servers=servers, worker_id=1, health_interval_ms=0)
+    try:
+        w0.init_key(0, 256)
+        w1.init_key(0, 256)
+        # round 1 closes at FULL membership; nobody pulls it yet
+        v0 = w0.push(0, x0)
+        w1.push(0, x1)
+        # worker 1 dies; wait out the eviction (epoch bumps)
+        w1.close()
+        deadline = time.time() + 10
+        while time.time() < deadline and lib.bps_server_epoch() == 0:
+            time.sleep(0.05)
+        assert lib.bps_server_epoch() == 1
+        # (a) the delayed pull of the pre-eviction round: full sum AND
+        # the pre-eviction live count as its divisor authority
+        np.testing.assert_array_equal(w0.pull(0, 64, v0), x0 + x1)
+        assert w0.last_round_live() == 2, (
+            "round closed at full membership must carry live=2 even "
+            "when pulled after the eviction")
+
+        # (b) re-admit worker 1 via a bare heartbeat (no rejoin), then
+        # re-send the round it missed: round 2 closes without it first
+        v0 = w0.push(0, x0)
+        np.testing.assert_array_equal(w0.pull(0, 64, v0), x0)
+        w1c = PSWorker(servers=servers, worker_id=1, health_interval_ms=0)
+        w1c.ping(0)  # heartbeat re-admits (epoch 2) — but NO round sync
+        # recreate the wedged worker's pre-eviction state: it had MINTED
+        # round 2 before going silent (counter = 2, push never landed)
+        w1c.adopt_rounds({0: 2}, {0: 256})
+        with pytest.raises(WorkerEvictedError, match="stale round"):
+            # version 2 = the round that closed without worker 1
+            # (> its applied watermark 1, <= the key's closed-round 2)
+            w1c.push_bytes(0, x1.view(np.uint8).ravel(), 0, version=2)
+        # the refusal triggered the inline rejoin: watermarks adopted,
+        # and a FRESH push now joins the open round correctly
+        versions, _ = w1c.export_rounds()
+        assert versions.get(0) == 2, versions
+        w1c.push(0, x1)
+        v0 = w0.push(0, x0)
+        np.testing.assert_array_equal(w0.pull(0, 64, v0), x0 + x1)
+        assert w0.last_round_live() == 2
+        w1c.close()
+    finally:
+        for w in (w0, w1):
+            try:
+                w.close()
+            except Exception:
+                pass
+        stop_server()
+        config_mod.reset_config()
+
+
+def test_worker_death_chaos_smoke_survivor_completes(monkeypatch):
+    """THE tier-1 worker-death smoke (acceptance criterion): 2 DcnCore
+    workers, ``worker:kill`` fires on worker 1 mid-run (its 4th-round
+    push never leaves). The survivor's training run COMPLETES — no hang:
+    the lease eviction re-targets the stalled round — with (a) pre-kill
+    rounds summing both workers, (b) every surviving-epoch round
+    BIT-IDENTICAL to a 1-worker clean run (= the pushed vector itself,
+    raw wire), (c) exactly one eviction + epoch bump in the counters,
+    (d) zero credit leak, and (e) the victim's handle failing with
+    WorkerKilledError instead of wedging its thread."""
+    import threading
+
+    from byteps_tpu.common import config as config_mod
+    from byteps_tpu.common.dcn_adapter import DcnCore
+    from byteps_tpu.common.faults import WorkerKilledError
+    from byteps_tpu.common.scheduler import PartitionFailure
+
+    monkeypatch.setenv("DMLC_NUM_WORKER", "2")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+    config_mod.reset_config()
+    port = BASE_PORT + 14
+    start_server(port=port, num_workers=2, engine_threads=2,
+                 async_mode=False, lease_ms=400)
+    servers = [("127.0.0.1", port)]
+    lib = load_lib()
+    rng = np.random.default_rng(3)
+    x0 = rng.standard_normal(4096).astype(np.float32)
+    x1 = rng.standard_normal(4096).astype(np.float32)
+    kill_round = 3   # victim dies on its round-4 push:
+    # plan ops = init(1) + {push,pull} per round → round-4 push = op 8
+    total_rounds = 8
+    cores = {}
+    results = {0: [], 1: []}
+    errors = {}
+    barrier = threading.Barrier(2, timeout=60)
+
+    def body(widx, flat, spec):
+        core = DcnCore(servers=servers, worker_id=widx,
+                       fault_specs=[spec] if spec else None,
+                       health_interval_ms=50 if widx == 0 else 0)
+        cores[widx] = core
+        barrier.wait()
+        for r in range(total_rounds):
+            h = core.push_pull_async(flat, name="wd")
+            try:
+                results[widx].append(DcnCore.assemble(h, timeout=60.0))
+            except PartitionFailure as e:
+                errors[widx] = e
+                return
+
+    ts = [
+        threading.Thread(target=body, args=(0, x0, None)),
+        threading.Thread(target=body, args=(1, x1, "worker:kill@step=8..")),
+    ]
+    try:
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+            assert not t.is_alive(), "worker hung under worker death"
+
+        # victim: died on round 4's push, handle failed diagnosably
+        assert len(results[1]) == kill_round
+        assert isinstance(errors[1].cause, WorkerKilledError), errors[1]
+
+        # survivor completed ALL rounds: pre-kill rounds sum both
+        # workers, surviving-epoch rounds are bit-identical to the
+        # 1-worker clean run (raw wire single push = memcpy of x0)
+        assert len(results[0]) == total_rounds and 0 not in errors
+        for r in range(kill_round):
+            np.testing.assert_array_equal(results[0][r], x0 + x1,
+                                          err_msg=f"round {r}")
+        for r in range(kill_round, total_rounds):
+            np.testing.assert_array_equal(results[0][r], x0,
+                                          err_msg=f"round {r}")
+
+        # exactly one eviction + epoch bump, seen and adopted
+        assert lib.bps_server_epoch() == 1
+        c = cores[0].worker.get_counters()
+        assert c["membership_events"] == 1, c
+        assert c["live_pods"] == 1, c
+        assert cores[0].live_size() == 1
+
+        # zero credit leak across the stall + eviction
+        sched = cores[0].scheduler
+        assert sched._credits == sched._credit_total
+    finally:
+        try:
+            if 1 in cores:
+                # victim "process death": no goodbye, just drop sockets
+                cores[1].scheduler.shutdown()
+                for w in cores[1].workers:
+                    w.close()
+            if 0 in cores:
+                cores[0].shutdown()
+        finally:
+            stop_server()
+            config_mod.reset_config()
+
+
+def test_handle_deadline_caps_every_wait(monkeypatch):
+    """Acceptance: no configuration can make Handle.wait() block past
+    BYTEPS_HANDLE_DEADLINE_MS — timeout=None and any larger explicit
+    timeout are capped, and the expiry is a diagnosable StallError
+    carrying the attached per-stage/per-server counters."""
+    from byteps_tpu.common import config as config_mod
+    from byteps_tpu.common.scheduler import Handle, StallError
+
+    monkeypatch.setenv("BYTEPS_HANDLE_DEADLINE_MS", "300")
+    config_mod.reset_config()
+    try:
+        h = Handle("stalled", 2)
+        h._partition_done(0, "done-part")
+        h.diag = lambda: {"retries": 7, "live_servers": [0],
+                          "health_last_probe_age_ms": 12}
+        t0 = time.time()
+        with pytest.raises(StallError) as ei:
+            h.wait(None)  # would block FOREVER without the deadline
+        assert time.time() - t0 < 5.0
+        e = ei.value
+        assert isinstance(e, TimeoutError)  # existing callers still catch
+        assert e.deadline_capped
+        assert e.done_parts == [0] and e.total_parts == 2
+        # the stall report shows WHY failover/retry did or didn't fire
+        assert "retries" in str(e) and "health_last_probe_age_ms" in str(e)
+        # an explicit timeout larger than the cap is still capped
+        t0 = time.time()
+        with pytest.raises(StallError):
+            h.wait(60.0)
+        assert time.time() - t0 < 5.0
+        # a failing diag callback must not mask the stall
+        h.diag = lambda: 1 / 0
+        with pytest.raises(StallError, match="diag_error"):
+            h.wait(None)
+    finally:
+        monkeypatch.delenv("BYTEPS_HANDLE_DEADLINE_MS", raising=False)
+        config_mod.reset_config()
+
+
+def test_race_smoke_tsan():
+    """Satellite: the csrc TSAN race smoke as a buildable one-shot
+    (scripts/race_smoke.sh), run from tier-1 when a TSAN toolchain is
+    present — server-side concurrency changes (this PR adds lease state
+    beside the per-key slot mutexes) stay race-clean."""
+    import os
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        ["bash", os.path.join(repo, "scripts", "race_smoke.sh")],
+        capture_output=True, timeout=570,
+    )
+    if r.returncode == 77:
+        pytest.skip("no ThreadSanitizer toolchain in this image")
+    assert r.returncode == 0, (r.stdout.decode()[-2000:],
+                               r.stderr.decode()[-2000:])
+    assert b"race_smoke: OK" in r.stdout
+
+
+@pytest.mark.slow
+def test_worker_hang_wedge_then_rejoin(monkeypatch):
+    """``worker:hang``: the worker wedges (ops block, heartbeats stop),
+    the server lease evicts it, peers keep summing over the live set;
+    when the window expires the worker's monitor heartbeat re-admits it
+    and it resumes with adopted rounds."""
+    from byteps_tpu.common import config as config_mod
+
+    config_mod.reset_config()
+    port = BASE_PORT + 16
+    start_server(port=port, num_workers=2, engine_threads=2,
+                 async_mode=False, lease_ms=300)
+    servers = [("127.0.0.1", port)]
+    lib = load_lib()
+    x0 = np.linspace(-1, 1, 64, dtype=np.float32)
+    x1 = np.linspace(1, 2, 64, dtype=np.float32)
+    from byteps_tpu.common.faults import FaultPlan
+
+    # w1 wedges for 1.2 s on its plan-op 5 (round-2 push)
+    plan = FaultPlan(parse_fault_spec("worker:hang@step=4,ms=1200"),
+                     seed=0, worker_id=1)
+    w0 = PSWorker(servers=servers, worker_id=0, health_interval_ms=50)
+    w1 = PSWorker(servers=servers, worker_id=1, health_interval_ms=50,
+                  fault_plan=plan)
+    try:
+        w0.init_key(0, 256)  # w0 op: init
+        w1.init_key(0, 256)  # w1 op 1 (+ping ops from its monitor)
+        v0 = w0.push(0, x0)
+        w1.push(0, x1)
+        np.testing.assert_array_equal(w0.pull(0, 64, v0), x0 + x1)
+
+        # w1's next push hits the hang window (whichever op ticks 4th,
+        # monitor pings included — the window is per plan op), wedging
+        # it past the lease: w0's rounds continue over the live set
+        import threading
+
+        def wedged():
+            try:
+                w1.push(0, x1)
+            except Exception:
+                pass
+
+        t = threading.Thread(target=wedged)
+        t.start()
+        deadline = time.time() + 10
+        while time.time() < deadline and lib.bps_server_epoch() == 0:
+            time.sleep(0.05)
+        assert lib.bps_server_epoch() >= 1, "wedged worker never evicted"
+        v0 = w0.push(0, x0)
+        out = w0.pull(0, 64, v0)
+        # w1 MAY have contributed its round-2 push before wedging;
+        # either way the round closes over the live set
+        assert out.shape == (64,)
+        t.join(timeout=30)
+        assert not t.is_alive()
+
+        # after the window the monitor's heartbeat re-admits w1
+        deadline = time.time() + 15
+        while time.time() < deadline and lib.bps_server_epoch() < 2:
+            time.sleep(0.05)
+        assert lib.bps_server_epoch() >= 2, "unwedged worker never rejoined"
+    finally:
+        for w in (w0, w1):
+            try:
+                w.close()
+            except Exception:
+                pass
+        stop_server()
+        config_mod.reset_config()
 
 
 def test_mixed_degraded_handle_scales_per_partition(monkeypatch):

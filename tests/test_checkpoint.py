@@ -126,6 +126,91 @@ def test_manager_retention_cadence_and_gating(tmp_path):
         assert ro.latest_step() == 6
 
 
+@pytest.mark.slow
+def test_crash_resume_matches_uninterrupted(tmp_path, monkeypatch):
+    """Crash-resume against a LIVE server tier (satellite): a training
+    loop aggregating grads through the DCN PS checkpoints every step via
+    ``Checkpointer``; an injected ``worker:kill`` crashes it mid-step.
+    A fresh worker (simulated process restart) REJOINS — adopting the
+    server's round watermarks, without which its re-minted round 1 would
+    be silently dedupe-dropped — restores the latest checkpoint, and the
+    resumed trajectory matches the uninterrupted run BIT-FOR-BIT."""
+    import dataclasses as dc
+
+    from byteps_tpu.common import config as config_mod
+    from byteps_tpu.common.faults import (
+        FaultPlan,
+        WorkerKilledError,
+        parse_fault_spec,
+    )
+    from byteps_tpu.server import PSWorker, start_server, stop_server
+
+    config_mod.reset_config()
+    port = 25840
+    start_server(port=port, num_workers=1, engine_threads=2,
+                 async_mode=False)
+    servers = [("127.0.0.1", port)]
+    n, steps, lr = 128, 6, np.float32(0.05)
+    base = np.linspace(-1.0, 1.0, n).astype(np.float32)
+
+    def grad_of(params, step):
+        # deterministic, params-dependent: any resume divergence compounds
+        return (0.1 * params + base * np.float32(step + 1)).astype(
+            np.float32)
+
+    def train(worker, params, ck, start_step, end_step):
+        for s in range(start_step, end_step):
+            g = grad_of(params, s)
+            v = worker.push(0, g)
+            agg = worker.pull(0, n, v)  # 1 worker: sum == own grad
+            params = (params - lr * agg).astype(np.float32)
+            if ck is not None:
+                ck.save(s, {"params": jnp.asarray(params), "step": s},
+                        force=True)
+                ck.wait()
+        return params
+
+    try:
+        # uninterrupted reference run (no checkpoints, same server tier)
+        w = PSWorker(servers=servers, worker_id=0)
+        w.init_key(0, n * 4)
+        params_clean = train(w, np.zeros(n, np.float32), None, 0, steps)
+        w.close()
+        stop_server()
+
+        # crashed run on a FRESH server: worker:kill fires on the step-4
+        # push (plan ops: init=1, then push/pull per step → op 10)
+        start_server(port=port + 1, num_workers=1, engine_threads=2,
+                     async_mode=False)
+        servers = [("127.0.0.1", port + 1)]
+        plan = FaultPlan(parse_fault_spec("worker:kill@step=10.."), seed=0)
+        w = PSWorker(servers=servers, worker_id=0, fault_plan=plan)
+        w.init_key(0, n * 4)
+        params = np.zeros(n, np.float32)
+        with Checkpointer(tmp_path / "crash", max_to_keep=None,
+                          async_save=False) as ck:
+            with pytest.raises(WorkerKilledError):
+                train(w, params, ck, 0, steps)
+            assert ck.latest_step() == 3  # steps 0..3 committed pre-crash
+
+            # resume: fresh worker = restarted process. rejoin() adopts
+            # the server round watermarks (versions 1..4 consumed) so the
+            # next push mints round 5 instead of a dedupe-dropped round 1
+            w2 = PSWorker(servers=servers, worker_id=0)
+            w2.rejoin()
+            versions, nbytes = w2.export_rounds()
+            assert versions.get(0) == 4 and nbytes.get(0) == n * 4
+            restored = ck.restore(
+                {"params": jnp.zeros(n, jnp.float32), "step": 0})
+        params = np.asarray(restored["params"], np.float32)
+        resumed = train(w2, params, None, int(restored["step"]) + 1, steps)
+        np.testing.assert_array_equal(resumed, params_clean)
+        w2.shutdown()
+    finally:
+        stop_server()
+        config_mod.reset_config()
+
+
 def test_restore_missing_raises(tmp_path):
     with Checkpointer(tmp_path / "empty") as ck:
         with pytest.raises(FileNotFoundError):
