@@ -1801,7 +1801,21 @@ def bench_chaos(payload_mb: int = 8, rounds: int = 4, reps: int = 3) -> dict:
         "retry_limit": 8,
         "retry_backoff_ms": 10,
         "results": results,
+        # the always-on telemetry plane's own view of the whole chaos
+        # run (docs/observability.md): injected/retry/failover totals
+        # survive every NIC retirement, unlike per-worker counters
+        "telemetry": _telemetry_counters(),
     }
+
+
+def _telemetry_counters() -> dict:
+    """Nonzero counters from byteps_tpu.metrics_snapshot() — the compact
+    registry view bench artifacts embed."""
+    import byteps_tpu
+
+    snap = byteps_tpu.metrics_snapshot()
+    return {k: v for k, v in snap["metrics"]["counters"].items()
+            if v and "." not in k.split(".", 1)[-1]}
 
 
 def bench_tuner(payload_mb: int = 8, max_moves: int = 40,
@@ -1913,6 +1927,129 @@ def bench_tuner(payload_mb: int = 8, max_moves: int = 40,
     }
 
 
+# --- perf-trend regression gate (--mode trend) -------------------------------
+# The measured trajectory this repo has banked (throttled compression
+# 10.3x, sharded-wire hybrid 3.39x, chaos worst-case 0.29x of clean)
+# must never silently regress: every perf PR re-runs the bench legs
+# (they rewrite BENCH_*.json in place) and the trend gate compares the
+# fresh headline metrics against spread-aware floors checked in as
+# BENCH_trend.json. Refresh after an INTENTIONAL trajectory change with
+#     python bench.py --mode trend --refresh
+# (one command; commit the rewritten BENCH_trend.json with the PR that
+# moved the numbers). docs/observability.md#trend-gate.
+TREND_FILE = "BENCH_trend.json"
+_TREND_SPECS = (
+    # (artifact, dotted path to the headline metric; all are
+    #  higher-is-better ratios)
+    ("BENCH_throttled.json", "results.200.onebit.speedup_vs_raw"),
+    ("BENCH_throttled.json", "results.200.topk.speedup_vs_raw"),
+    ("BENCH_hybrid.json", "value"),
+    ("BENCH_chaos.json", "value"),
+)
+
+
+def _json_path(doc, path: str):
+    cur = doc
+    for part in path.split("."):
+        cur = cur[int(part)] if isinstance(cur, list) else cur[part]
+    return cur
+
+
+def _max_rel_spread(doc) -> float:
+    """Worst relative rep spread recorded anywhere in a bench artifact:
+    every timing leg carries ``sec_spread: [lo, hi]`` beside its median
+    (``sec_med`` / ``sec_per_round_med``). A ratio of two such medians
+    can legitimately move by about this much run-to-run, so the floor
+    slack scales with it — noisy benches get loose floors instead of a
+    gate that cries wolf."""
+    worst = 0.0
+    stack = [doc]
+    while stack:
+        d = stack.pop()
+        if isinstance(d, dict):
+            sp = d.get("sec_spread")
+            med = d.get("sec_med", d.get("sec_per_round_med"))
+            if (isinstance(sp, (list, tuple)) and len(sp) == 2
+                    and isinstance(med, (int, float)) and med > 0):
+                worst = max(worst, (float(sp[1]) - float(sp[0])) / med)
+            stack.extend(d.values())
+        elif isinstance(d, list):
+            stack.extend(d)
+    return worst
+
+
+def _trend_margin(rel_spread: float) -> float:
+    # at least 10% slack (timing never reproduces exactly), at most 50%
+    # (beyond that the gate stops meaning anything — a metric that noisy
+    # needs more reps, not more slack)
+    return min(0.5, max(0.1, rel_spread))
+
+
+def trend_refresh(bench_dir: str = ".") -> dict:
+    """Rebuild BENCH_trend.json's floors from the bench artifacts in
+    ``bench_dir`` — the one-command refresh path after an intentional
+    trajectory change."""
+    rows = []
+    for fname, path in _TREND_SPECS:
+        fpath = os.path.join(bench_dir, fname)
+        with open(fpath) as f:
+            doc = json.load(f)
+        value = float(_json_path(doc, path))
+        margin = _trend_margin(_max_rel_spread(doc))
+        rows.append({
+            "file": fname,
+            "path": path,
+            "value": round(value, 4),
+            "rel_spread": round(_max_rel_spread(doc), 4),
+            "floor": round(value * (1.0 - margin), 4),
+        })
+    return {
+        "metric": "perf-trend floors (bench.py --mode trend gate)",
+        "refresh": "python bench.py --mode trend --refresh",
+        "metrics": rows,
+    }
+
+
+def trend_check(trend: dict, bench_dir: str = ".") -> dict:
+    """Compare the bench artifacts in ``bench_dir`` against the checked-in
+    floors; ``pass`` is False when any headline metric fell below its
+    spread-aware floor (bench_all.sh exits nonzero on that)."""
+    checks = []
+    ok = True
+    worst_ratio = None
+    for row in trend.get("metrics", []):
+        fpath = os.path.join(bench_dir, row["file"])
+        check = {"file": row["file"], "path": row["path"],
+                 "floor": row["floor"], "was": row["value"]}
+        try:
+            with open(fpath) as f:
+                fresh = float(_json_path(json.load(f), row["path"]))
+        except (OSError, KeyError, IndexError, TypeError, ValueError) as e:
+            check["error"] = f"{type(e).__name__}: {e}"
+            check["pass"] = False
+            ok = False
+            checks.append(check)
+            continue
+        passed = fresh >= row["floor"]
+        ratio = fresh / row["floor"] if row["floor"] > 0 else float("inf")
+        worst_ratio = ratio if worst_ratio is None else min(worst_ratio,
+                                                           ratio)
+        check["fresh"] = round(fresh, 4)
+        check["pass"] = passed
+        ok = ok and passed
+        checks.append(check)
+    return {
+        "metric": ("perf-trend regression gate (fresh BENCH_*.json vs "
+                   "checked-in spread-aware floors)"),
+        "value": round(worst_ratio, 3) if worst_ratio is not None else 0.0,
+        "unit": "x worst fresh/floor (>=1 = no regression)",
+        "vs_baseline": (round(worst_ratio, 3) if worst_ratio is not None
+                        else 0.0),
+        "pass": ok,
+        "checks": checks,
+    }
+
+
 def _devices_or_die(timeout_s: float) -> int:
     """Initialize the backend with a watchdog.
 
@@ -1952,8 +2089,13 @@ def main() -> None:
     ap.add_argument("--mode",
                     choices=["auto", "dcn", "dcn-profile", "throttled",
                              "tune", "chaos", "hybrid", "generate",
-                             "profile"],
+                             "profile", "trend"],
                     default="auto")
+    ap.add_argument("--refresh", action="store_true",
+                    help="trend mode: rebuild BENCH_trend.json's "
+                    "spread-aware floors from the current BENCH_*.json "
+                    "artifacts (run after an INTENTIONAL trajectory "
+                    "change, commit the result)")
     ap.add_argument("--rates", default="64,200,800",
                     help="throttled mode: comma-separated emulated link "
                     "rates in Mbps (BYTEPS_DCN_THROTTLE_MBPS sweep)")
@@ -1999,6 +2141,12 @@ def main() -> None:
         if args.mode == "throttled":
             rates = tuple(float(r) for r in args.rates.split(","))
             result = bench_throttled(rates_mbps=rates)
+            # artifact for the trend gate, like chaos/hybrid (only the
+            # full default sweep is trend-comparable)
+            if rates == (64.0, 200.0, 800.0):
+                with open("BENCH_throttled.json", "w") as f:
+                    json.dump(result, f, indent=1)
+                _log("bench: wrote BENCH_throttled.json")
         elif args.mode == "dcn":
             result = bench_dcn()
         elif args.mode == "tune":
@@ -2016,6 +2164,23 @@ def main() -> None:
             _log("bench: wrote BENCH_hybrid.json")
         else:
             result = bench_dcn_profile()
+    elif args.mode == "trend":
+        if args.refresh:
+            result = trend_refresh()
+            with open(TREND_FILE, "w") as f:
+                json.dump(result, f, indent=1)
+            _log(f"bench: wrote {TREND_FILE} "
+                 "(commit it with the PR that moved the trajectory)")
+        else:
+            with open(TREND_FILE) as f:
+                result = trend_check(json.load(f))
+            if not result["pass"]:
+                _log("bench: PERF TREND REGRESSION — a headline metric "
+                     "fell below its spread-aware floor (see checks[]); "
+                     "if intentional, refresh with: python bench.py "
+                     "--mode trend --refresh")
+                print(json.dumps(result), flush=True)
+                sys.exit(5)
     elif args.mode == "profile":
         n = _devices_or_die(
             float(os.environ.get("BYTEPS_BENCH_DEVICE_TIMEOUT", "600")))

@@ -35,3 +35,19 @@ if "jax" in _sys.modules:
     _ensure_jax_compat()
 
 from byteps_tpu.common.config import Config, get_config  # noqa: F401,E402
+
+
+def metrics_snapshot() -> dict:
+    """One JSON-safe view of the always-on telemetry plane
+    (docs/observability.md): the unified metrics registry (scheduler
+    stage dwell/run percentiles, per-NIC wire bytes/attempts/retries,
+    pacer debt, ICI dispatch counts, fault injections, train-step
+    walltime) plus the flight recorder's ring occupancy. The hook bench
+    legs and tests assert against — and what ops would scrape."""
+    from byteps_tpu.common.flight_recorder import get_flight_recorder
+    from byteps_tpu.common.metrics import get_registry
+
+    return {
+        "metrics": get_registry().snapshot(),
+        "flight_recorder": get_flight_recorder().summary(),
+    }

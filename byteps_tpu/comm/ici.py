@@ -30,7 +30,30 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from byteps_tpu.common.metrics import get_registry
 from byteps_tpu.compression.base import Compressor
+
+
+# handle cache keyed by registry identity (tests reset the registry):
+# the dispatch path must not pay a name format + registry lookup per
+# collective — the metrics design rule is handles resolved once
+_dispatch_cache = {"reg": None, "counters": {}}
+
+
+def _count_dispatch(kind: str) -> None:
+    """Always-on ICI collective DISPATCH counter (host-side issue, not
+    device completion — the quantity the ici_lock serializes and a stall
+    report wants: did the host stop issuing, or did the device stop
+    finishing?). One registry counter per collective family."""
+    reg = get_registry()
+    if _dispatch_cache["reg"] is not reg:
+        _dispatch_cache["reg"] = reg
+        _dispatch_cache["counters"] = {}
+    c = _dispatch_cache["counters"].get(kind)
+    if c is None:
+        c = reg.counter(f"ici.{kind}_dispatch")
+        _dispatch_cache["counters"][kind] = c
+    c.inc()
 
 
 def _segment(g: jnp.ndarray, n_dev: int):
@@ -57,6 +80,7 @@ def allreduce_flat(
 ) -> jnp.ndarray:
     """Uncompressed all-reduce of (N, L) → (L,): one fused psum."""
     axis = axis or mesh.axis_names[0]
+    _count_dispatch("allreduce")
     return _allreduce_impl(x, mesh=mesh, axis=axis, average=average)
 
 
@@ -96,6 +120,7 @@ def reduce_scatter_flat(
     trip (and per-owner compression) happen on the scattered form.
     """
     axis = axis or mesh.axis_names[0]
+    _count_dispatch("reduce_scatter")
     return _reduce_scatter_impl(x, mesh=mesh, axis=axis)
 
 
@@ -123,6 +148,7 @@ def all_gather_flat(
     the hierarchical tail (the reference's BROADCAST after COPYH2D).
     Exact: gathering moves bits, never sums."""
     axis = axis or mesh.axis_names[0]
+    _count_dispatch("all_gather")
     out = _all_gather_impl(x, mesh=mesh, axis=axis)
     if length is not None and length != out.shape[0]:
         out = jax.lax.slice_in_dim(out, 0, length, axis=0)
@@ -149,6 +175,7 @@ def broadcast_flat(
     implements ``broadcast_parameters`` (byteps/torch/__init__.py).
     """
     axis = axis or mesh.axis_names[0]
+    _count_dispatch("broadcast")
     return _broadcast_impl(x, mesh=mesh, axis=axis, root=root)
 
 
@@ -388,6 +415,7 @@ def compressed_allreduce_flat(
     applied and ``(out, new_residual)`` is returned.
     """
     axis = axis or mesh.axis_names[0]
+    _count_dispatch("compressed_allreduce")
     if rng is None:
         if compressor.stochastic:
             raise ValueError(

@@ -176,6 +176,24 @@ class Config:
     # blocking forever. 0 = only the caller's own timeout applies.
     handle_deadline_ms: int = 0
 
+    # --- telemetry plane (docs/observability.md) ---------------------------
+    # Always-on metrics registry (common/metrics.py): counters, gauges,
+    # fixed-bucket latency/size histograms threaded through every layer
+    # (scheduler stages, per-NIC wire, pacer, ICI dispatch, faults,
+    # train-step walltime). 0 swaps every handle for a no-op.
+    metrics_on: bool = True
+    # Flight recorder (common/flight_recorder.py): bounded ring of
+    # per-step metric snapshots, dumped on StallError/PartitionFailure.
+    # 0 disables the per-step ring (FAULT events still recorded).
+    flight_recorder_steps: int = 64
+    # Recent FAULT-class events (retries, failovers, evictions,
+    # membership changes) kept for the post-mortem; 0 disables.
+    flight_recorder_events: int = 128
+    # When set: post-mortems are ALSO written as JSON files into this
+    # directory (one per distinct failure reason per run); empty = the
+    # post-mortem only rides the raised error object.
+    flight_recorder_dir: str = ""
+
     # --- tracing (SURVEY §5.1) ---------------------------------------------
     trace_on: bool = False
     trace_dir: str = "./traces"
@@ -236,6 +254,12 @@ class Config:
             degraded_ok=_env_bool("BYTEPS_DEGRADED_OK", True),
             worker_lease_ms=_env_int("BYTEPS_WORKER_LEASE_MS", 0),
             handle_deadline_ms=_env_int("BYTEPS_HANDLE_DEADLINE_MS", 0),
+            metrics_on=_env_bool("BYTEPS_METRICS_ON", True),
+            flight_recorder_steps=_env_int("BYTEPS_FLIGHT_RECORDER_STEPS",
+                                           64),
+            flight_recorder_events=_env_int("BYTEPS_FLIGHT_RECORDER_EVENTS",
+                                            128),
+            flight_recorder_dir=_env_str("BYTEPS_FLIGHT_RECORDER_DIR", ""),
             trace_on=_env_bool("BYTEPS_TRACE_ON"),
             trace_dir=_env_str("BYTEPS_TRACE_DIR", "./traces"),
             trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 1),

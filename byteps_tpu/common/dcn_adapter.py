@@ -25,6 +25,13 @@ from byteps_tpu.common.scheduler import (
     PipelineScheduler,
     Stage,
 )
+from byteps_tpu.common.stage_orders import (  # noqa: F401 - re-exported;
+    # the canonical orders live in the light leaf module so
+    # trace_analysis can learn them without importing the data plane
+    DCN_STAGE_ORDER,
+    EAGER_STAGE_ORDER,
+    HYBRID_STAGE_ORDER,
+)
 from byteps_tpu.common.tracing import get_tracer
 from byteps_tpu.compression.wire import (
     Fp16Wire,
@@ -99,6 +106,9 @@ def stall_diag(workers, owners, scheduler):
     return {
         "workers": {f"nic{r}": w.get_counters()
                     for r, w in enumerate(workers)},
+        "wire_bytes": {f"nic{r}": {"pushed": w.bytes_pushed,
+                                   "pulled": w.bytes_pulled}
+                       for r, w in enumerate(workers)},
         "live_servers": {f"nic{r}": sorted(w.live_servers())
                          for r, w in enumerate(workers)},
         "live_owners": (sorted(owners.live())
@@ -242,20 +252,26 @@ class DcnCore:
         # number instead of failing the Handle. Sharded pods scope credits
         # per owner: each NIC gets its own in-flight bound, so one faulted
         # owner backing off cannot starve its siblings' wires.
+        stages = [
+            Stage("COMPRESS", self._compress_stage, credited=True,
+                  pool_size=2),
+            # +1 attempt per extra controller: a total-DCN-outage
+            # walk-down spends one stage attempt failing each owner
+            # over before the last controller may degrade
+            Stage("PUSH", self._push_stage, credited=True, pool_size=4,
+                  releases_credit=True, retryable=True,
+                  max_attempts=2 + pod_controllers),
+            Stage("PULL", self._pull_stage, pool_size=4,
+                  retryable=True, max_attempts=2 + pod_controllers),
+            Stage("DECOMPRESS", self._decompress_stage, pool_size=2),
+        ]
+        # pinned against the declared order trace_analysis sorts by — a
+        # stage added here without updating DCN_STAGE_ORDER is a bug
+        bps_check(
+            tuple(s.name for s in stages) == DCN_STAGE_ORDER,
+            "DcnCore stage list drifted from DCN_STAGE_ORDER")
         self.scheduler = PipelineScheduler(
-            stages=[
-                Stage("COMPRESS", self._compress_stage, credited=True,
-                      pool_size=2),
-                # +1 attempt per extra controller: a total-DCN-outage
-                # walk-down spends one stage attempt failing each owner
-                # over before the last controller may degrade
-                Stage("PUSH", self._push_stage, credited=True, pool_size=4,
-                      releases_credit=True, retryable=True,
-                      max_attempts=2 + pod_controllers),
-                Stage("PULL", self._pull_stage, pool_size=4,
-                      retryable=True, max_attempts=2 + pod_controllers),
-                Stage("DECOMPRESS", self._decompress_stage, pool_size=2),
-            ],
+            stages=stages,
             credit=cfg.scheduling_credit,
             tracer=get_tracer(),
             credit_scope="owner" if pod_controllers > 1 else "global",

@@ -270,6 +270,8 @@ class FaultPlan:
 
     def __init__(self, rules: List[FaultRule], seed: int = 0,
                  worker_id: int = 0):
+        from byteps_tpu.common.metrics import get_registry
+
         self.rules = list(rules)
         self.seed = seed
         self.worker_id = worker_id
@@ -277,6 +279,12 @@ class FaultPlan:
         self._lock = threading.Lock()
         self._step = 0
         self.injected: Dict[str, int] = {k: 0 for k in KINDS}
+        # always-on registry mirror: per-plan counts die with the plan's
+        # PSWorker (owner failover retires it); the process-wide
+        # faults.injected_* totals do not (docs/observability.md)
+        _reg = get_registry()
+        self._m_injected = {k: _reg.counter(f"faults.injected_{k}")
+                            for k in KINDS}
 
     @property
     def step(self) -> int:
@@ -293,9 +301,11 @@ class FaultPlan:
                     continue
                 if r.kind == "slow":
                     self.injected["slow"] += 1
+                    self._m_injected["slow"].inc()
                     sleep_ms += r.latency_ms
                     continue  # latency composes with a later loss rule
                 self.injected[r.kind] += 1
+                self._m_injected[r.kind].inc()
                 hit = Injection(kind=r.kind, rule=r,
                                 corrupt_at=self._rng.randrange(1 << 30))
                 break

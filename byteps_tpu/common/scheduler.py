@@ -29,15 +29,74 @@ from __future__ import annotations
 
 import dataclasses
 import heapq
+import itertools
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from byteps_tpu.common.flight_recorder import get_flight_recorder
 from byteps_tpu.common.logging import get_logger
+from byteps_tpu.common.metrics import get_registry
 from byteps_tpu.common.partition import Partition
 from byteps_tpu.common.tracing import TraceRecorder
 
 log = get_logger("scheduler")
+
+
+# --- stage-order registry ----------------------------------------------------
+# Pipeline-order of every stage name any scheduler has declared, merged
+# across pipelines (order-preserving: a new name is inserted after its
+# predecessor in the registering sequence). This is what
+# ``trace_analysis`` sorts its display by — derived from the pipelines
+# that EMIT the events instead of a hand-kept list that had to remember
+# ALLGATHER by hand (PR 4). Pipelines register at import time (the
+# offline-analysis case: dcn_adapter declares the worker orders;
+# trace_analysis adds the server rows after them) AND every PipelineScheduler
+# re-registers its actual stage list at construction, so a stage added
+# to a constructor without updating the declared constant still lands
+# in the order — and the coverage test catches the drift.
+_stage_order: List[str] = []
+_stage_order_lock = threading.Lock()
+
+# sequential id per PipelineScheduler: the credit-occupancy gauge is a
+# per-scheduler series — two concurrent schedulers (bench's two-worker
+# legs run two DcnCores in one process) sharing one gauge would mask
+# each other last-writer-wins, exactly when occupancy matters
+_SCHED_SEQ = itertools.count()
+
+
+def register_stage_order(names: Sequence[str]) -> None:
+    """Merge a pipeline's stage-name sequence into the global order:
+    each new name lands after its last already-known predecessor in the
+    registering sequence, or before its first known successor, or at the
+    end (a pipeline unrelated to every existing one appends whole)."""
+    seq = [str(n) for n in names]
+    with _stage_order_lock:
+        for i, n in enumerate(seq):
+            if n in _stage_order:
+                continue
+            pred = -1
+            for p in seq[:i]:
+                if p in _stage_order:
+                    pred = max(pred, _stage_order.index(p))
+            if pred >= 0:
+                _stage_order.insert(pred + 1, n)
+                continue
+            succ = None
+            for q in seq[i + 1:]:
+                if q in _stage_order:
+                    succ = _stage_order.index(q)
+                    break
+            if succ is not None:
+                _stage_order.insert(succ, n)
+            else:
+                _stage_order.append(n)
+
+
+def registered_stage_order() -> List[str]:
+    with _stage_order_lock:
+        return list(_stage_order)
 
 
 class StallError(TimeoutError):
@@ -69,6 +128,9 @@ class StallError(TimeoutError):
         self.total_parts = total_parts
         self.diag = diag
         self.deadline_capped = deadline_capped
+        # flight-recorder post-mortem (per-step metric ring + recent
+        # FAULT events), attached at raise time by Handle.wait()
+        self.post_mortem: Optional[Dict[str, Any]] = None
 
 
 class PartitionFailure(RuntimeError):
@@ -92,6 +154,8 @@ class PartitionFailure(RuntimeError):
         self.cause = cause
         self.partial_results = partial_results
         self.__cause__ = cause
+        # flight-recorder post-mortem, attached by Handle._partition_failed
+        self.post_mortem: Optional[Dict[str, Any]] = None
 
 
 class Handle:
@@ -114,6 +178,7 @@ class Handle:
         self._event = threading.Event()
         self._lock = threading.Lock()
         self._error: Optional[BaseException] = None
+        self._stall_recorded = False  # one FAULT-ring event per handle
         self.results: Dict[int, Any] = {}  # part_idx -> stage-pipeline output
         # Optional stall-diagnostics callback attached by the owning
         # pipeline: () -> dict of per-stage/per-server counters, folded
@@ -132,10 +197,40 @@ class Handle:
     def _partition_failed(self, exc: BaseException,
                           part_idx: Optional[int] = None) -> None:
         with self._lock:
-            if self._error is None:
-                self._error = PartitionFailure(
+            first = self._error is None
+            if first:
+                err = PartitionFailure(
                     self.name, part_idx, exc, dict(self.results))
+                self._error = err
+            else:
+                # already failed and signalled; nothing left to do
+                return
+        # flight-recorder post-mortem rides the FIRST failure
+        # (docs/observability.md): the ring shows the steps leading up
+        # to it, not just the moment of death. Assembled OUTSIDE the
+        # handle lock (the registry snapshot must not block sibling
+        # completions or waiters), the event signalled right after the
+        # attach so a woken waiter always sees it, and the optional
+        # FILE dump deferred past the signal — a slow disk must not
+        # hold every waiter long enough to misread the failure as a
+        # stall.
+        fr = pm = None
+        try:
+            fr = get_flight_recorder()
+            fr.record_event("partition_failure", {
+                "handle": self.name, "part": part_idx,
+                "error": type(exc).__name__})
+            pm = fr.post_mortem(reason="partition_failure", dump=False)
+            err.post_mortem = pm
+        except Exception:  # noqa: BLE001 - telemetry must never mask
+            pass           # the original failure
+        finally:
             self._event.set()
+        if fr is not None and pm is not None:
+            try:
+                fr.maybe_dump("partition_failure", pm)
+            except Exception:  # noqa: BLE001
+                pass
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -169,9 +264,31 @@ class Handle:
                     diag = {"diag_error": f"{type(e).__name__}: {e}"}
             with self._lock:
                 done = sorted(self.results)
-            raise StallError(self.name, effective, done,
+            err = StallError(self.name, effective, done,
                              self._num_partitions, diag,
                              deadline_capped=capped)
+            # the always-on flight recorder's post-mortem rides EVERY
+            # stall (with or without a pipeline diag callback): the
+            # per-step ring + recent FAULT events show the run's shape
+            # before the moment of death. The FAULT-ring event is
+            # recorded once per handle: poll-style waiters (short
+            # timeout in a loop, catching TimeoutError) re-raise this
+            # every slice, and per-raise events would evict the genuine
+            # retry/failover history the ring exists to keep.
+            try:
+                fr = get_flight_recorder()
+                with self._lock:
+                    first = not self._stall_recorded
+                    self._stall_recorded = True
+                if first:
+                    fr.record_event("stall", {
+                        "handle": self.name, "done": len(done),
+                        "total": self._num_partitions,
+                        "deadline_capped": capped})
+                err.post_mortem = fr.post_mortem(reason="stall")
+            except Exception:  # noqa: BLE001 - telemetry must never
+                pass           # mask the stall itself
+            raise err
         if self._error is not None:
             raise self._error
         return self.results
@@ -231,6 +348,11 @@ class PartitionTask:
     payload: Any = None        # stage functions read/replace this
     stage_idx: int = 0
     context: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # perf_counter of the last queue insertion (set by _StageQueue.push):
+    # issue_time − queued_at is the stage DWELL the metrics registry
+    # tracks per stage — queue wait is the quantity the priority
+    # scheduler exists to control
+    queued_at: float = 0.0
     # Credit ownership is PER-TASK state and must never live in
     # ``context``: the production pipelines share one context dict across
     # every partition of a tensor, which would let partition 0's credit
@@ -258,6 +380,7 @@ class _StageQueue:
         self._counter = 0
 
     def push(self, task: PartitionTask) -> None:
+        task.queued_at = time.perf_counter()
         self._counter += 1
         heapq.heappush(self._heap, (task.sort_key, self._counter, task))
 
@@ -325,6 +448,21 @@ class PipelineScheduler:
         if credit_scope not in ("global", "owner"):
             raise ValueError(f"unknown credit_scope {credit_scope!r}")
         self.stages = list(stages)
+        register_stage_order([s.name for s in self.stages])
+        # metrics handles resolved ONCE (near-zero hot path: the per-op
+        # cost is the metric's own lock + arithmetic, never a name
+        # lookup) — docs/observability.md
+        _reg = get_registry()
+        self._m_run = [_reg.histogram(f"scheduler.stage.{s.name}.run_us")
+                       for s in self.stages]
+        self._m_dwell = [_reg.histogram(f"scheduler.stage.{s.name}.dwell_us")
+                         for s in self.stages]
+        self._m_credit_in_use = _reg.gauge(
+            f"scheduler.s{next(_SCHED_SEQ)}.credits_in_use")
+        self._m_tasks_done = _reg.counter("scheduler.tasks_done")
+        self._m_tasks_failed = _reg.counter("scheduler.tasks_failed")
+        self._m_stage_retries = _reg.counter("scheduler.stage_retries")
+        self._credits_in_use = 0
         self._queues = [_StageQueue() for _ in self.stages]
         self._credit_total = max(1, credit)
         self._credit_scope = credit_scope
@@ -373,6 +511,8 @@ class PipelineScheduler:
 
     def _acquire_credit_locked(self, task: PartitionTask) -> None:
         task.holds_credit = True
+        self._credits_in_use += 1
+        self._m_credit_in_use.set(self._credits_in_use)
         if self._credit_scope == "global":
             task.credit_pool = 0
             self._credits -= 1
@@ -386,6 +526,8 @@ class PipelineScheduler:
         if not task.holds_credit:
             return
         task.holds_credit = False
+        self._credits_in_use -= 1
+        self._m_credit_in_use.set(self._credits_in_use)
         if self._credit_scope == "global":
             self._credits = min(self._credits + 1, self._credit_total)
             return
@@ -495,6 +637,9 @@ class PipelineScheduler:
 
     def _run_stage(self, si: int, task: PartitionTask) -> None:
         stage = self.stages[si]
+        t_issue = time.perf_counter()
+        if task.queued_at:
+            self._m_dwell[si].observe((t_issue - task.queued_at) * 1e6)
         t0 = self._tracer._now_us() if self._tracer else 0.0
         try:
             result = stage.fn(task)
@@ -502,6 +647,7 @@ class PipelineScheduler:
             failed = None
         except BaseException as e:  # noqa: BLE001 - propagate via handle
             failed = e
+        self._m_run[si].observe((time.perf_counter() - t_issue) * 1e6)
         retrying = (
             failed is not None
             and stage.retryable
@@ -550,6 +696,7 @@ class PipelineScheduler:
                 self._release_credit_locked(task)
         if retrying:
             task.stage_attempts += 1
+            self._m_stage_retries.inc()
             delay = stage.retry_backoff_s * (2 ** (task.stage_attempts - 1))
             if self._tracer:
                 self._tracer.instant(
@@ -606,8 +753,10 @@ class PipelineScheduler:
             self._release_credit_locked(task)
             self._inflight -= 1
         if error is not None:
+            self._m_tasks_failed.inc()
             task.handle._partition_failed(error, task.partition.part_idx)
         else:
+            self._m_tasks_done.inc()
             task.handle._partition_done(task.partition.part_idx, task.payload)
         with self._idle:
             if self._inflight == 0:

@@ -1,0 +1,36 @@
+"""Canonical pipeline stage-name orders — a LIGHT leaf module.
+
+Declared here, away from the pipelines themselves, for exactly one
+reason: ``trace_analysis`` must be usable on an analysis-only box (a
+copied trace file, no jax installed), and importing the pipeline
+modules to learn their stage names would drag in the whole data plane
+(dcn_adapter → compression → jax). The pipelines stay the enforcement
+point — DcnCore and the jax adapter ``bps_check`` their BUILT stage
+lists against these constants at construction, and every
+``PipelineScheduler`` re-registers its live stage list — so a stage
+added to a constructor without updating its constant raises, instead
+of silently drifting (the PR 4 ALLGATHER problem this replaces).
+
+Importing this module registers every order into the scheduler's
+stage-order registry (worker pipelines first, server rows after).
+"""
+
+from __future__ import annotations
+
+from byteps_tpu.common.scheduler import register_stage_order
+
+# Host-adapter DCN pipeline (DcnCore) — reference core_loops.cc order.
+DCN_STAGE_ORDER = ("COMPRESS", "PUSH", "PULL", "DECOMPRESS")
+# Jax hybrid pipeline (reference root-GPU queue list); unsharded mode
+# runs the same order without the ALLGATHER tail.
+HYBRID_STAGE_ORDER = (("REDUCE", "COPYD2H") + DCN_STAGE_ORDER
+                      + ("COPYH2D", "ALLGATHER"))
+# Jax eager ICI pipeline.
+EAGER_STAGE_ORDER = ("PUSHPULL", "SYNC")
+# Per-key rows the C++ summation server's own chrome trace emits.
+SERVER_STAGE_ORDER = ("PUSH_RECV", "SUM", "PULL_RESP", "ROUND")
+
+register_stage_order(HYBRID_STAGE_ORDER)
+register_stage_order(DCN_STAGE_ORDER)
+register_stage_order(EAGER_STAGE_ORDER)
+register_stage_order(SERVER_STAGE_ORDER)

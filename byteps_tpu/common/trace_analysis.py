@@ -31,14 +31,25 @@ import json
 import sys
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-# Stage order of the hybrid pipeline (reference QueueType order,
-# byteps/common/common.h) — used to sort lifecycle rows for display;
-# unknown stages sort after, alphabetically.
-_STAGE_ORDER = [
-    "REDUCE", "COPYD2H", "COMPRESS", "PUSH", "PULL",
-    "DECOMPRESS", "COPYH2D", "ALLGATHER", "PUSHPULL",
-    "PUSH_RECV", "SUM", "PULL_RESP", "ROUND",
-]
+# Stage display order is DERIVED from the scheduler's stage-order
+# registry (reference QueueType order, byteps/common/common.h), not
+# hand-kept: importing stage_orders registers every pipeline's declared
+# order (DCN/HYBRID/EAGER + the server's per-key rows — the light leaf
+# module, so this CLI stays usable on an analysis-only box without
+# jax), and any PipelineScheduler built in this process re-registers
+# its live stage list. PR 4 had to remember to append ALLGATHER to the
+# old hand-kept list by hand; now a stage exists in the order the
+# moment its pipeline declares it (the pipelines bps_check their built
+# stage lists against the declared constants). Unknown stages still
+# sort after, alphabetically.
+from byteps_tpu.common import stage_orders as _orders  # noqa: F401
+from byteps_tpu.common.scheduler import registered_stage_order
+from byteps_tpu.common.stage_orders import SERVER_STAGE_ORDER as _SERVER_ROWS
+
+
+def stage_order() -> List[str]:
+    """Current pipeline-ordered stage names (see module comment)."""
+    return registered_stage_order()
 
 
 def load_events(path: str) -> List[Dict[str, Any]]:
@@ -121,12 +132,14 @@ def stage_stats(events: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
     for e in xs:
         groups.setdefault((e.get("pid"), e.get("tid")), []).append(e)
 
+    order = stage_order()
+
     def stage_key(item):
         (pid, tid), _ = item
         try:
-            si = _STAGE_ORDER.index(tid)
+            si = order.index(tid)
         except ValueError:
-            si = len(_STAGE_ORDER)
+            si = len(order)
         # numeric ranks first in numeric order, then string pids (servers)
         pid_key = (0, pid, "") if isinstance(pid, int) else (1, 0, str(pid))
         return (pid_key, si, str(tid))
@@ -167,7 +180,7 @@ def partition_lifecycles(
     rounds: Dict[Tuple[Any, str, int], List[Dict[str, Any]]] = {}
     for e in sorted(_complete_events(events), key=lambda e: e["ts"]):
         pid, name, tid = e.get("pid"), str(e.get("name")), e.get("tid")
-        if tid in ("PUSH_RECV", "SUM", "PULL_RESP", "ROUND"):
+        if tid in _SERVER_ROWS:
             continue  # server rows: per-key, not per-partition-occurrence
         occ = per_stage_seen.get((pid, name, tid), 0)
         per_stage_seen[(pid, name, tid)] = occ + 1

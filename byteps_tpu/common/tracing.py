@@ -22,7 +22,9 @@ import time
 from typing import Any, Dict, List, Optional
 
 from byteps_tpu.common.config import get_config
+from byteps_tpu.common.flight_recorder import get_flight_recorder
 from byteps_tpu.common.logging import get_logger
+from byteps_tpu.common.metrics import json_safe
 
 log = get_logger("tracing")
 
@@ -67,6 +69,11 @@ class TraceRecorder:
     def step(self) -> None:
         """Advance the step counter; auto-dump once past end_step."""
         self._step += 1
+        # ALWAYS-ON step boundary: the flight recorder snapshots the
+        # metrics registry per step regardless of trace_on — step
+        # advancement is the one signal every aggregation path already
+        # drives (docs/observability.md)
+        get_flight_recorder().on_step(self._step)
         self._maybe_xprof()
         if self.enabled and self._step > self.end_step:
             self.dump()
@@ -82,6 +89,7 @@ class TraceRecorder:
                 return
             self._step = step_no
             dump = self.enabled and self._step > self.end_step
+        get_flight_recorder().on_step(step_no)
         self._maybe_xprof()
         if dump:
             self.dump()
@@ -126,6 +134,7 @@ class TraceRecorder:
                 self._step = step_no
                 emit = True
         if emit:
+            get_flight_recorder().on_step(step_no)
             self._maybe_xprof()
             self.instant(f"step{step_no}", "FUSED_PUSHPULL", args)
             if self.enabled and self._step > self.end_step:
@@ -172,7 +181,10 @@ class TraceRecorder:
             "dur": dur_us,
             "pid": self.rank,
             "tid": stage,
-            "args": args or {},
+            # sanitize at the producer boundary: ONE rule for every call
+            # site (np.bool_/np-scalar args broke the JSON dump once —
+            # see metrics.json_safe)
+            "args": json_safe(args or {}),
         }
         with self._lock:
             self._events.append(ev)
@@ -182,6 +194,12 @@ class TraceRecorder:
         return _Span(self, name, stage, args)
 
     def instant(self, name: str, stage: str, args: Optional[Dict[str, Any]] = None) -> None:
+        if stage == "FAULT":
+            # every FAULT-track instant (retries, failovers, evictions,
+            # membership, injections) also lands in the ALWAYS-ON flight
+            # recorder — the chrome trace is the opt-in consumer, the
+            # post-mortem ring the unconditional one
+            get_flight_recorder().record_event(name, args)
         if not self.active:
             return
         ev = {
@@ -192,7 +210,7 @@ class TraceRecorder:
             "s": "t",
             "pid": self.rank,
             "tid": stage,
-            "args": args or {},
+            "args": json_safe(args or {}),
         }
         with self._lock:
             self._events.append(ev)
@@ -218,12 +236,12 @@ class TraceRecorder:
             doc = {
                 "traceEvents": self._events,
                 "displayTimeUnit": "ms",
-                "metadata": {
+                "metadata": json_safe({
                     "rank": self.rank,
                     "framework": "byteps_tpu",
                     "clock": "epoch_us",
                     **self.metadata,
-                },
+                }),
             }
         with open(path, "w") as f:
             json.dump(doc, f)

@@ -44,6 +44,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from byteps_tpu.common.config import Config, get_config
+from byteps_tpu.common.stage_orders import (
+    EAGER_STAGE_ORDER,
+    HYBRID_STAGE_ORDER,
+)
 from byteps_tpu.common.logging import bps_check, get_logger
 from byteps_tpu.common.partition import OwnerTable, TensorRegistry
 from byteps_tpu.common.scheduler import (
@@ -221,6 +225,13 @@ def init(
             # per-device segments; the ICI all-gather replicates them
             # (reference BROADCAST after COPYH2D)
             stages.append(Stage("ALLGATHER", _allgather_stage, pool_size=2))
+        # pinned against the canonical order trace_analysis sorts by
+        # (stage_orders.HYBRID_STAGE_ORDER): a stage added here without
+        # updating the shared constant is a bug, not a silent drift
+        bps_check(
+            tuple(s.name for s in stages)
+            == HYBRID_STAGE_ORDER[:len(stages)],
+            "hybrid stage list drifted from HYBRID_STAGE_ORDER")
         _state.scheduler = PipelineScheduler(
             stages=stages,
             credit=cfg.scheduling_credit,
@@ -232,11 +243,15 @@ def init(
         # (async dispatch; issue order = execution order on the device
         # stream), SYNC blocks until the chunk's result is ready and frees
         # the credit.
+        stages = [
+            Stage("PUSHPULL", _dispatch_stage, credited=True, pool_size=1),
+            Stage("SYNC", _sync_stage, pool_size=4),
+        ]
+        bps_check(
+            tuple(s.name for s in stages) == EAGER_STAGE_ORDER,
+            "eager stage list drifted from EAGER_STAGE_ORDER")
         _state.scheduler = PipelineScheduler(
-            stages=[
-                Stage("PUSHPULL", _dispatch_stage, credited=True, pool_size=1),
-                Stage("SYNC", _sync_stage, pool_size=4),
-            ],
+            stages=stages,
             credit=cfg.scheduling_credit,
             tracer=tracer,
         )

@@ -77,6 +77,14 @@ class AutoTunedStep:
         return sorted(self._compiled)
 
     def __call__(self, *args):
+        # always-on train-step tick (docs/observability.md): the plain
+        # jitted path gets this from _finalize_step's wrapper; the tuned
+        # path must stay an AutoTunedStep instance, so it ticks itself
+        # (relative — the recorder may already be ahead of this
+        # instance's private step count)
+        from byteps_tpu.common.flight_recorder import get_flight_recorder
+
+        get_flight_recorder().tick()
         step = self._compiled.get(self._pb)
         if step is None:
             step = self._build(self._pb)

@@ -348,6 +348,11 @@ def _finalize_step(build_jit, partition_bytes, dp, tunable=True):
         step = AutoTunedStep(build_jit, partition_bytes or cfg.partition_bytes)
     else:
         step = build_jit(partition_bytes)
+    # decided BEFORE any wrapper rebinds `step` to a plain function: the
+    # tuned step ticks the flight recorder inside its own __call__, and
+    # an isinstance check after the trace wrapper below would miss it —
+    # double-ticking every step (halving step_ms and the ring's reach)
+    ticks_itself = cfg.auto_tune and dp is not None and tunable
     if cfg.trace_on:
         from byteps_tpu.jax.optimizer import _host_callbacks_supported
 
@@ -364,6 +369,28 @@ def _finalize_step(build_jit, partition_bytes, dp, tunable=True):
                 out = inner(*a, **k)
                 get_tracer().host_step()
                 return out
+
+    # Always-on train-step telemetry (docs/observability.md): one
+    # flight-recorder tick per DISPATCHED step — a host-side function
+    # call, unlike the in-program debug-callback marker above, which
+    # costs a host sync and stays gated on BYTEPS_TRACE_ON.
+    # AutoTunedStep ticks inside its own __call__ (tests rely on the
+    # factory returning the instance, so it must not be wrapped into a
+    # plain function here) — `ticks_itself`, decided before the trace
+    # wrapper could rebind `step`, keeps this tick from stacking on it.
+    if not ticks_itself:
+        from byteps_tpu.common.flight_recorder import get_flight_recorder
+
+        traced = step
+
+        def step(*a, **k):  # noqa: F811 — deliberate rebind
+            out = traced(*a, **k)
+            # relative tick: the recorder may already be ahead (eager
+            # rounds, a previous model) — a private 1-based counter
+            # would be dropped there (FlightRecorder.tick docstring)
+            get_flight_recorder().tick()
+            return out
+
     return step
 
 

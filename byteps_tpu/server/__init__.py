@@ -20,6 +20,7 @@ decompress→sum→recompress engine (SURVEY §2.2/§3.3).
 
 from __future__ import annotations
 
+import itertools
 import random
 import threading
 import time
@@ -37,7 +38,9 @@ from byteps_tpu.common.faults import (
     WorkerKilledError,
     plan_from_env,
 )
+from byteps_tpu.common.flight_recorder import get_flight_recorder
 from byteps_tpu.common.logging import get_logger
+from byteps_tpu.common.metrics import get_registry
 from byteps_tpu.common.tracing import get_tracer
 from byteps_tpu.server.native import (
     WIRE_RAW,
@@ -58,6 +61,16 @@ __all__ = [
     "NoLiveServersError", "WireCorruption", "WorkerEvictedError",
     "WorkerKilledError", "wire_crc32",
 ]
+
+
+# Per-key rows the C++ summation server's own chrome trace emits
+# (declared in the light stage_orders module so trace_analysis can
+# learn the display order without importing the data plane).
+from byteps_tpu.common.stage_orders import SERVER_STAGE_ORDER  # noqa: F401,E402
+
+# Sequential id per PSWorker instance: each emulated NIC gets its own
+# per-NIC metric series (wire.nic<N>.*) beside the process aggregates.
+_NIC_SEQ = itertools.count()
 
 
 def wire_crc32(buf) -> int:
@@ -112,6 +125,7 @@ def retire_nic(worker, rank: int) -> None:
     way: it alone carries the pod's single kShutdown round, so it goes
     through ``PSWorker.shutdown``."""
     worker.export_counters(f"worker{worker._worker_id}.nic{rank}")
+    get_registry().counter("nic.retired").inc()
     worker.close()
 
 
@@ -346,6 +360,27 @@ class PSWorker:
             "membership_events": 0, "rejoins": 0,
         }
         self._counter_lock = threading.Lock()
+        # --- always-on metrics registry (docs/observability.md) ------------
+        # Every robustness count and wire byte ALSO lands in the
+        # process-wide registry: the per-instance views above die with
+        # the NIC (owner failover retires it), the registry totals do
+        # not — which is what keeps per-run accounting complete.
+        # Handles are resolved once here; _count mirrors lazily.
+        self._nic_tag = f"nic{next(_NIC_SEQ)}"
+        _reg = get_registry()
+        self._m_counts: Dict[str, Tuple] = {}
+        self._m_push_bytes = _reg.counter("wire.push_bytes")
+        self._m_pull_bytes = _reg.counter("wire.pull_bytes")
+        self._m_push_bytes_nic = _reg.counter(
+            f"wire.{self._nic_tag}.push_bytes")
+        self._m_pull_bytes_nic = _reg.counter(
+            f"wire.{self._nic_tag}.pull_bytes")
+        self._m_push_size = _reg.histogram("wire.push_size_bytes")
+        self._m_attempts = {
+            op: (_reg.counter(f"wire.{op}_attempts"),
+                 _reg.counter(f"wire.{self._nic_tag}.{op}_attempts"))
+            for op in ("push", "pull", "init")
+        }
         self._health: Optional[_HealthMonitor] = None
         hb_ms = (health_interval_ms if health_interval_ms is not None
                  else cfg.health_interval_ms)
@@ -359,6 +394,14 @@ class PSWorker:
     def _count(self, name: str, n: int = 1) -> None:
         with self._counter_lock:
             self.counters[name] = self.counters.get(name, 0) + n
+        m = self._m_counts.get(name)
+        if m is None:
+            _reg = get_registry()
+            m = (_reg.counter(f"psworker.{name}"),
+                 _reg.counter(f"psworker.{self._nic_tag}.{name}"))
+            self._m_counts[name] = m
+        m[0].inc(n)
+        m[1].inc(n)
 
     def _trace_fault(self, event: str, **args) -> None:
         get_tracer().instant(event, "FAULT",
@@ -713,6 +756,10 @@ class PSWorker:
                 raise FailedOverError(
                     f"{op} key {key}: placement moved {sidx0}->{sidx} "
                     f"(failover epoch {epoch}); round abandoned")
+            m_att = self._m_attempts.get(op)
+            if m_att is not None:
+                m_att[0].inc()
+                m_att[1].inc()
             try:
                 result = attempt_fn(sidx)
                 self._note_epoch(sidx)
@@ -925,6 +972,9 @@ class PSWorker:
         self._retry_loop("push", key, attempt)
         with self._vlock:
             self.bytes_pushed += int(b.nbytes)
+        self._m_push_bytes.inc(int(b.nbytes))
+        self._m_push_bytes_nic.inc(int(b.nbytes))
+        self._m_push_size.observe(int(b.nbytes))
         return version
 
     def pull_bytes(self, key: int, capacity: int, version: int,
@@ -988,6 +1038,8 @@ class PSWorker:
         out, got = self._retry_loop("pull", key, attempt)
         with self._vlock:
             self.bytes_pulled += got
+        self._m_pull_bytes.inc(got)
+        self._m_pull_bytes_nic.inc(got)
         return out[:got]
 
     def push(self, key: int, data: np.ndarray) -> int:
@@ -1123,6 +1175,14 @@ class PSWorker:
         if any(counters.values()):
             get_tracer().metadata.setdefault("robustness", {})[
                 tag or f"worker{self._worker_id}"] = counters
+            # the flight recorder keeps the final per-NIC snapshot too:
+            # after retire_nic closes this worker, the snapshot (incl.
+            # injected_* and health-probe state, which have no
+            # per-increment registry mirror) outlives the instance
+            get_flight_recorder().record_event(
+                "counters_export",
+                {"tag": tag or f"worker{self._worker_id}",
+                 "nic": self._nic_tag, "counters": counters})
 
 
 class _HealthMonitor:
@@ -1158,6 +1218,7 @@ class _HealthMonitor:
         self._total_misses: Dict[int, int] = {}
         self._last_probe: Dict[int, float] = {}
         self._dbg_lock = threading.Lock()
+        self._m_misses = get_registry().counter("health.misses")
         self._conns: Dict[int, NativeClient] = {}
         self._stop_ev = threading.Event()
         self._thread = threading.Thread(
@@ -1223,6 +1284,7 @@ class _HealthMonitor:
                     except WorkerKilledError:
                         return  # injected process death: no more probes
                     except Exception as e:  # noqa: BLE001 - miss
+                        self._m_misses.inc()
                         with self._dbg_lock:
                             self._last_probe[sidx] = time.monotonic()
                             n = self._misses.get(sidx, 0) + 1

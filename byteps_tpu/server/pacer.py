@@ -22,9 +22,17 @@ DCN tier moves, header bytes are noise.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import Optional
+
+from byteps_tpu.common.metrics import get_registry
+
+# sequential id per DcnPacer: one pacer per emulated NIC, and a shared
+# debt gauge would be last-writer-wins across NICs — NIC 0's idle
+# update must not mask NIC 2's 4 MB backlog
+_PACER_SEQ = itertools.count()
 
 
 class TokenBucket:
@@ -55,6 +63,13 @@ class TokenBucket:
         self._avail = self.burst
         self._last = time.monotonic()
         self._lock = threading.Lock()
+
+    def debt_bytes(self) -> float:
+        """Current token DEBT: how many booked bytes have not yet 'fit'
+        the rate (0 when the burst absorbs traffic). The always-on gauge
+        of how far behind the emulated NIC is running."""
+        with self._lock:
+            return max(0.0, -self._avail)
 
     def throttle(self, nbytes: int) -> float:
         """Charge ``nbytes`` and sleep until they fit the rate; returns
@@ -91,12 +106,26 @@ class DcnPacer:
         self._acct_lock = threading.Lock()
         self.send_sleep_s = 0.0
         self.recv_sleep_s = 0.0
+        # always-on registry mirror (docs/observability.md): sleep time
+        # is the price the emulated link charged (process-wide counters
+        # sum correctly across pacers); token debt is how far behind
+        # THIS NIC is running, so the gauges are per-pacer series —
+        # their max() is the high-water mark a stall report wants
+        _reg = get_registry()
+        tag = f"p{next(_PACER_SEQ)}"
+        self._m_send_sleep = _reg.counter("pacer.send_sleep_us")
+        self._m_recv_sleep = _reg.counter("pacer.recv_sleep_us")
+        self._m_send_debt = _reg.gauge(f"pacer.{tag}.send_debt_bytes")
+        self._m_recv_debt = _reg.gauge(f"pacer.{tag}.recv_debt_bytes")
 
     def throttle_send(self, nbytes: int) -> float:
         slept = self.send.throttle(nbytes)
         with self._acct_lock:
             self.sent_bytes += int(nbytes)
             self.send_sleep_s += slept
+        if slept > 0:
+            self._m_send_sleep.inc(int(slept * 1e6))
+        self._m_send_debt.set(self.send.debt_bytes())
         return slept
 
     def throttle_recv(self, nbytes: int) -> float:
@@ -104,6 +133,9 @@ class DcnPacer:
         with self._acct_lock:
             self.recv_bytes += int(nbytes)
             self.recv_sleep_s += slept
+        if slept > 0:
+            self._m_recv_sleep.inc(int(slept * 1e6))
+        self._m_recv_debt.set(self.recv.debt_bytes())
         return slept
 
 

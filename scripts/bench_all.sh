@@ -18,6 +18,21 @@ run() {
   timeout 1800 python bench.py "$@" 2>&2 | tail -1 >> "$OUT"
 }
 
+# Trend-relevant legs rewrite the BENCH_*.json artifacts the gate reads:
+# a leg that crashes or times out leaves the CHECKED-IN artifact behind,
+# and gating against it would pass a real regression (fail-open). Track
+# their exit codes and refuse to run the gate on stale artifacts.
+TREND_LEGS_RC=0
+run_trend_leg() {
+  echo "== bench $* ==" >&2
+  timeout 1800 python bench.py "$@" 2>&2 | tail -1 >> "$OUT"
+  local rc=${PIPESTATUS[0]}
+  if [ "$rc" -ne 0 ]; then
+    echo "trend-relevant leg '$*' failed (rc=$rc) — its artifact is stale" >&2
+    TREND_LEGS_RC=1
+  fi
+}
+
 run                                      # flagship GPT (or all-reduce if >1 dev)
 run --model resnet50                     # BASELINE config 2
 run --model bert --compressor onebit     # BASELINE config 3
@@ -30,10 +45,29 @@ run --ce dense                           # flagship w/o fused CE (A/B attributio
 run --mode generate                      # KV-cache decode vs full recompute
 run --mode dcn                           # DCN summation tier
 run --mode dcn-profile                   # host component ceilings
-run --mode throttled                     # compression race on emulated slow DCN
+run_trend_leg --mode throttled           # compression race on emulated slow DCN (+BENCH_throttled.json)
 run --mode tune                          # joint (partition, credit) auto-tune
-run --mode chaos                         # goodput vs fault rate (+BENCH_chaos.json)
-run --mode hybrid                        # sharded-wire hierarchical race (+BENCH_hybrid.json)
+run_trend_leg --mode chaos               # goodput vs fault rate (+BENCH_chaos.json)
+run_trend_leg --mode hybrid              # sharded-wire hierarchical race (+BENCH_hybrid.json)
+
+# Perf-trend regression gate LAST: the legs above rewrote
+# BENCH_{throttled,chaos,hybrid}.json in place; compare the fresh
+# headline metrics against the checked-in spread-aware floors
+# (BENCH_trend.json) and FAIL the whole run on a regression. After an
+# intentional trajectory change: python bench.py --mode trend --refresh
+echo "== bench --mode trend ==" >&2
+if [ "$TREND_LEGS_RC" -ne 0 ]; then
+  echo "SKIPPING trend gate: a trend-relevant bench leg failed, its" \
+       "artifact is stale — gating against it would fail OPEN" >&2
+  trend_rc=1
+else
+  timeout 600 python bench.py --mode trend 2>&2 | tail -1 >> "$OUT"
+  trend_rc=${PIPESTATUS[0]}
+fi
 
 echo "collected $(wc -l < "$OUT") results in $OUT" >&2
 cat "$OUT"
+if [ "$trend_rc" -ne 0 ]; then
+  echo "PERF TREND REGRESSION (bench.py --mode trend exit $trend_rc)" >&2
+  exit "$trend_rc"
+fi

@@ -47,12 +47,26 @@ def pytest_collection_modifyitems(config, items):
 
 @pytest.fixture(autouse=True)
 def _fresh_config(monkeypatch):
-    """Each test sees a fresh Config parsed from (possibly monkeypatched) env."""
+    """Each test sees a fresh Config parsed from (possibly monkeypatched)
+    env — and a fresh metrics registry / flight recorder, so telemetry
+    assertions never see a sibling test's counts."""
     from byteps_tpu.common import config as config_mod
+    from byteps_tpu.common.flight_recorder import reset_flight_recorder
+    from byteps_tpu.common.metrics import reset_registry
+    from byteps_tpu.common.tracing import reset_tracer
 
-    config_mod.reset_config()
+    def _reset():
+        config_mod.reset_config()
+        reset_registry()
+        reset_flight_recorder()
+        # the tracer's step counter otherwise leaks across tests, and
+        # step-driven telemetry (flight-recorder ring) would see a
+        # sibling test's step numbers
+        reset_tracer()
+
+    _reset()
     yield
-    config_mod.reset_config()
+    _reset()
 
 
 @pytest.fixture(scope="session")

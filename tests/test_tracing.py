@@ -88,3 +88,59 @@ def test_xprof_failure_degrades_to_chrome_only(tmp_path, monkeypatch):
     rec.step()
     assert json.load(open(
         os.path.join(str(tmp_path), "trace_rank0.json")))["traceEvents"]
+
+
+def test_trace_args_json_safe_over_numpy_scalar_types(tmp_path):
+    """Property test (telemetry-plane satellite): ANY event arg built
+    from a numpy scalar type must survive the chrome-trace JSON dump —
+    the np.bool_ that broke the dump once (PR 5 fixed one call site) is
+    now scrubbed centrally in the recorder, for every call site."""
+    import numpy as np
+
+    scalars = [
+        np.bool_(True), np.int8(-3), np.int16(9), np.int32(-5),
+        np.int64(7), np.uint8(2), np.uint16(4), np.uint32(6),
+        np.uint64(8), np.float16(1.5), np.float32(2.5), np.float64(3.5),
+        np.complex64(1 + 2j), np.complex128(3 - 4j),
+        np.bytes_(b"x"), np.str_("s"),
+        np.array(True), np.array(11), np.arange(3),
+        np.zeros((100,)), np.float64("nan"), np.float64("inf"),
+    ]
+    rec = TraceRecorder(enabled=True, trace_dir=str(tmp_path),
+                        start_step=1, end_step=999, rank=0)
+    rec.step()
+    for i, s in enumerate(scalars):
+        rec.instant(f"e{i}", "FAULT", {"v": s, "nested": {"list": [s]}})
+        rec.complete_event(f"x{i}", "PUSH", 0.0, 1.0, {"v": s})
+    rec.metadata["robustness"] = {"w0": {"flag": np.bool_(False),
+                                         "n": np.int64(12)}}
+    path = rec.dump()
+    doc = json.load(open(path))  # strict JSON round-trip, no np leakage
+    by_name = {e["name"]: e for e in doc["traceEvents"]
+               if e["name"].startswith("e")}
+    assert by_name["e0"]["args"]["v"] is True
+    assert by_name["e4"]["args"]["v"] == 7
+    assert by_name["e10"]["args"]["v"] == 2.5
+    assert by_name["e18"]["args"]["v"] == [0, 1, 2]
+    assert "ndarray" in by_name["e19"]["args"]["v"]  # big array: descriptor
+    assert doc["metadata"]["robustness"]["w0"] == {"flag": False, "n": 12}
+    # the FAULT instants also landed in the always-on flight recorder,
+    # sanitized the same way
+    from byteps_tpu.common.flight_recorder import get_flight_recorder
+
+    evs = get_flight_recorder().events()
+    assert any(e["event"] == "e0" and e["args"]["v"] is True for e in evs)
+
+
+def test_fault_instants_feed_flight_recorder_even_when_trace_off():
+    """The chrome trace is opt-in; the flight recorder is not. A FAULT
+    instant recorded with tracing DISABLED must still reach the ring."""
+    from byteps_tpu.common.flight_recorder import get_flight_recorder
+
+    rec = TraceRecorder(enabled=False)
+    rec.instant("failover", "FAULT", {"server": 1})
+    rec.instant("not_a_fault", "PUSH", {})
+    assert rec._events == []  # nothing traced
+    evs = get_flight_recorder().events()
+    assert [e["event"] for e in evs] == ["failover"]
+    assert evs[0]["args"] == {"server": 1}

@@ -1,0 +1,89 @@
+"""Perf-trend regression gate (bench.py --mode trend; the checked-in
+trajectory lives in BENCH_trend.json). Tier-1: the gate passes on the
+repo's own artifacts, a synthetically degraded artifact fails it, and
+the one-command refresh produces floors the gate accepts."""
+
+import json
+import os
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+import bench  # noqa: E402
+
+
+def _trend():
+    with open(os.path.join(ROOT, bench.TREND_FILE)) as f:
+        return json.load(f)
+
+
+def test_trend_gate_passes_on_checked_in_trajectory():
+    res = bench.trend_check(_trend(), bench_dir=ROOT)
+    assert res["pass"], res
+    assert res["value"] >= 1.0
+    # every tracked headline metric was actually compared
+    assert len(res["checks"]) == len(_trend()["metrics"])
+    assert all(c["pass"] and "fresh" in c for c in res["checks"])
+
+
+def test_trend_gate_fails_on_degraded_artifact(tmp_path):
+    """A regression in ONE headline metric (hybrid goodput cut to 0.3x)
+    must fail the gate while the untouched artifacts still pass."""
+    trend = _trend()
+    for row in trend["metrics"]:
+        src = os.path.join(ROOT, row["file"])
+        dst = tmp_path / row["file"]
+        if not dst.exists():
+            dst.write_text(open(src).read())
+    doc = json.loads((tmp_path / "BENCH_hybrid.json").read_text())
+    doc["value"] = round(doc["value"] * 0.3, 3)
+    (tmp_path / "BENCH_hybrid.json").write_text(json.dumps(doc))
+
+    res = bench.trend_check(trend, bench_dir=str(tmp_path))
+    assert not res["pass"], res
+    failed = [c for c in res["checks"] if not c["pass"]]
+    assert [c["file"] for c in failed] == ["BENCH_hybrid.json"]
+    assert failed[0]["fresh"] < failed[0]["floor"]
+
+
+def test_trend_gate_fails_on_missing_artifact(tmp_path):
+    """A bench leg that never produced its artifact is a FAILURE, not a
+    silent skip — the gate's job is to prove the trajectory, and a
+    missing file proves nothing."""
+    res = bench.trend_check(_trend(), bench_dir=str(tmp_path))
+    assert not res["pass"]
+    assert all("error" in c for c in res["checks"])
+
+
+def test_trend_refresh_round_trip():
+    """The one-command refresh path: floors rebuilt from the current
+    artifacts sit strictly below their values (spread-aware slack,
+    clamped to [10%, 50%]) and the gate accepts them immediately."""
+    doc = bench.trend_refresh(bench_dir=ROOT)
+    assert len(doc["metrics"]) == len(bench._TREND_SPECS)
+    for row in doc["metrics"]:
+        assert 0 < row["floor"] < row["value"]
+        assert 0.5 <= row["floor"] / row["value"] <= 0.9
+    assert bench.trend_check(doc, bench_dir=ROOT)["pass"]
+    # the refresh command is documented inside the artifact itself
+    assert "refresh" in doc and "--refresh" in doc["refresh"]
+
+
+def test_trend_checked_in_floors_match_refresh():
+    """BENCH_trend.json must stay in sync with the artifacts it floors:
+    if a bench PR rewrites BENCH_*.json it must re-run the refresh (one
+    command, see docs/observability.md#trend-gate)."""
+    fresh = bench.trend_refresh(bench_dir=ROOT)["metrics"]
+    checked_in = _trend()["metrics"]
+    assert fresh == checked_in, (
+        "BENCH_trend.json is stale — run: python bench.py --mode trend "
+        "--refresh")
+
+
+def test_json_path_walker():
+    doc = {"a": {"200": {"b": [10, 20]}}}
+    assert bench._json_path(doc, "a.200.b.1") == 20
+    with pytest.raises(KeyError):
+        bench._json_path(doc, "a.nope")
