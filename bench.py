@@ -943,6 +943,169 @@ def bench_generate() -> dict:
     }
 
 
+def bench_serve(reps: int = 3, n_requests: int = 24,
+                quick: bool = False) -> dict:
+    """Continuous-batching serve tier (byteps_tpu/serve,
+    docs/serving.md) vs the sequential single-stream baseline — the
+    "millions of users, heavy traffic" scenario made measurable.
+
+    Legs:
+
+    * **sequential** — each request alone through ``make_generate_fn``,
+      back to back: the pre-serve way to drain a queue (one fused XLA
+      program per request, zero batching).
+    * **saturation** — the same trace submitted all at once through one
+      :class:`Scheduler`: mixed prompt/output lengths pack one paged
+      decode batch; the headline ``value`` is the tokens/s ratio vs
+      sequential (>= 2x acceptance bar — the batched GEMM reads the
+      weights once where the sequential GEMV re-reads them per
+      request).
+    * **offered-load sweep** — arrivals paced at fractions of the
+      measured saturation request rate: p50/p99 TTFT and per-token
+      latency show where the latency knee sits below saturation.
+
+    Outputs are bit-identical to the sequential leg's tokens by the
+    serve tier's exactness contract (pinned in tests/test_serve.py);
+    this bench measures ONLY speed. Single-process, one chip:
+    tokens/s == tokens/s/chip. Artifact: BENCH_serve.json (+ the
+    ``--mode trend`` gate floors the headline)."""
+    on_cpu = jax.devices()[0].platform == "cpu"
+    from byteps_tpu.models import GPTConfig, gpt_init
+    from byteps_tpu.models.generate import make_generate_fn
+    from byteps_tpu.serve import Request, Scheduler
+
+    if quick:
+        cfg = GPTConfig.tiny()
+        prompt_lens, max_news = (4, 8, 12), (5, 8)
+        max_batch, prefill_chunk = 4, 8
+        rates = ()
+    elif on_cpu:
+        # mid config at a REAL vocab: the 64 MB readout weight is the
+        # dominant per-token stream, which is exactly what continuous
+        # batching amortizes (the sequential GEMV re-reads it per
+        # request-token; the packed GEMM reads it once per step)
+        cfg = GPTConfig(vocab_size=32768, max_seq=256, d_model=512,
+                        n_heads=8, n_layers=6, d_ff=2048)
+        prompt_lens, max_news = (8, 24, 48), (16, 32)
+        max_batch, prefill_chunk = 12, 32
+        rates = (0.5, 0.8)
+    else:
+        cfg = GPTConfig(vocab_size=32768, max_seq=512, d_model=512,
+                        n_heads=8, n_layers=8, d_ff=2048,
+                        dtype=jnp.bfloat16)
+        prompt_lens, max_news = (16, 64, 128), (32, 64)
+        max_batch, prefill_chunk = 16, 64
+        rates = (0.5, 0.8)
+
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(42)
+    trace = []
+    for i in range(n_requests):
+        T0 = prompt_lens[i % len(prompt_lens)]
+        mn = max_news[i % len(max_news)]
+        trace.append((rng.integers(0, cfg.vocab_size, T0).astype(np.int32),
+                      mn))
+    total_new = sum(mn for _, mn in trace)
+
+    gens = {mn: make_generate_fn(cfg, mn)
+            for mn in sorted({mn for _, mn in trace})}
+    key = jax.random.PRNGKey(1)
+
+    def run_sequential():
+        out = None
+        for prompt, mn in trace:
+            out = gens[mn](params, jnp.asarray(prompt)[None], key, 0.0)
+        return _fence(out)
+
+    def run_serve(rate_rps=None):
+        """One full trace through a FRESH scheduler (fresh pool +
+        tables per rep; the warmup pass below eats the one-time jit
+        compiles for both sides)."""
+        sched = Scheduler(params, cfg, max_batch=max_batch,
+                          prefill_chunk=prefill_chunk)
+        t0 = time.monotonic()
+        reqs = []
+        for i, (prompt, mn) in enumerate(trace):
+            arr = 0.0 if rate_rps is None else t0 + i / rate_rps
+            reqs.append(Request(rid=i, prompt=prompt, max_new=mn,
+                                arrival_s=arr))
+        res = sched.serve(reqs)
+        makespan = time.monotonic() - t0
+        assert sched.cache.leaked_blocks() == 0, "KV block leak"
+        return makespan, res
+
+    def leg_stats(runs):
+        """Aggregate a leg's reps: makespan med/spread + latency
+        percentiles over every (rep, request, token)."""
+        mks = sorted(m for m, _ in runs)
+        med = float(np.median(mks))
+        ttfts, gaps = [], []
+        for _, res in runs:
+            for r in res.values():
+                ttfts.append(r["ttft_s"] * 1e3)
+                ts = r["token_s"]
+                if len(ts) > 1:
+                    gaps.extend(np.diff(ts) * 1e3)
+        return {
+            "sec_med": round(med, 4),
+            "sec_spread": [round(mks[0], 4), round(mks[-1], 4)],
+            "tokens_per_s": round(total_new / med, 1),
+            "ttft_ms_p50": round(float(np.percentile(ttfts, 50)), 2),
+            "ttft_ms_p99": round(float(np.percentile(ttfts, 99)), 2),
+            "token_ms_p50": round(float(np.percentile(gaps, 50)), 3),
+            "token_ms_p99": round(float(np.percentile(gaps, 99)), 3),
+        }
+
+    # warmup: compiles every shape both sides touch
+    run_sequential()
+    run_serve()
+
+    seq_times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        run_sequential()
+        seq_times.append(time.perf_counter() - t0)
+    seq_times.sort()
+    seq_med = float(np.median(seq_times))
+    sequential = {
+        "sec_med": round(seq_med, 4),
+        "sec_spread": [round(seq_times[0], 4), round(seq_times[-1], 4)],
+        "tokens_per_s": round(total_new / seq_med, 1),
+    }
+
+    sat_runs = [run_serve() for _ in range(reps)]
+    sat = leg_stats(sat_runs)
+    speedup = sat["tokens_per_s"] / sequential["tokens_per_s"]
+
+    results = {"saturation": sat}
+    sat_rps = n_requests / sat["sec_med"]
+    for frac in rates:
+        runs = [run_serve(rate_rps=sat_rps * frac)
+                for _ in range(max(1, reps - 1))]
+        results[f"offered_{frac}"] = leg_stats(runs)
+
+    _log(f"serve: {n_requests} requests ({total_new} new tokens) — "
+         f"sequential {sequential['tokens_per_s']} tok/s, saturation "
+         f"{sat['tokens_per_s']} tok/s ({speedup:.2f}x), TTFT p50/p99 "
+         f"{sat['ttft_ms_p50']}/{sat['ttft_ms_p99']} ms, token p50/p99 "
+         f"{sat['token_ms_p50']}/{sat['token_ms_p99']} ms")
+    return {
+        "metric": (f"continuous-batching serve, {n_requests} mixed-length "
+                   f"requests (GPT d{cfg.d_model}/L{cfg.n_layers}, prompts "
+                   f"{list(prompt_lens)}, max_new {list(max_news)}, batch "
+                   f"{max_batch}) vs sequential single-stream "
+                   "make_generate_fn"),
+        "value": round(speedup, 3),
+        "unit": "x serve vs sequential tokens/s",
+        "vs_baseline": round(speedup, 3),
+        "tokens_per_s_per_chip": sat["tokens_per_s"],
+        "sequential": sequential,
+        "results": results,
+        "device_kind": jax.devices()[0].device_kind,
+        "telemetry": _telemetry_counters(),
+    }
+
+
 def bench_allreduce_multichip() -> dict:
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -1945,6 +2108,7 @@ _TREND_SPECS = (
     ("BENCH_throttled.json", "results.200.topk.speedup_vs_raw"),
     ("BENCH_hybrid.json", "value"),
     ("BENCH_chaos.json", "value"),
+    ("BENCH_serve.json", "value"),
 )
 
 
@@ -2089,7 +2253,7 @@ def main() -> None:
     ap.add_argument("--mode",
                     choices=["auto", "dcn", "dcn-profile", "throttled",
                              "tune", "chaos", "hybrid", "generate",
-                             "profile", "trend"],
+                             "serve", "profile", "trend"],
                     default="auto")
     ap.add_argument("--refresh", action="store_true",
                     help="trend mode: rebuild BENCH_trend.json's "
@@ -2195,6 +2359,22 @@ def main() -> None:
             float(os.environ.get("BYTEPS_BENCH_DEVICE_TIMEOUT", "600")))
         _log(f"bench: {n} device(s): {jax.devices()[0].device_kind}")
         result = bench_generate()
+        # artifact like throttled/chaos/hybrid — the checked-in
+        # single-stream baseline the serve speedup is read against
+        with open("BENCH_generate.json", "w") as f:
+            json.dump(result, f, indent=1)
+        _log("bench: wrote BENCH_generate.json")
+    elif args.mode == "serve":
+        if flags_set:
+            _log("bench: WARNING --model/--compressor ignored in "
+                 "serve mode")
+        n = _devices_or_die(
+            float(os.environ.get("BYTEPS_BENCH_DEVICE_TIMEOUT", "600")))
+        _log(f"bench: {n} device(s): {jax.devices()[0].device_kind}")
+        result = bench_serve()
+        with open("BENCH_serve.json", "w") as f:
+            json.dump(result, f, indent=1)
+        _log("bench: wrote BENCH_serve.json")
     else:
         n = _devices_or_die(
             float(os.environ.get("BYTEPS_BENCH_DEVICE_TIMEOUT", "600")))

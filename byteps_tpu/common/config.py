@@ -194,6 +194,37 @@ class Config:
     # post-mortem only rides the raised error object.
     flight_recorder_dir: str = ""
 
+    # --- inference serving tier (docs/serving.md) --------------------------
+    # KV block size (tokens per paged-cache block). Must divide the
+    # model's max_seq for bit-tight packing vs the dense cache (the
+    # scheduler validates); 16 suits both the tiny CI configs and the
+    # flash kernels' tiling.
+    serve_block_size: int = 16
+    # Physical KV blocks in the preallocated pool. 0 = auto: enough for
+    # max_batch full-length requests plus the reserved scratch block
+    # (no oversubscription). Smaller pools oversubscribe and trigger
+    # preemption with recompute-on-resume.
+    serve_pool_blocks: int = 0
+    # Decode-batch slots: how many requests one packed decode step
+    # serves (the jitted step's static batch dimension).
+    serve_max_batch: int = 8
+    # Prefill chunk length in tokens: long prompts are fed through the
+    # model this many tokens per scheduler iteration so a 2k-token
+    # prompt can't starve the decode lane (Orca-style iteration-level
+    # scheduling).
+    serve_prefill_chunk: int = 32
+    # int8-quantized KV pool (reuses generate.py's _QuantSlot absmax
+    # machinery) — ~half the pool HBM of bf16, the knob that doubles
+    # the servable batch/context per chip.
+    serve_quant_cache: bool = False
+    # Default spec_len for per-request speculative policies.
+    serve_spec_len: int = 4
+    # Replica lease for the serve router (serve/router.py): a replica
+    # silent past this many ms (no completed scheduler step) is evicted
+    # — epoch bump, its in-flight requests re-queue to survivors.
+    # Mirrors the PR 5 server-side worker-lease semantics.
+    serve_replica_lease_ms: int = 1000
+
     # --- tracing (SURVEY §5.1) ---------------------------------------------
     trace_on: bool = False
     trace_dir: str = "./traces"
@@ -260,6 +291,14 @@ class Config:
             flight_recorder_events=_env_int("BYTEPS_FLIGHT_RECORDER_EVENTS",
                                             128),
             flight_recorder_dir=_env_str("BYTEPS_FLIGHT_RECORDER_DIR", ""),
+            serve_block_size=_env_int("BYTEPS_SERVE_BLOCK_SIZE", 16),
+            serve_pool_blocks=_env_int("BYTEPS_SERVE_POOL_BLOCKS", 0),
+            serve_max_batch=_env_int("BYTEPS_SERVE_MAX_BATCH", 8),
+            serve_prefill_chunk=_env_int("BYTEPS_SERVE_PREFILL_CHUNK", 32),
+            serve_quant_cache=_env_bool("BYTEPS_SERVE_QUANT_CACHE"),
+            serve_spec_len=_env_int("BYTEPS_SERVE_SPEC_LEN", 4),
+            serve_replica_lease_ms=_env_int(
+                "BYTEPS_SERVE_REPLICA_LEASE_MS", 1000),
             trace_on=_env_bool("BYTEPS_TRACE_ON"),
             trace_dir=_env_str("BYTEPS_TRACE_DIR", "./traces"),
             trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 1),

@@ -234,8 +234,9 @@ def _block_step(x, p, cache_k, cache_v, pos0, cfg, tp_axis, ep_axis,
 
 def gpt_apply_cached(params, tokens: jnp.ndarray, cache: KVCache,
                      cfg: GPTConfig, tp_axis: Optional[str] = None,
-                     ep_axis: Optional[str] = None
-                     ) -> Tuple[jnp.ndarray, KVCache]:
+                     ep_axis: Optional[str] = None,
+                     readout: bool = True
+                     ) -> Tuple[Optional[jnp.ndarray], KVCache]:
     """Run T new tokens through the model, appending to the cache.
 
     tokens: (B, T) continuing at position ``cache.length``. Returns
@@ -244,6 +245,11 @@ def gpt_apply_cached(params, tokens: jnp.ndarray, cache: KVCache,
     ``gpt_forward`` numerics either way. Serves both the dense and the
     MoE GPT families (block type detected from the params; ``ep_axis``
     shards the experts inside shard_map).
+
+    ``readout=False`` skips the vocab projection and returns
+    ``(None, cache)`` — the serve tier's intermediate prefill chunks
+    only need the cache side, and at real vocab sizes the readout is
+    the single largest weight stream in the step.
     """
     resolve_rope(cfg)   # validate the position scheme decode-side too
     norm_fn, norm_eps = resolve_norm(cfg)
@@ -273,7 +279,7 @@ def gpt_apply_cached(params, tokens: jnp.ndarray, cache: KVCache,
         else:
             new_k.append(ck)
             new_v.append(cv)
-    logits = _readout(params, x, norm_fn, norm_eps)
+    logits = _readout(params, x, norm_fn, norm_eps) if readout else None
     return logits, KVCache(
         k=jnp.stack(new_k), v=jnp.stack(new_v), length=pos0 + T,
         k_scale=jnp.stack(new_ks) if quant else None,

@@ -174,15 +174,23 @@ def _positions(S_loc: int, sp_axis, seq_layout: str) -> jnp.ndarray:
 def rope_rotate(x: jnp.ndarray, pos: jnp.ndarray,
                 base: float = 10000.0) -> jnp.ndarray:
     """Rotary position embedding (half-split convention), (B, S, H, D)
-    with per-row global positions ``pos (S,)``. Pure elementwise rotation
-    — composes with the flash kernel, ring/zigzag schedules (positions
-    are layout-aware), and the KV cache (keys cached post-rotation)."""
+    with global positions ``pos`` — either ``(S,)`` shared across the
+    batch (training / single-request decode) or ``(B, S)`` per-row (the
+    serve tier's packed decode, where one batch holds requests at
+    heterogeneous positions). Pure elementwise rotation — composes with
+    the flash kernel, ring/zigzag schedules (positions are
+    layout-aware), and the KV cache (keys cached post-rotation)."""
     D = x.shape[-1]
     half = D // 2
     inv_freq = 1.0 / (base ** (jnp.arange(half, dtype=jnp.float32) / half))
-    ang = pos.astype(jnp.float32)[:, None] * inv_freq[None, :]   # (S, half)
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    if jnp.ndim(pos) == 2:
+        ang = pos.astype(jnp.float32)[..., None] * inv_freq  # (B, S, half)
+        cos = jnp.cos(ang)[:, :, None, :]
+        sin = jnp.sin(ang)[:, :, None, :]
+    else:
+        ang = pos.astype(jnp.float32)[:, None] * inv_freq[None, :]
+        cos = jnp.cos(ang)[None, :, None, :]
+        sin = jnp.sin(ang)[None, :, None, :]
     xf = x.astype(jnp.float32)
     x1, x2 = xf[..., :half], xf[..., half:]
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],

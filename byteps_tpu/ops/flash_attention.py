@@ -640,7 +640,13 @@ def attention_lse_jnp(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     shape. The golden for the kernel and the fallback for ring schedules
     off-TPU. Grouped-query attention is native: when q carries G× the
     k/v head count, each kv head serves its group through the einsum —
-    no materialized head repeat (the GQA decode hot path)."""
+    no materialized head repeat (the GQA decode hot path).
+
+    ``q_offset`` may be a per-batch ``(B,)`` vector: row ``b``'s queries
+    sit at global positions ``q_offset[b] + arange(Sq)``. That is the
+    serve tier's packed-decode contract — one device batch holds
+    requests at heterogeneous sequence positions (serve/paged_cache.py),
+    and each row masks against its own fill level."""
     B, Sq, H, D = q.shape
     Sk, Hkv = k.shape[1], k.shape[2]
     scale = 1.0 / (D ** 0.5)
@@ -657,9 +663,16 @@ def attention_lse_jnp(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                        k.astype(jnp.float32)) * scale
     if causal:
-        rows = q_offset + jnp.arange(Sq)[:, None]
-        cols = k_offset + jnp.arange(Sk)[None, :]
-        s = jnp.where((rows >= cols)[None, None], s, _NEG)
+        if jnp.ndim(q_offset) == 1:
+            # per-batch offsets: (B, Sq, Sk) mask broadcast over heads
+            rows = (jnp.asarray(q_offset)[:, None, None]
+                    + jnp.arange(Sq)[None, :, None])
+            cols = k_offset + jnp.arange(Sk)[None, None, :]
+            s = jnp.where((rows >= cols)[:, None], s, _NEG)
+        else:
+            rows = q_offset + jnp.arange(Sq)[:, None]
+            cols = k_offset + jnp.arange(Sk)[None, :]
+            s = jnp.where((rows >= cols)[None, None], s, _NEG)
     m = s.max(axis=-1)                                   # (B, H, Sq)
     live = m > _NEG / 2
     m_safe = jnp.where(live, m, 0.0)
@@ -687,8 +700,12 @@ def attention_lse(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     building block ring schedules merge with :func:`merge_attention`.
     Grouped-query attention (q heads a multiple of k/v heads) is native
     on both backends — the kernel associates each query head with its kv
-    head by grid-index arithmetic, so the narrow k/v is read directly."""
-    if use_pallas() and supported(q.shape[1], k.shape[1], q.shape[-1]):
+    head by grid-index arithmetic, so the narrow k/v is read directly.
+    A per-batch ``(B,)`` ``q_offset`` vector (the serve tier's packed
+    decode) always takes the jnp twin — the kernel's grid masking is
+    scalar-offset only."""
+    if (jnp.ndim(q_offset) == 0 and use_pallas()
+            and supported(q.shape[1], k.shape[1], q.shape[-1])):
         return flash_attention_lse(q, k, v, q_offset, k_offset,
                                    causal=causal)
     return attention_lse_jnp(q, k, v, q_offset, k_offset, causal=causal)

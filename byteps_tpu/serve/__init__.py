@@ -1,0 +1,43 @@
+"""byteps_tpu.serve — the continuous-batching inference tier.
+
+The training side of this repo already had every serving-shaped piece
+(`models/generate.py` KV cache + cached apply, `models/speculative.py`,
+flash decode) but served exactly one request at a time with a
+fixed-shape cache. This subsystem is the vLLM/Orca-shaped completion:
+
+* ``paged_cache`` — a block-paged KV pool: fixed-size KV blocks
+  preallocated once, per-request block tables, so sequences of wildly
+  different lengths pack one device batch (PagedAttention's memory
+  model).
+* ``scheduler`` — iteration-level request scheduling: continuous
+  admission from a queue, chunked prefill so long prompts can't starve
+  decoders, preemption under block-pool pressure with
+  recompute-on-resume, and speculative decoding as a per-request
+  policy (Orca's per-step admission instead of run-to-completion
+  batches).
+* ``router`` — multi-replica routing with lease/epoch replica
+  liveness mirroring the PR 5 elastic-membership layer: a dead
+  replica's in-flight requests re-queue to survivors.
+
+Greedy outputs are pinned BIT-identical (token-for-token) to
+single-request ``make_generate_fn`` runs — batching and paging are
+pure throughput levers, never content changes (tests/test_serve.py).
+Measured by ``bench.py --mode serve`` (docs/serving.md).
+"""
+
+from byteps_tpu.common.jax_compat import ensure as _ensure_jax_compat
+
+_ensure_jax_compat()
+
+from byteps_tpu.serve.paged_cache import (  # noqa: E402,F401
+    PagedKVCache,
+    PoolState,
+    make_paged_decode_fn,
+    make_paged_prefill_fn,
+)
+from byteps_tpu.serve.router import Router  # noqa: E402,F401
+from byteps_tpu.serve.scheduler import (  # noqa: E402,F401
+    Request,
+    Scheduler,
+    SpecPolicy,
+)

@@ -1,0 +1,190 @@
+"""Multi-replica request routing with lease/epoch replica liveness.
+
+The PR 5 elastic-membership layer taught this repo one lesson worth
+repeating at the serving tier: **death is detected by silence, never by
+exception identity**. The summation servers there lease every worker —
+one silent past the lease is evicted, the membership epoch bumps, and
+open work re-targets the live set. :class:`Router` mirrors exactly
+those semantics over serve replicas:
+
+* every completed ``Scheduler.step()`` is the replica's lease renewal
+  (the serve analog of the push/pull/kPing heartbeat);
+* a replica silent past ``serve_replica_lease_ms`` — crashed, wedged,
+  or deterministically killed by a ``worker:kill`` fault rule — is
+  EVICTED: the routing epoch bumps (stamped on every completed
+  result), and its in-flight requests re-queue to the survivors;
+* re-queued requests keep their committed tokens and recompute their
+  KV on the survivor (the scheduler's recompute-on-resume path), so a
+  greedy request's final output is bit-identical to an undisturbed run
+  — failover moves work, never content (pinned in tests/test_serve.py
+  under the deterministic ``worker:kill`` fault scope).
+
+Dispatch is least-loaded over the live set. The router is
+single-threaded by design (one ``run()`` loop steps every replica
+round-robin): replica parallelism in a real deployment is process- or
+host-level, and this in-process form is what the bench and the chaos
+pins drive deterministically.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from byteps_tpu.common.config import get_config
+from byteps_tpu.common.faults import WorkerKilledError
+from byteps_tpu.common.flight_recorder import get_flight_recorder
+from byteps_tpu.common.logging import get_logger
+from byteps_tpu.common.metrics import get_registry
+from byteps_tpu.serve.scheduler import Request, Scheduler
+
+log = get_logger("serve.router")
+
+
+class NoLiveReplicasError(RuntimeError):
+    """Every replica is dead or evicted — nothing can serve."""
+
+
+class Router:
+    """Lease/epoch routing over a set of :class:`Scheduler` replicas."""
+
+    def __init__(self, replicas: List[Scheduler],
+                 lease_ms: Optional[int] = None,
+                 clock=time.monotonic):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        self.replicas = list(replicas)
+        self.lease_ms = lease_ms if lease_ms is not None \
+            else get_config().serve_replica_lease_ms
+        self._clock = clock
+        now = clock()
+        self._beat: Dict[int, float] = {i: now
+                                        for i in range(len(replicas))}
+        self._live = set(range(len(replicas)))
+        self.epoch = 0
+        self.results: Dict[Any, Dict[str, Any]] = {}
+        _reg = get_registry()
+        self._m_dispatch = _reg.counter("serve.router.dispatched")
+        self._m_evict = _reg.counter("serve.router.evictions")
+        self._m_requeued = _reg.counter("serve.router.requeued")
+        self._g_epoch = _reg.gauge("serve.router.epoch")
+        self._g_live = _reg.gauge("serve.router.live_replicas")
+        self._g_live.set(len(self._live))
+
+    # -- dispatch -----------------------------------------------------------
+    def live_replicas(self) -> List[int]:
+        return sorted(self._live)
+
+    def submit(self, req: Request,
+               resume_tokens: Optional[List[int]] = None) -> int:
+        """Route to the least-loaded live replica; returns its index."""
+        if not self._live:
+            raise NoLiveReplicasError("no live replica to route to")
+        target = min(self._live, key=lambda i: (self.replicas[i].load, i))
+        self.replicas[target].submit(req, resume_tokens=resume_tokens)
+        self._m_dispatch.inc()
+        return target
+
+    # -- liveness -----------------------------------------------------------
+    def step(self) -> bool:
+        """Step every live replica once (its completed step renews the
+        lease), then sweep expired leases. Returns True when any
+        replica made progress."""
+        progress = False
+        completed = []
+        for i in sorted(self._live):
+            sched = self.replicas[i]
+            try:
+                if sched.step():
+                    progress = True
+                completed.append(i)
+            except WorkerKilledError:
+                # a dead replica renews nothing — eviction happens by
+                # silence in sweep(), exactly like a real crash (the
+                # PR 5 lease philosophy: no exception-identity paths)
+                pass
+        # renew every completed step at the SAME post-round timestamp:
+        # this harness steps replicas serially, so a sibling's slow step
+        # (first-call jit compile) must not age a healthy replica's
+        # lease — a replica that completed its step this round is alive
+        # NOW. Only true silence (kill/crash/wedge) accumulates.
+        now = self._clock()
+        for i in completed:
+            self._beat[i] = now
+        self._collect()
+        self.sweep()
+        return progress
+
+    def sweep(self) -> None:
+        """Evict replicas silent past the lease: epoch bump + re-queue
+        of their entire unfinished load onto the survivors."""
+        now = self._clock()
+        expired = [i for i in sorted(self._live)
+                   if (now - self._beat[i]) * 1e3 > self.lease_ms]
+        for i in expired:
+            self._live.discard(i)
+            self.epoch += 1
+            self._m_evict.inc()
+            self._g_epoch.set(self.epoch)
+            self._g_live.set(len(self._live))
+            incomplete = self.replicas[i].drain_incomplete()
+            get_flight_recorder().record_event(
+                "serve.replica_evicted",
+                {"replica": i, "epoch": self.epoch,
+                 "requeued": len(incomplete)})
+            log.warning(
+                "serve router: replica %d lease expired (epoch -> %d), "
+                "re-queueing %d request(s)", i, self.epoch,
+                len(incomplete))
+            for req, emitted in incomplete:
+                if not self._live:
+                    raise NoLiveReplicasError(
+                        f"replica {i} died holding {len(incomplete)} "
+                        "request(s) and no survivor remains")
+                self.submit(req, resume_tokens=emitted)
+                self._m_requeued.inc()
+
+    def _collect(self) -> None:
+        """DRAIN newly completed results up to the router (stamped with
+        the epoch they completed under, like PR 5's response headers).
+        Popping — not copying — keeps each replica's results dict and
+        this loop sized by new completions, not lifetime traffic."""
+        for i, sched in enumerate(self.replicas):
+            while sched.results:
+                rid, res = sched.results.popitem()
+                res = dict(res)
+                res["epoch"] = self.epoch
+                res["replica"] = i
+                self.results[rid] = res
+
+    # -- convenience --------------------------------------------------------
+    def finished(self, rids) -> bool:
+        return all(r in self.results for r in rids)
+
+    def run(self, requests: List[Request],
+            max_idle_iters: int = 10000) -> Dict[Any, Dict[str, Any]]:
+        """Dispatch ``requests`` (arrival-ordered) and drive the replica
+        set until every one completes. Requests whose ``arrival_s`` is
+        in the future are held back and dispatched on time — continuous
+        admission, not a batch."""
+        pending = sorted(requests, key=lambda r: r.arrival_s)
+        rids = [r.rid for r in requests]
+        idle = 0
+        while not self.finished(rids):
+            now = self._clock()
+            while pending and pending[0].arrival_s <= now:
+                self.submit(pending.pop(0))
+            if self.step():
+                idle = 0
+            else:
+                idle += 1
+                # idle wall time is what expires a dead replica's lease
+                # — spinning without sleeping would burn the iteration
+                # budget before the silence gets long enough to matter
+                time.sleep(max(1e-4, self.lease_ms / 20e3))
+                if idle > max_idle_iters:
+                    raise RuntimeError(
+                        "router made no progress with "
+                        f"{len(rids) - len(self.results)} request(s) "
+                        "outstanding")
+        return self.results

@@ -1,0 +1,723 @@
+"""Iteration-level request scheduler — Orca's continuous batching over
+the block-paged KV cache.
+
+One :class:`Scheduler` is one model replica: it owns a
+:class:`~byteps_tpu.serve.paged_cache.PagedKVCache` pool and drives a
+four-phase iteration (``step()``):
+
+1. **Admission** — requests whose arrival time has passed join the
+   running set as soon as a decode slot AND enough free KV blocks
+   exist. FIFO in arrival order; preempted requests re-queue at the
+   FRONT (they are the oldest work).
+2. **Prefill** — one prompt chunk (``serve_prefill_chunk`` tokens) per
+   iteration through the per-request paged prefill, so a long prompt
+   interleaves with everyone else's decode steps instead of stalling
+   them (the Orca observation). The final chunk's last-position logits
+   yield the request's first generated token — that commit is TTFT.
+3. **Speculative lane** — every spec-policy request runs one
+   draft-propose/verify round per iteration instead of a plain decode
+   step: ``spec_len`` proposed tokens verified in ONE forward,
+   committed through ``speculative._verify_commit`` (the same
+   exactness-critical arithmetic as ``make_speculative_generate_fn``
+   — greedy output is identical to plain greedy decoding at any
+   accept rate, the draft only moves speed). Spec requests never join
+   the packed batch: a plain decode step would commit tokens the
+   per-request draft cache never saw, silently desyncing it and
+   collapsing acceptance. Fill-level rewind is the paged twin of the
+   dense cache rewind: ``cache_len`` advances only by the committed
+   count, later writes overwrite the rest.
+4. **Packed decode** — every non-speculative decoding request joins
+   ONE jitted device batch (static ``serve_max_batch`` rows, padded
+   rows scatter into the reserved scratch block): one token per
+   request per iteration at heterogeneous positions.
+
+**Preemption** — when a block allocation fails, the youngest admitted
+request is evicted: its blocks free immediately, its committed tokens
+are kept, and it re-queues with ``prompt + emitted`` as the recompute
+prefill input (recompute-on-resume; the vLLM policy that beats
+swapping when recompute is one chunked prefill). Continuation tokens
+are unchanged — the resume prefill's last logits ARE the logits the
+uninterrupted decode step would have produced at that position.
+
+**Exactness contract** — greedy (``temperature == 0``) requests emit
+token-for-token what a solo ``make_generate_fn`` run emits, regardless
+of batch composition, admission order, chunking, preemption, or
+speculation (pinned in tests/test_serve.py). Sampled requests draw
+per-request fold_in keys — deterministic per (seed, position) but
+intentionally NOT the solo sampler's batched key sequence.
+
+Replica death is deterministic chaos: a ``worker:kill`` rule in the
+request's :class:`~byteps_tpu.common.faults.FaultPlan` kills the
+replica at an exact step; the router's lease sweep then evicts it —
+the same death-by-silence semantics the PR 5 membership layer pins.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import itertools
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from byteps_tpu.common.config import get_config
+from byteps_tpu.common.faults import FaultPlan, WorkerKilledError, plan_from_env
+from byteps_tpu.common.flight_recorder import get_flight_recorder
+from byteps_tpu.common.logging import get_logger
+from byteps_tpu.common.metrics import get_registry
+from byteps_tpu.models.generate import gpt_apply_cached, init_cache
+from byteps_tpu.models.gpt import GPTConfig
+from byteps_tpu.models.speculative import _verify_commit
+from byteps_tpu.serve.paged_cache import (
+    PagedKVCache,
+    PoolExhausted,
+    make_paged_decode_fn,
+    make_paged_prefill_fn,
+)
+
+log = get_logger("serve.scheduler")
+
+# global replica instance sequence for per-replica gauge series (the
+# PR 6 scheduler.s<N> pattern — replica_id is caller-chosen and two
+# fresh replicas may both say 0)
+_REPLICA_SEQ = itertools.count()
+
+
+@functools.lru_cache(maxsize=16)
+def _make_pick_fn(vocab_size: int):
+    """Process-wide jitted token pick, one per vocab size (jit's own
+    shape cache handles the batch dimension). The greedy/sampled select
+    arm IS generate.make_pick — the serve layer only adds per-row keys
+    (fold_in by absolute position, invariant to batch packing), so the
+    bit-exact greedy contract can never drift from make_generate_fn's.
+    lru-cached like the paged-step factories: fresh replicas (bench
+    reps, failover respawns) must reuse the compiled programs."""
+    from byteps_tpu.models.generate import make_pick, make_truncate
+
+    pick1 = make_pick(make_truncate(None, None, vocab_size))
+
+    def pick(logits, seeds, pos, temps):
+        keys = jax.vmap(
+            lambda s, p: jax.random.fold_in(jax.random.PRNGKey(s), p))(
+                seeds, pos)
+        return jax.vmap(lambda l, k, t: pick1(l[None], k, t)[0])(
+            logits, keys, temps)
+
+    return jax.jit(pick)
+
+
+@dataclasses.dataclass
+class SpecPolicy:
+    """Per-request speculative decoding policy.
+
+    ``kind="lookup"`` — prompt-lookup drafting (model-free): propose
+    the ``spec_len`` tokens that followed the most recent earlier
+    occurrence of the current bigram in the committed context (the
+    ``make_lookup_generate_fn`` trick, host-side).
+    ``kind="draft"`` — a draft MODEL (any GPT-family config sharing
+    the target's vocab): ``spec_len`` greedy draft steps against a
+    per-request dense draft cache, the
+    ``make_speculative_generate_fn`` proposal semantics in-loop.
+    Greedy-only (verification compares greedy argmax)."""
+
+    kind: str = "lookup"
+    spec_len: int = 0              # 0 = BYTEPS_SERVE_SPEC_LEN
+    draft_params: Any = None
+    draft_cfg: Optional[GPTConfig] = None
+
+    def __post_init__(self):
+        if self.kind not in ("lookup", "draft"):
+            raise ValueError(f"unknown spec kind {self.kind!r}")
+        if self.kind == "draft" and (self.draft_params is None
+                                     or self.draft_cfg is None):
+            raise ValueError("draft policy needs draft_params + draft_cfg")
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. ``prompt`` is a 1-D int32 token array;
+    the scheduler emits up to ``max_new`` tokens (stopping early at
+    ``eos_id`` when set). ``temperature == 0`` is the bit-pinned greedy
+    path; sampled requests use per-request ``seed``."""
+
+    rid: Any
+    prompt: np.ndarray
+    max_new: int
+    temperature: float = 0.0
+    seed: int = 0
+    eos_id: Optional[int] = None
+    spec: Optional[SpecPolicy] = None
+    arrival_s: float = 0.0
+
+
+class _Run:
+    """Scheduler-internal per-request state."""
+
+    __slots__ = ("req", "full_input", "emitted", "pending", "cache_len",
+                 "prefill_done", "state", "t_submit", "t_origin", "t_admit",
+                 "t_first", "t_last", "preemptions", "spec_rounds",
+                 "draft_cache", "tok_s")
+
+    def __init__(self, req: Request, resume_tokens: List[int],
+                 t_submit: float):
+        self.req = req
+        self.emitted: List[int] = list(resume_tokens)
+        self.full_input = np.concatenate(
+            [np.asarray(req.prompt, np.int32),
+             np.asarray(self.emitted, np.int32)])
+        self.pending: Optional[int] = None
+        self.cache_len = 0
+        self.prefill_done = 0
+        self.state = "queued"
+        self.t_submit = t_submit
+        # latency origin: the request's ARRIVAL, not the (possibly
+        # earlier) submit call — offered-load benches submit ahead of
+        # time and TTFT must not credit queue-building as waiting
+        self.t_origin = max(t_submit, req.arrival_s)
+        self.t_admit = 0.0
+        self.t_first: Optional[float] = None
+        self.t_last = self.t_origin
+        self.preemptions = 0
+        self.spec_rounds = 0
+        self.draft_cache = None
+        self.tok_s: List[float] = []
+
+
+class NoProgressError(RuntimeError):
+    """The drain loop spun without any request advancing — a scheduler
+    bug or an impossible pool configuration; raised instead of hanging
+    (the serve twin of the PR 5 StallError philosophy)."""
+
+
+class Scheduler:
+    """One serving replica: continuous admission, chunked prefill,
+    packed decode, preemption, per-request speculation. See the module
+    docstring for the iteration anatomy and docs/serving.md for the
+    operator view."""
+
+    def __init__(self, params, cfg: GPTConfig, *,
+                 tp_axis: Optional[str] = None,
+                 max_batch: Optional[int] = None,
+                 block_size: Optional[int] = None,
+                 pool_blocks: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 quant_cache: Optional[bool] = None,
+                 fault_plan: Optional[FaultPlan] = None,
+                 replica_id: int = 0,
+                 clock=time.monotonic):
+        c = get_config()
+        self.params = params
+        self.cfg = cfg
+        self.tp_axis = tp_axis
+        self.replica_id = replica_id
+        self.max_batch = max_batch if max_batch is not None \
+            else c.serve_max_batch
+        self.prefill_chunk = prefill_chunk if prefill_chunk is not None \
+            else c.serve_prefill_chunk
+        self.default_spec_len = c.serve_spec_len
+        quant = quant_cache if quant_cache is not None \
+            else c.serve_quant_cache
+        bs = block_size if block_size is not None else c.serve_block_size
+        nb = pool_blocks if pool_blocks is not None else c.serve_pool_blocks
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1; got {self.max_batch}")
+        if self.prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1; got {self.prefill_chunk}")
+        if cfg.max_seq % bs != 0:
+            log.warning(
+                "serve: block_size %d does not divide max_seq %d — the "
+                "gathered views carry a zero tail past max_seq (correct, "
+                "slightly wasteful)", bs, cfg.max_seq)
+        kv_loc = params["blocks"][0]["wk"].shape[-1] // cfg.head_dim
+        self.cache = PagedKVCache(cfg, block_size=bs, pool_blocks=nb,
+                                  max_batch=self.max_batch, h_loc=kv_loc,
+                                  quant=quant)
+        self._decode = make_paged_decode_fn(cfg, bs, tp_axis)
+        self._pick = _make_pick_fn(cfg.vocab_size)
+        self._draft_steps: Dict[int, Any] = {}
+        self._plan = fault_plan if fault_plan is not None \
+            else plan_from_env(worker_id=replica_id)
+        self._dead = False
+        self._clock = clock
+        self._waiting: deque = deque()
+        self._running: List[_Run] = []
+        self._runs: Dict[Any, _Run] = {}
+        self.results: Dict[Any, Dict[str, Any]] = {}
+        # admit a little past the decode-slot count so a finished
+        # request's slot refills from a PREFILLED standby instead of
+        # waiting a prompt's worth of prefill chunks with the batch
+        # underfull (the pool pressure valve is preemption either way)
+        self._admit_cap = self.max_batch + max(1, self.max_batch // 4)
+        _reg = get_registry()
+        self._m = {
+            "admitted": _reg.counter("serve.admitted"),
+            "completed": _reg.counter("serve.completed"),
+            "preempted": _reg.counter("serve.preempted"),
+            "resumed": _reg.counter("serve.resumed"),
+            "prefill_tokens": _reg.counter("serve.prefill_tokens"),
+            "decode_tokens": _reg.counter("serve.decode_tokens"),
+            "spec_rounds": _reg.counter("serve.spec_rounds"),
+            "spec_tokens": _reg.counter("serve.spec_tokens"),
+            "iterations": _reg.counter("serve.iterations"),
+            "ttft_ms": _reg.histogram("serve.ttft_ms"),
+            "token_ms": _reg.histogram("serve.token_ms"),
+            "request_ms": _reg.histogram("serve.request_ms"),
+            "batch_occupancy": _reg.histogram("serve.batch_occupancy"),
+            # per-replica series (global instance sequence): two
+            # replicas' queues must not mask each other
+            "queue_depth": _reg.gauge(
+                f"serve.r{next(_REPLICA_SEQ)}.queue_depth"),
+        }
+
+    # -- client surface -----------------------------------------------------
+    def submit(self, req: Request,
+               resume_tokens: Optional[List[int]] = None) -> None:
+        """Enqueue a request (idempotence is the caller's problem: rids
+        must be unique per replica lifetime). ``resume_tokens`` is the
+        router's failover path — tokens already committed on a dead
+        replica, kept verbatim and recomputed into fresh KV."""
+        prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must hold at least one token")
+        if req.max_new < 1:
+            raise ValueError(f"max_new must be >= 1; got {req.max_new}")
+        spec_k = 0
+        if req.spec is not None:
+            if req.temperature != 0.0:
+                raise ValueError(
+                    "speculative policies are greedy-only "
+                    "(verification compares greedy argmax)")
+            spec_k = req.spec.spec_len or self.default_spec_len
+            if spec_k < 1:
+                raise ValueError(
+                    f"effective spec_len must be >= 1; got {spec_k} "
+                    "(policy spec_len or BYTEPS_SERVE_SPEC_LEN)")
+        total = prompt.size + req.max_new + spec_k
+        if total > self.cfg.max_seq:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new ({req.max_new})"
+                + (f" + spec_len ({spec_k})" if spec_k else "")
+                + f" exceeds cfg.max_seq ({self.cfg.max_seq})")
+        if self.cache.blocks_for(total) > self.cache.pool_blocks - 1:
+            raise ValueError(
+                f"request needs {self.cache.blocks_for(total)} KV blocks "
+                f"but the pool holds {self.cache.pool_blocks - 1} — it "
+                "could never be scheduled")
+        if req.rid in self._runs:
+            raise ValueError(f"duplicate request id {req.rid!r}")
+        run = _Run(req, list(resume_tokens or []), self._clock())
+        self._runs[req.rid] = run
+        if resume_tokens:
+            self._waiting.appendleft(run)   # failover work is oldest
+            self._m["resumed"].inc()
+        else:
+            self._waiting.append(run)
+        self._m["queue_depth"].set(len(self._waiting))
+
+    @property
+    def load(self) -> int:
+        """Routing weight: queued + running requests."""
+        return len(self._waiting) + len(self._running)
+
+    @property
+    def finished(self) -> bool:
+        return not self._waiting and not self._running
+
+    @property
+    def dead(self) -> bool:
+        return self._dead
+
+    def result(self, rid) -> Dict[str, Any]:
+        return self.results[rid]
+
+    def drain_incomplete(self):
+        """Pop every unfinished request (queued AND running), freeing
+        their blocks; returns ``[(Request, emitted_tokens), ...]`` for
+        the router to re-queue on a survivor. Completed results stay
+        readable — they were already delivered."""
+        out = []
+        for run in list(self._running):
+            self.cache.release(run.req.rid)
+            out.append((run.req, list(run.emitted)))
+            del self._runs[run.req.rid]
+        self._running.clear()
+        while self._waiting:
+            run = self._waiting.popleft()
+            out.append((run.req, list(run.emitted)))
+            del self._runs[run.req.rid]
+        self._m["queue_depth"].set(0)
+        return out
+
+    # -- jit caches ---------------------------------------------------------
+    def _prefill_fn(self, C: int, with_readout: bool = True):
+        # the factory is lru-cached process-wide — every replica shares
+        # one jit wrapper per (cfg, block_size, C, readout)
+        return make_paged_prefill_fn(self.cfg, self.cache.block_size, C,
+                                     self.tp_axis, with_readout)
+
+    def _width(self, rid) -> int:
+        """Power-of-two bucket of the request's live table: the jitted
+        steps retrace once per bucket instead of once per length, and a
+        short request never pays a max_seq-wide gather."""
+        n = self.cache.table_len(rid)
+        w = 1
+        while w < n:
+            w <<= 1
+        return min(w, self.cache.blocks_per_req)
+
+
+    def _draft_step(self, draft_cfg: GPTConfig):
+        key = id(draft_cfg)
+        fn = self._draft_steps.get(key)
+        if fn is None:
+            fn = jax.jit(_make_draft_apply(draft_cfg, self.tp_axis))
+            self._draft_steps[key] = fn
+        return fn
+
+    # -- internals ----------------------------------------------------------
+    def _commit_token(self, run: _Run, tok: int, now: float) -> None:
+        """Append one generated token, stamp latencies, finish when the
+        request is done (max_new reached or eos emitted)."""
+        run.emitted.append(tok)
+        run.pending = tok
+        run.tok_s.append(now)
+        if run.t_first is None:
+            run.t_first = now
+            self._m["ttft_ms"].observe((now - run.t_origin) * 1e3)
+        else:
+            self._m["token_ms"].observe((now - run.t_last) * 1e3)
+        run.t_last = now
+        if (len(run.emitted) >= run.req.max_new
+                or (run.req.eos_id is not None
+                    and tok == run.req.eos_id)):
+            self._finish(run, now)
+
+    def _finish(self, run: _Run, now: float) -> None:
+        self.cache.release(run.req.rid)
+        self._running.remove(run)
+        # the run record is done — drop it so a long-lived replica's
+        # memory tracks its LIVE load, not its lifetime request count
+        # (results stay until the caller/router consumes them)
+        del self._runs[run.req.rid]
+        run.state = "done"
+        prompt = np.asarray(run.req.prompt, np.int32).reshape(-1)
+        emitted = np.asarray(run.emitted[:run.req.max_new], np.int32)
+        self.results[run.req.rid] = {
+            "tokens": np.concatenate([prompt, emitted]),
+            "emitted": emitted,
+            "ttft_s": (run.t_first - run.t_origin
+                       if run.t_first is not None else None),
+            "total_s": now - run.t_origin,
+            "token_s": np.asarray(run.tok_s[:run.req.max_new]),
+            "preemptions": run.preemptions,
+            "spec_rounds": run.spec_rounds,
+        }
+        self._m["completed"].inc()
+        self._m["request_ms"].observe((now - run.t_origin) * 1e3)
+
+    def _preempt(self, run: _Run) -> None:
+        """Evict ``run`` under pool pressure: free its blocks, keep its
+        committed tokens, re-queue at the FRONT for recompute-on-resume
+        (its next prefill input is prompt + emitted)."""
+        self.cache.release(run.req.rid)
+        run.state = "queued"
+        run.preemptions += 1
+        run.pending = None
+        run.cache_len = 0
+        run.prefill_done = 0
+        run.draft_cache = None
+        run.full_input = np.concatenate(
+            [np.asarray(run.req.prompt, np.int32),
+             np.asarray(run.emitted, np.int32)])
+        self._running.remove(run)
+        self._waiting.appendleft(run)
+        self._m["preempted"].inc()
+        self._m["queue_depth"].set(len(self._waiting))
+        get_flight_recorder().record_event(
+            "serve.preempt",
+            {"replica": self.replica_id, "rid": str(run.req.rid),
+             "emitted": len(run.emitted)})
+
+    def _ensure_or_preempt(self, run: _Run, n_tokens: int) -> bool:
+        """Grow ``run``'s block table to ``n_tokens``, preempting the
+        youngest admitted request as often as needed. Returns False when
+        ``run`` itself became the victim (the caller skips it)."""
+        while True:
+            try:
+                self.cache.ensure(run.req.rid, n_tokens)
+                return True
+            except PoolExhausted:
+                victim = None
+                for cand in reversed(self._running):
+                    if cand.state in ("prefill", "decode"):
+                        victim = cand
+                        break
+                if victim is None:
+                    raise RuntimeError(
+                        "KV pool exhausted with no preemptible request — "
+                        "pool sizing bug (submit() validates single-"
+                        "request fit)")
+                self._preempt(victim)
+                if victim is run:
+                    return False
+
+    # -- speculative lane ---------------------------------------------------
+    def _lookup_propose(self, run: _Run, K: int) -> np.ndarray:
+        """Host-side prompt-lookup draft: the continuation of the most
+        recent earlier occurrence of the committed context's last
+        bigram (speculative.make_lookup_generate_fn's propose(), numpy).
+        No match → junk proposals (they just accept 0)."""
+        ctx = np.concatenate(
+            [np.asarray(run.req.prompt, np.int32),
+             np.asarray(run.emitted, np.int32)])
+        n = ctx.size
+        if n < 2:
+            return np.zeros(K, np.int32)
+        prev, last = int(ctx[-2]), int(ctx[-1])
+        match = np.flatnonzero(
+            (ctx[:-1] == prev) & (ctx[1:] == last))
+        match = match[match <= n - 3]   # strictly earlier than the bigram
+        if match.size == 0:
+            return np.zeros(K, np.int32)
+        p = int(match[-1])
+        idx = np.clip(p + 2 + np.arange(K), 0, n - 1)
+        return ctx[idx].astype(np.int32)
+
+    def _draft_propose(self, run: _Run, K: int):
+        """K greedy draft-model steps (make_speculative_generate_fn's
+        dstep scan, in-loop with a per-request dense draft cache).
+        Returns ``(proposals (K,), draft fill level before the round)``
+        — the rewind anchor."""
+        pol = run.req.spec
+        step = self._draft_step(pol.draft_cfg)
+        dc = run.draft_cache
+        len0 = int(dc.length)
+        tok = run.pending
+        d = []
+        for _ in range(K):
+            lg, dc = step(pol.draft_params,
+                          jnp.asarray([[tok]], jnp.int32), dc)
+            tok = int(np.argmax(np.asarray(lg)[0, -1]))
+            d.append(tok)
+        run.draft_cache = dc
+        return np.asarray(d, np.int32), len0
+
+    def _spec_round(self, run: _Run, now: float) -> None:
+        """One propose→verify→commit round for a spec-policy request.
+        Exactness rides on speculative._verify_commit — the identical
+        accept/commit arithmetic of make_speculative_generate_fn."""
+        pol = run.req.spec
+        K = pol.spec_len or self.default_spec_len
+        pos0 = run.cache_len
+        if not self._ensure_or_preempt(run, pos0 + K):
+            return
+        draft_len0 = None
+        if pol.kind == "draft":
+            d, draft_len0 = self._draft_propose(run, K)
+        else:
+            d = self._lookup_propose(run, K)
+        feed = np.concatenate([[run.pending], d[:K - 1]]).astype(np.int32)
+        logits, self.cache.state = self._prefill_fn(K)(
+            self.params, self.cache.state, jnp.asarray(feed)[None],
+            jnp.int32(pos0),
+            jnp.asarray(self.cache.table_row(run.req.rid,
+                                             self._width(run.req.rid))))
+        out = jnp.zeros((1, K + 1), jnp.int32)
+        out, n_emitted, next_tok, committed = _verify_commit(
+            jnp.asarray(d)[None], logits, out, jnp.int32(0), K)
+        n = int(n_emitted)
+        block = np.asarray(out)[0, :n]
+        committed = int(committed)
+        run.cache_len = pos0 + committed
+        if pol.kind == "draft":
+            run.draft_cache = run.draft_cache._replace(
+                length=jnp.asarray(draft_len0 + committed, jnp.int32))
+        run.spec_rounds += 1
+        self._m["spec_rounds"].inc()
+        self._m["spec_tokens"].inc(n)
+        # the round emits [d_1..d_m (, correction)] then the NEXT round's
+        # pending token; commit them one by one so eos/max_new stop
+        # mid-block exactly like the dense sampler's output truncation
+        for t in block:
+            if run.state != "decode":
+                return                       # finished mid-block
+            self._commit_token(run, int(t), now)
+        if run.state == "decode":
+            run.pending = int(np.asarray(next_tok)[0])
+
+    # -- the iteration ------------------------------------------------------
+    def step(self) -> bool:
+        """One scheduler iteration; returns True when any request made
+        progress (admission, a prefill chunk, a spec round, or at least
+        one decoded token)."""
+        if self._dead:
+            raise WorkerKilledError(
+                f"serve replica {self.replica_id} is dead")
+        if self._plan is not None:
+            inj = self._plan.intercept("serve", -1)
+            if inj is not None:
+                if inj.kind == "kill":
+                    self._dead = True
+                    get_flight_recorder().record_event(
+                        "serve.replica_killed",
+                        {"replica": self.replica_id,
+                         "step": self._plan.step})
+                    raise WorkerKilledError(
+                        f"serve replica {self.replica_id} killed by fault "
+                        f"plan at op {self._plan.step}")
+                if inj.kind == "hang":
+                    time.sleep(inj.rule.latency_ms / 1e3)
+        self._m["iterations"].inc()
+        now = self._clock()
+        progress = False
+
+        # 1. admission (FIFO in arrival order; head-blocked on blocks so
+        # latecomers can't starve the oldest request)
+        while (self._waiting
+               and len(self._running) < self._admit_cap
+               and self._waiting[0].req.arrival_s <= now):
+            run = self._waiting[0]
+            need = self.cache.blocks_for(len(run.full_input) + 1)
+            if need > self.cache.free_blocks:
+                break
+            self._waiting.popleft()
+            self.cache.register(run.req.rid)
+            self.cache.ensure(run.req.rid, len(run.full_input) + 1)
+            run.state = "prefill"
+            run.t_admit = now
+            self._running.append(run)
+            self._m["admitted"].inc()
+            self._m["queue_depth"].set(len(self._waiting))
+            progress = True
+
+        # 2. prefill lane: ONE chunk for the oldest prefilling request
+        for run in list(self._running):
+            if run.state != "prefill":
+                continue
+            C = min(self.prefill_chunk,
+                    len(run.full_input) - run.prefill_done)
+            toks = run.full_input[run.prefill_done:run.prefill_done + C]
+            final = run.prefill_done + C == len(run.full_input)
+            # intermediate chunks skip the vocab readout — only the
+            # final chunk's last-position logits are ever read
+            logits, self.cache.state = self._prefill_fn(C, final)(
+                self.params, self.cache.state, jnp.asarray(toks)[None],
+                jnp.int32(run.prefill_done),
+                jnp.asarray(self.cache.table_row(run.req.rid,
+                                                 self._width(run.req.rid))))
+            run.prefill_done += C
+            run.cache_len = run.prefill_done
+            self._m["prefill_tokens"].inc(C)
+            progress = True
+            if run.prefill_done == len(run.full_input):
+                # device-side last-position slice: only vocab floats
+                # cross to host, not the whole (1, C, vocab) chunk
+                picked = self._pick(
+                    logits[:, -1],
+                    jnp.asarray([run.req.seed], jnp.int32),
+                    jnp.asarray([run.cache_len], jnp.int32),
+                    jnp.asarray([run.req.temperature], jnp.float32))
+                run.state = "decode"
+                if (run.req.spec is not None
+                        and run.req.spec.kind == "draft"):
+                    self._build_draft_cache(run)
+                self._commit_token(run, int(np.asarray(picked)[0]),
+                                   self._clock())
+            break                                 # one chunk per iteration
+
+        # 3. speculative lane: one round per spec request — they never
+        # take plain decode steps (a token committed outside the round
+        # would desync the per-request draft cache)
+        for run in [r for r in self._running
+                    if r.state == "decode" and r.req.spec is not None]:
+            if run.state == "decode":   # an earlier round may preempt
+                self._spec_round(run, self._clock())
+                progress = True
+
+        # 4. packed decode for the non-speculative decoders
+        packed: List[_Run] = []
+        for run in list(self._running):
+            if run.state != "decode" or run.req.spec is not None:
+                continue
+            if len(packed) >= self.max_batch:
+                break
+            if self._ensure_or_preempt(run, run.cache_len + 1):
+                if run.state == "decode":     # survived any preemptions
+                    packed.append(run)
+        packed = [r for r in packed if r.state == "decode"]
+        if packed:
+            R = self.max_batch
+            W = max(self._width(r.req.rid) for r in packed)
+            toks = np.zeros(R, np.int32)
+            pos = np.zeros(R, np.int32)
+            tables = np.zeros((R, W), np.int32)
+            seeds = np.zeros(R, np.int32)
+            temps = np.zeros(R, np.float32)
+            for i, run in enumerate(packed):
+                toks[i] = run.pending
+                pos[i] = run.cache_len
+                tables[i] = self.cache.table_row(run.req.rid, W)
+                seeds[i] = run.req.seed
+                temps[i] = run.req.temperature
+            logits, self.cache.state = self._decode(
+                self.params, self.cache.state, jnp.asarray(toks),
+                jnp.asarray(pos), jnp.asarray(tables))
+            picked = np.asarray(self._pick(
+                logits, jnp.asarray(seeds), jnp.asarray(pos + 1),
+                jnp.asarray(temps)))
+            now = self._clock()
+            for i, run in enumerate(packed):
+                run.cache_len += 1
+                self._commit_token(run, int(picked[i]), now)
+            self._m["decode_tokens"].inc(len(packed))
+            self._m["batch_occupancy"].observe(len(packed))
+            progress = True
+        return progress
+
+    def _build_draft_cache(self, run: _Run) -> None:
+        """Prefill the per-request dense draft cache over the full
+        committed context (prompt + resumed tokens)."""
+        pol = run.req.spec
+        kv_d = (pol.draft_params["blocks"][0]["wk"].shape[-1]
+                // pol.draft_cfg.head_dim)
+        dc = init_cache(pol.draft_cfg, 1, h_loc=kv_d)
+        _, dc = self._draft_step(pol.draft_cfg)(
+            pol.draft_params, jnp.asarray(run.full_input)[None], dc)
+        run.draft_cache = dc
+
+    def serve(self, requests: List[Request], max_idle_iters: int = 10000):
+        """Submit + drain convenience for tests/bench: runs ``step()``
+        until every request finished. Arrival times are honored against
+        this scheduler's clock."""
+        for r in requests:
+            self.submit(r)
+        idle = 0
+        while not self.finished:
+            if self.step():
+                idle = 0
+            else:
+                idle += 1
+                if self._waiting and all(
+                        r.req.arrival_s > self._clock()
+                        for r in self._waiting):
+                    time.sleep(1e-4)
+                elif idle > max_idle_iters:
+                    raise NoProgressError(
+                        f"{len(self._waiting)} queued / "
+                        f"{len(self._running)} running requests made no "
+                        f"progress for {max_idle_iters} iterations")
+        return self.results
+
+
+def _make_draft_apply(draft_cfg: GPTConfig, tp_axis):
+    """A named closure (not functools.partial) so jit caches by draft
+    config identity and the traceback names the draft step."""
+    def _draft_apply(p, t, c):
+        return gpt_apply_cached(p, t, c, draft_cfg, tp_axis)
+    return _draft_apply

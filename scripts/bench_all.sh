@@ -42,7 +42,8 @@ run --model vit                          # beyond-reference families
 run --model t5
 run --model moe                          # Switch-MoE routing overhead vs dense
 run --ce dense                           # flagship w/o fused CE (A/B attribution)
-run --mode generate                      # KV-cache decode vs full recompute
+run --mode generate                      # KV-cache decode vs full recompute (+BENCH_generate.json)
+run_trend_leg --mode serve               # continuous-batching serve vs sequential (+BENCH_serve.json)
 run --mode dcn                           # DCN summation tier
 run --mode dcn-profile                   # host component ceilings
 run_trend_leg --mode throttled           # compression race on emulated slow DCN (+BENCH_throttled.json)
@@ -51,7 +52,7 @@ run_trend_leg --mode chaos               # goodput vs fault rate (+BENCH_chaos.j
 run_trend_leg --mode hybrid              # sharded-wire hierarchical race (+BENCH_hybrid.json)
 
 # Perf-trend regression gate LAST: the legs above rewrote
-# BENCH_{throttled,chaos,hybrid}.json in place; compare the fresh
+# BENCH_{throttled,chaos,hybrid,serve}.json in place; compare the fresh
 # headline metrics against the checked-in spread-aware floors
 # (BENCH_trend.json) and FAIL the whole run on a regression. After an
 # intentional trajectory change: python bench.py --mode trend --refresh

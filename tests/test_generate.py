@@ -320,6 +320,123 @@ def test_quant_cache_prefill_close_and_greedy_matches(setup):
     np.testing.assert_array_equal(np.asarray(toks_d), np.asarray(toks_q))
 
 
+# ---- cache internals at the edges the paged serve tier stresses -------------
+def test_cache_write_at_tail_positions():
+    """_cache_write landing flush against max_seq: the last T rows are
+    written exactly, nothing before them moves, and a T=1 write into
+    the very last slot works — the offsets the paged pool's last block
+    exercises on every long request."""
+    from byteps_tpu.models.generate import _cache_write
+
+    S, h, D = 16, 2, 4
+    rng = np.random.default_rng(3)
+    base = jnp.asarray(rng.normal(size=(1, S, h, D)).astype(np.float32))
+    for T in (4, 1):
+        new = jnp.asarray(rng.normal(size=(1, T, h, D)).astype(np.float32))
+        out = _cache_write(base, new, S - T)
+        np.testing.assert_array_equal(np.asarray(out[:, S - T:]),
+                                      np.asarray(new))
+        np.testing.assert_array_equal(np.asarray(out[:, :S - T]),
+                                      np.asarray(base[:, :S - T]))
+    # one past the end must clamp (the documented dynamic_update_slice
+    # behavior make_generate_fn's trace-time guard exists to prevent)
+    new = jnp.asarray(rng.normal(size=(1, 2, h, D)).astype(np.float32))
+    out = _cache_write(base, new, S - 1)
+    np.testing.assert_array_equal(np.asarray(out[:, S - 2:]),
+                                  np.asarray(new))
+
+
+def test_quant_slot_roundtrip_error_bound_at_tail():
+    """_QuantSlot write→read roundtrip (the quant pool's per-token
+    path): dequantized values stay within scale/2 of the input at every
+    written position, including a write flush against the cache tail."""
+    from byteps_tpu.models.generate import (
+        _QuantSlot, _cache_read, _cache_write)
+
+    S, h, D = 16, 2, 8
+    rng = np.random.default_rng(4)
+    slot = _QuantSlot(jnp.zeros((1, S, h, D), jnp.int8),
+                      jnp.zeros((1, S, h), jnp.float32))
+    for pos0, T in ((0, 5), (S - 5, 5), (S - 1, 1)):
+        x = jnp.asarray(rng.normal(size=(1, T, h, D)).astype(np.float32))
+        slot2 = _cache_write(slot, x, pos0)
+        deq = np.asarray(_cache_read(slot2, jnp.float32))[:, pos0:pos0 + T]
+        scale = np.asarray(slot2.scale)[:, pos0:pos0 + T]
+        err = np.abs(deq - np.asarray(x))
+        assert (err <= scale[..., None] / 2 + 1e-7).all(), (pos0, T)
+        # unwritten positions dequantize to exact zeros (zero-init q and
+        # scale) — the contract the paged gather's zero-mask mirrors
+        before = np.asarray(_cache_read(slot, jnp.float32))
+        assert (before == 0.0).all()
+
+
+def test_cached_attention_parity_on_ragged_positions():
+    """_cached_attention against a partially filled cache equals plain
+    attention over exactly the visible prefix, for a spread of
+    (fill, T) shapes — and the per-batch offset-VECTOR form (the packed
+    serve decode) matches row-wise scalar calls."""
+    from byteps_tpu.models.generate import _cached_attention
+    from byteps_tpu.ops.flash_attention import attention_lse_jnp
+
+    S, h, D = 24, 2, 8
+    rng = np.random.default_rng(5)
+    kv = rng.normal(size=(2, 1, S, h, D)).astype(np.float32)
+    for fill, T in ((3, 1), (11, 1), (5, 4), (S - 4, 4)):
+        cache_k = jnp.zeros((1, S, h, D))
+        cache_v = jnp.zeros((1, S, h, D))
+        cache_k = cache_k.at[:, :fill + T].set(kv[0, :, :fill + T])
+        cache_v = cache_v.at[:, :fill + T].set(kv[1, :, :fill + T])
+        q = jnp.asarray(rng.normal(size=(1, T, h, D)).astype(np.float32))
+        o = _cached_attention(q, cache_k, cache_v, fill)
+        # golden: attention over only the live keys, same global offsets
+        o_ref, _ = attention_lse_jnp(q, cache_k[:, :fill + T],
+                                     cache_v[:, :fill + T], fill, 0,
+                                     causal=True)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                                   rtol=1e-6, atol=1e-6)
+
+    # vector offsets: 3 rows at ragged positions == 3 scalar calls
+    k3 = jnp.asarray(rng.normal(size=(3, S, h, D)).astype(np.float32))
+    v3 = jnp.asarray(rng.normal(size=(3, S, h, D)).astype(np.float32))
+    q3 = jnp.asarray(rng.normal(size=(3, 1, h, D)).astype(np.float32))
+    pos = jnp.asarray([2, 9, 17])
+    o_vec, lse_vec = attention_lse_jnp(q3, k3, v3, pos, 0, causal=True)
+    for b in range(3):
+        o_b, lse_b = attention_lse_jnp(q3[b:b + 1], k3[b:b + 1],
+                                       v3[b:b + 1], int(pos[b]), 0,
+                                       causal=True)
+        np.testing.assert_allclose(np.asarray(o_vec[b:b + 1]),
+                                   np.asarray(o_b), rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(lse_vec[b:b + 1]),
+                                   np.asarray(lse_b), rtol=1e-6, atol=1e-6)
+
+
+# ---- greedy-path pin: the serve scheduler's bit-exact packing premise -------
+def test_greedy_deterministic_across_jit_and_batch(setup):
+    """temperature == 0 tokens are invariant to (a) jit vs eager and
+    (b) which batch the row rides in — the property that lets the serve
+    tier pack heterogeneous requests into one device batch and still
+    pin outputs bit-identical to solo runs."""
+    params, prompt = setup
+    B = prompt.shape[0]
+    gen = make_generate_fn(CFG, max_new=6)
+    batched = np.asarray(gen(params, prompt, jax.random.PRNGKey(0), 0.0))
+    # rows match their own B=1 runs
+    for b in range(B):
+        solo = np.asarray(gen(params, prompt[b:b + 1],
+                              jax.random.PRNGKey(1), 0.0))
+        np.testing.assert_array_equal(batched[b:b + 1], solo)
+    # and a row embedded in a LARGER (repeated) batch
+    big = jnp.concatenate([prompt, prompt, prompt[:1]], axis=0)
+    out_big = np.asarray(gen(params, big, jax.random.PRNGKey(2), 0.0))
+    np.testing.assert_array_equal(out_big[:B], batched)
+    np.testing.assert_array_equal(out_big[B:2 * B], batched)
+    # eager (no jit) reproduces the jitted tokens
+    with jax.disable_jit():
+        eager = np.asarray(gen(params, prompt, jax.random.PRNGKey(3), 0.0))
+    np.testing.assert_array_equal(eager, batched)
+
+
 def test_quant_cache_under_tensor_parallelism(setup):
     """quant_cache composes with tp: per-shard caches quantize their own
     head slices; tokens match the single-device quantized sampler."""
