@@ -16,4 +16,6 @@ from byteps_tpu.comm.ici import (  # noqa: F401
     broadcast_flat,
     compressed_allreduce_flat,
     compressed_allreduce_local,
+    compressed_reduce_scatter_flat,
+    compressed_reduce_scatter_local,
 )

@@ -12,13 +12,35 @@ The compressed all-reduce reproduces the reference's hybrid-PS dataflow
 worker decompress; ``core_loops.cc`` COMPRESS/PUSH/PULL/DECOMPRESS stages +
 ``server.cc`` ``SumRecvBuff``) with devices as both workers and "servers":
 device j owns segment j of every chunk (the analog of key→server hashing),
-receives peers' compressed segments over ``all_to_all``, decompresses, sums
-in fp32, recompresses, and ``all_gather``s the result. Wire bytes per
-direction are (N−1)/N · compressed_size — the same ratio the reference's
+receives peers' compressed segments, decompresses, sums in fp32,
+recompresses, and broadcasts the result. Wire bytes per direction are
+(N−1)/N · compressed_size — the same ratio the reference's
 colocated-server topology achieves.
 
 Compressors whose payloads sum positionally (seed-synced randomk) skip the
 decompress/recompress round trip entirely — the positional-sum fast path.
+
+Wire tiers (``BYTEPS_ICI_TIER``, per-call ``tier=`` override;
+docs/architecture.md three-tier table):
+
+* ``staged`` (default) — payload transport is one monolithic
+  ``all_to_all`` ("push") and one ``all_gather`` ("pull"): codec compute
+  and wire time serialize and every hop pays the full-exchange latency.
+* ``ring`` — the ``ici-compressed`` tier: the same payloads ride ``n−1``
+  ring hops (``ops/ring_collective_kernels.py`` — Pallas
+  ``make_async_remote_copy`` kernels on TPU, ``lax.ppermute`` twins
+  everywhere else), one segment-payload per link per hop, each hop's DMA
+  overlapping the neighboring hops' codec work. The aggregation
+  arithmetic (worker-ordered payload stack → the codec's own
+  ``decompress_sum`` / the shared positional fold → ``two_way``
+  recompress) is the SAME expression as the staged path, which is what
+  pins the ring result BIT-exact against staged for deterministic codecs
+  — EF and two_way included (tests/test_ring_ici.py). Stochastic
+  presummable codecs (randomk) instead take the genuinely fused per-hop
+  form — ``ring_presum`` accumulates the running partial in payload
+  space at every hop, the bandwidth-optimal ring reduce-scatter — whose
+  chain-order fp32 adds are pinned statistically (same key schedule and
+  support; values at summation-order roundoff).
 """
 
 from __future__ import annotations
@@ -30,14 +52,43 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from byteps_tpu.common.config import get_config
 from byteps_tpu.common.metrics import get_registry
 from byteps_tpu.compression.base import Compressor
+from byteps_tpu.ops.ring_collective_kernels import (
+    ring_allgather,
+    ring_collect,
+    ring_presum,
+)
+
+_TIERS = ("staged", "ring")
+
+
+def _resolve_tier(tier: Optional[str]) -> str:
+    t = tier or get_config().ici_tier
+    if t not in _TIERS:
+        raise ValueError(
+            f"unknown ICI tier {t!r} (BYTEPS_ICI_TIER / tier=): "
+            f"expected one of {_TIERS}")
+    return t
 
 
 # handle cache keyed by registry identity (tests reset the registry):
 # the dispatch path must not pay a name format + registry lookup per
 # collective — the metrics design rule is handles resolved once
-_dispatch_cache = {"reg": None, "counters": {}}
+_counter_cache = {"reg": None, "counters": {}}
+
+
+def _ici_counter(name: str):
+    reg = get_registry()
+    if _counter_cache["reg"] is not reg:
+        _counter_cache["reg"] = reg
+        _counter_cache["counters"] = {}
+    c = _counter_cache["counters"].get(name)
+    if c is None:
+        c = reg.counter(name)
+        _counter_cache["counters"][name] = c
+    return c
 
 
 def _count_dispatch(kind: str) -> None:
@@ -45,15 +96,53 @@ def _count_dispatch(kind: str) -> None:
     device completion — the quantity the ici_lock serializes and a stall
     report wants: did the host stop issuing, or did the device stop
     finishing?). One registry counter per collective family."""
-    reg = get_registry()
-    if _dispatch_cache["reg"] is not reg:
-        _dispatch_cache["reg"] = reg
-        _dispatch_cache["counters"] = {}
-    c = _dispatch_cache["counters"].get(kind)
-    if c is None:
-        c = reg.counter(f"ici.{kind}_dispatch")
-        _dispatch_cache["counters"][kind] = c
-    c.inc()
+    _ici_counter(f"ici.{kind}_dispatch").inc()
+
+
+def _account_wire(wire_bytes: int, logical_bytes: int) -> None:
+    """Per-dispatch ICI wire accounting (always-on, host-side):
+    ``ici.wire_bytes`` is what actually crosses the wire PER DEVICE for
+    this dispatch (compressed payload bytes, from the payload tree's
+    nbytes), ``ici.logical_bytes`` the uncompressed fp32 bytes the same
+    collective would move — so the achieved compression / bus-bandwidth
+    ratio is computable straight from ``metrics_snapshot()`` and rides
+    every flight-recorder step. Scope: the HOST-dispatched collectives
+    (the flat wrappers — eager path, hybrid REDUCE/ALLGATHER, bench);
+    the fused in-jit paths call the *_local bodies inside one traced
+    step and never cross the host per collective, so their traffic is
+    not counted here (it is derivable from the chunk count × payload
+    nbytes if needed)."""
+    if wire_bytes:
+        _ici_counter("ici.wire_bytes").inc(int(wire_bytes))
+    if logical_bytes:
+        _ici_counter("ici.logical_bytes").inc(int(logical_bytes))
+
+
+_payload_nbytes_cache = {}
+
+
+def _payload_nbytes(compressor: Compressor, seg: int) -> int:
+    """Wire bytes of one compressed segment payload — the actual payload
+    tree's nbytes (abstract eval, no compute), not the codec's
+    ``compressed_bytes`` estimate."""
+    key = (compressor, seg)
+    v = _payload_nbytes_cache.get(key)
+    if v is None:
+        try:
+            tree = jax.eval_shape(
+                lambda x, k: compressor.compress(x, k),
+                jax.ShapeDtypeStruct((seg,), jnp.float32),
+                jax.ShapeDtypeStruct((2,), jnp.uint32),
+            )
+            v = sum(
+                int(functools.reduce(lambda a, b: a * b, l.shape, 1))
+                * jnp.dtype(l.dtype).itemsize
+                for l in jax.tree.leaves(tree)
+            )
+        except Exception:  # noqa: BLE001 — accounting must never fail a step
+            v = compressor.compressed_bytes(seg)
+        _payload_nbytes_cache[key] = v
+    return v
 
 
 def _segment(g: jnp.ndarray, n_dev: int):
@@ -81,6 +170,9 @@ def allreduce_flat(
     """Uncompressed all-reduce of (N, L) → (L,): one fused psum."""
     axis = axis or mesh.axis_names[0]
     _count_dispatch("allreduce")
+    n = mesh.shape[axis]
+    raw = 2 * (n - 1) * (-(-x.shape[1] // n)) * jnp.dtype(x.dtype).itemsize
+    _account_wire(raw, raw)
     return _allreduce_impl(x, mesh=mesh, axis=axis, average=average)
 
 
@@ -121,6 +213,9 @@ def reduce_scatter_flat(
     """
     axis = axis or mesh.axis_names[0]
     _count_dispatch("reduce_scatter")
+    n = mesh.shape[axis]
+    raw = (n - 1) * (-(-x.shape[1] // n)) * jnp.dtype(x.dtype).itemsize
+    _account_wire(raw, raw)
     return _reduce_scatter_impl(x, mesh=mesh, axis=axis)
 
 
@@ -149,6 +244,9 @@ def all_gather_flat(
     Exact: gathering moves bits, never sums."""
     axis = axis or mesh.axis_names[0]
     _count_dispatch("all_gather")
+    n = mesh.shape[axis]
+    raw = (n - 1) * (x.shape[0] // max(1, n)) * jnp.dtype(x.dtype).itemsize
+    _account_wire(raw, raw)
     out = _all_gather_impl(x, mesh=mesh, axis=axis)
     if length is not None and length != out.shape[0]:
         out = jax.lax.slice_in_dim(out, 0, length, axis=0)
@@ -176,22 +274,81 @@ def broadcast_flat(
     """
     axis = axis or mesh.axis_names[0]
     _count_dispatch("broadcast")
+    n = mesh.shape[axis]
+    # accounted as the psum it is implemented with
+    raw = 2 * (n - 1) * (-(-x.shape[1] // n)) * jnp.dtype(x.dtype).itemsize
+    _account_wire(raw, raw)
     return _broadcast_impl(x, mesh=mesh, axis=axis, root=root)
 
 
-def _compress_push(g, rng, compressor, axis, n):
+# --- compressed-collective building blocks -----------------------------------
+def _exchange(payload, axis: str, n: int, tier: str):
+    """Deliver row j of each device's payload tree to owner j, stacked in
+    WORKER order — ``all_to_all`` semantics. ``staged`` moves the whole
+    tree in one collective; ``ring`` rotates one segment-payload per link
+    per hop (n−1 mutually independent hops, DMA overlapping codec work).
+    Both move bits only, so the stacks are bitwise identical — the
+    transport is swappable under the shared aggregation arithmetic."""
+    if tier == "ring":
+        return jax.tree.map(lambda a: ring_collect(a, axis, n), payload)
+    return jax.tree.map(
+        lambda a: jax.lax.all_to_all(a, axis, 0, 0, tiled=True), payload
+    )
+
+
+def _gather(out_payload, axis: str, n: int, tier: str):
+    """Owner-ordered stack of every owner's result payload — the "pull"
+    direction (compressed when two_way/presummable). Exact either way:
+    a gather moves bits, never sums."""
+    if tier == "ring":
+        return jax.tree.map(lambda a: ring_allgather(a, axis, n),
+                            out_payload)
+    return jax.tree.map(
+        lambda a: jax.lax.all_gather(a, axis, axis=0, tiled=False),
+        out_payload,
+    )
+
+
+# Beyond this many workers the unrolled fold's program size stops being
+# worth it and both tiers fall back to one reduce op — at which point the
+# ring-vs-staged bitwise pin is no longer structural (XLA may lower the
+# two programs' reduces differently); the tests pin n = 8.
+_FOLD_MAX_N = 64
+
+
+def _payload_sum(recv, n: int):
+    """Positional payload sum over the worker-ordered stack, as an
+    UNROLLED left fold in worker order (w = 0, 1, …, n−1).
+
+    Shared by the staged and ring paths ON PURPOSE: ``a.sum(axis=0)``
+    lowers to an order XLA picks per program (measured: left fold after
+    an all_to_all, a different association after the ring's assembled
+    stack — a 1-ulp drift), while an explicit fold pins the association
+    identically in both programs, making the deterministic-codec
+    bit-exact pin structural rather than a lowering accident."""
+    if n > _FOLD_MAX_N:
+        return jax.tree.map(lambda a: a.sum(axis=0), recv)
+
+    def fold(a):
+        acc = a[0]
+        for w in range(1, n):
+            acc = acc + a[w]
+        return acc
+
+    return jax.tree.map(fold, recv)
+
+
+def _compress_push(g, rng, compressor, axis, n, tier="staged"):
     """Shared COMPRESS → "PUSH" half: segment, per-segment compress,
-    all_to_all so owner j receives every peer's segment j. Returns
-    ``(payload, seg_keys, recv, seg)``. Per-segment rng keys must agree
-    across devices (randomk index agreement, reference's
-    synchronized-seed requirement): derive from the replicated base key +
-    segment id only."""
+    exchange so owner j receives every peer's segment j (stacked in
+    worker order). Returns ``(payload, seg_keys, recv, seg)``.
+    Per-segment rng keys must agree across devices (randomk index
+    agreement, reference's synchronized-seed requirement): derive from
+    the replicated base key + segment id only."""
     segs, seg = _segment(g, n)      # (n, seg): row j goes to owner j
     seg_keys = jax.vmap(lambda j: jax.random.fold_in(rng, j))(jnp.arange(n))
     payload = jax.vmap(compressor.compress)(segs, seg_keys)
-    recv = jax.tree.map(
-        lambda a: jax.lax.all_to_all(a, axis, 0, 0, tiled=True), payload
-    )
+    recv = _exchange(payload, axis, n, tier)
     return payload, seg_keys, recv, seg
 
 
@@ -214,6 +371,7 @@ def compressed_allreduce_local(
     two_way: bool = True,
     ef_residual: Optional[jnp.ndarray] = None,
     return_residual: bool = False,
+    tier: Optional[str] = None,
 ):
     """Per-device body of the compressed all-reduce.
 
@@ -231,7 +389,13 @@ def compressed_allreduce_local(
     EF add out of the per-chunk bodies so the chunk views stay pure
     reshapes): the input is taken as-is and the residual is
     ``g − D(C(g))``.
+
+    ``tier`` selects the payload transport (``staged``/``ring``; None →
+    ``BYTEPS_ICI_TIER``, resolved at trace time) — see the module
+    docstring. Deterministic-codec results are bit-identical across
+    tiers by construction.
     """
+    tier = _resolve_tier(tier)
     L = g.shape[0]
     g = g.astype(jnp.float32)
     if n == 1 and not compressor.stochastic:
@@ -254,13 +418,24 @@ def compressed_allreduce_local(
         return dense, resid
     if ef_residual is not None:
         g = g + ef_residual
-    payload, seg_keys, recv, seg = _compress_push(g, rng, compressor, axis, n)
+    payload, seg_keys, recv, seg = _compress_push(
+        g, rng, compressor, axis, n, tier)
     my_id = jax.lax.axis_index(axis)
     my_key = jax.random.fold_in(rng, my_id)
 
     if compressor.presummable:
-        # positional-sum fast path: sum payloads, one decompress at end
-        out_payload = jax.tree.map(lambda a: a.sum(axis=0), recv)
+        if tier == "ring" and compressor.stochastic:
+            # genuinely fused per-hop form: accumulate the running
+            # partial in payload space at every hop (payload sum == the
+            # recompressed partial for presummable codecs) — chain-order
+            # adds, so stochastic codecs only (statistical pin). recv is
+            # unused; XLA dead-codes the collect exchange away.
+            out_payload = jax.tree.map(
+                lambda a: ring_presum(a, axis, n), payload)
+        else:
+            # positional-sum fast path: sum payloads, one decompress at
+            # the end — the shared worker-order fold (see _payload_sum)
+            out_payload = _payload_sum(recv, n)
     else:
         # server path: decompress each peer's segment, fp32 sum — fused
         # (Pallas on TPU) via the compressor's decompress_sum hot op
@@ -274,9 +449,7 @@ def compressed_allreduce_local(
             out_payload = {"dense": s}
 
     # "PULL": broadcast owner results to everyone.
-    gathered = jax.tree.map(
-        lambda a: jax.lax.all_gather(a, axis, axis=0, tiled=False), out_payload
-    )
+    gathered = _gather(out_payload, axis, n, tier)
     if compressor.presummable or two_way:
         all_keys = jax.vmap(lambda j: jax.random.fold_in(rng, j))(jnp.arange(n))
         out_segs = jax.vmap(
@@ -299,6 +472,7 @@ def compressed_reduce_scatter_local(
     n: int,
     average: bool = True,
     ef_residual: Optional[jnp.ndarray] = None,
+    tier: Optional[str] = None,
 ):
     """First half of the compressed all-reduce: COMPRESS → "PUSH" → owner
     fp32 sum — WITHOUT the all_gather "PULL" back.
@@ -310,17 +484,39 @@ def compressed_reduce_scatter_local(
     carries update bytes instead of gradient bytes). With ``ef_residual``
     returns ``(segment, new_residual)`` — error feedback is identical to
     :func:`compressed_allreduce_local`'s (compress(g + residual), residual
-    from the own-payload decompress).
+    from the own-payload decompress). ``tier`` as in
+    :func:`compressed_allreduce_local`.
     """
+    tier = _resolve_tier(tier)
     L = g.shape[0]
     g = g.astype(jnp.float32)
+    if n == 1 and not compressor.stochastic:
+        # single-worker fast path, mirroring compressed_allreduce_local's
+        # (VERDICT r8: the asymmetry): the owner "sum" over one worker is
+        # D(C(g[+e])) — one fused roundtrip, EF add included. The
+        # segment IS the whole vector (seg = ceil(L/1) = L, no padding)
+        # and the general body's reduce-scatter never recompresses, so
+        # idempotence isn't even needed — the collapse is exact for any
+        # deterministic codec; pinned per codec in
+        # tests/test_ring_ici.py::test_rs_n1_fast_path_*. Stochastic
+        # codecs keep the general body (size-1-axis collectives are
+        # identities), same gate as the allreduce fast path.
+        dense, resid = compressor.roundtrip(
+            g, jax.random.fold_in(rng, 0), e=ef_residual)
+        if ef_residual is None:
+            return dense
+        return dense, resid
     if ef_residual is not None:
         g = g + ef_residual
-    payload, seg_keys, recv, seg = _compress_push(g, rng, compressor, axis, n)
+    payload, seg_keys, recv, seg = _compress_push(
+        g, rng, compressor, axis, n, tier)
     my_id = jax.lax.axis_index(axis)
     my_key = jax.random.fold_in(rng, my_id)
     if compressor.presummable:
-        summed = jax.tree.map(lambda a: a.sum(axis=0), recv)
+        if tier == "ring" and compressor.stochastic:
+            summed = jax.tree.map(lambda a: ring_presum(a, axis, n), payload)
+        else:
+            summed = _payload_sum(recv, n)
         s = compressor.decompress(summed, seg, jnp.float32, my_key)
     else:
         my_keys = jnp.broadcast_to(my_key, (n,) + my_key.shape) \
@@ -334,7 +530,8 @@ def compressed_reduce_scatter_local(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("compressor", "axis", "average", "mesh", "two_way"),
+    static_argnames=("compressor", "axis", "average", "mesh", "two_way",
+                     "tier"),
 )
 def _compressed_allreduce_impl(
     x,
@@ -345,12 +542,14 @@ def _compressed_allreduce_impl(
     axis: str,
     average: bool,
     two_way: bool,
+    tier: str,
 ):
     n = mesh.shape[axis]
 
     def inner(blk, rng):
         return compressed_allreduce_local(
-            blk[0], rng, compressor, axis, n, average=average, two_way=two_way
+            blk[0], rng, compressor, axis, n, average=average,
+            two_way=two_way, tier=tier,
         )
 
     # check_vma=False: the output IS replicated (it ends in an all_gather of
@@ -365,7 +564,8 @@ def _compressed_allreduce_impl(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("compressor", "axis", "average", "mesh", "two_way"),
+    static_argnames=("compressor", "axis", "average", "mesh", "two_way",
+                     "tier"),
 )
 def _compressed_allreduce_ef_impl(
     x,
@@ -377,6 +577,7 @@ def _compressed_allreduce_ef_impl(
     axis: str,
     average: bool,
     two_way: bool,
+    tier: str,
 ):
     n = mesh.shape[axis]
 
@@ -384,6 +585,7 @@ def _compressed_allreduce_ef_impl(
         out, new_e = compressed_allreduce_local(
             blk[0], rng, compressor, axis, n,
             average=average, two_way=two_way, ef_residual=eblk[0],
+            tier=tier,
         )
         return out, new_e[None]
 
@@ -391,6 +593,35 @@ def _compressed_allreduce_ef_impl(
         inner, mesh=mesh, in_specs=(P(axis), P(axis), P()),
         out_specs=(P(), P(axis)), check_vma=False,
     )(x, ef, base_rng)
+
+
+def _require_rng(compressor: Compressor, rng):
+    if rng is None:
+        if compressor.stochastic:
+            raise ValueError(
+                f"{compressor.name} requires an rng key advancing every step"
+            )
+        rng = jax.random.PRNGKey(0)
+    return rng
+
+
+def _account_compressed(compressor: Compressor, L: int, n: int,
+                        two_way: bool, pull: bool) -> None:
+    """Per-device wire bytes of one compressed collective dispatch: the
+    push direction always carries (n−1) compressed segment payloads; the
+    pull direction (allreduce only) carries compressed owner results
+    when two_way/presummable, raw fp32 segments otherwise."""
+    if n <= 1:
+        return
+    seg = -(-L // n)
+    pb = _payload_nbytes(compressor, seg)
+    wire = (n - 1) * pb
+    logical = (n - 1) * seg * 4
+    if pull:
+        wire += (n - 1) * (
+            pb if (compressor.presummable or two_way) else seg * 4)
+        logical *= 2
+    _account_wire(wire, logical)
 
 
 def compressed_allreduce_flat(
@@ -402,6 +633,7 @@ def compressed_allreduce_flat(
     rng: Optional[jnp.ndarray] = None,
     two_way: bool = True,
     ef_residual: Optional[jnp.ndarray] = None,
+    tier: Optional[str] = None,
 ):
     """Compressed all-reduce of (N, L) → (L,).
 
@@ -413,21 +645,79 @@ def compressed_allreduce_flat(
 
     With ``ef_residual`` (an (N, L) per-device residual), error feedback is
     applied and ``(out, new_residual)`` is returned.
+
+    ``tier`` picks the wire transport (None → ``BYTEPS_ICI_TIER``):
+    ``staged`` all_to_all/all_gather vs the ``ring`` hop pipeline —
+    bit-identical results for deterministic codecs.
     """
     axis = axis or mesh.axis_names[0]
+    tier = _resolve_tier(tier)
     _count_dispatch("compressed_allreduce")
-    if rng is None:
-        if compressor.stochastic:
-            raise ValueError(
-                f"{compressor.name} requires an rng key advancing every step"
-            )
-        rng = jax.random.PRNGKey(0)
+    _account_compressed(compressor, x.shape[1], mesh.shape[axis],
+                        two_way, pull=True)
+    rng = _require_rng(compressor, rng)
     if ef_residual is not None:
         return _compressed_allreduce_ef_impl(
             x, ef_residual, rng, compressor=compressor, mesh=mesh, axis=axis,
-            average=average, two_way=two_way,
+            average=average, two_way=two_way, tier=tier,
         )
     return _compressed_allreduce_impl(
         x, rng, compressor=compressor, mesh=mesh, axis=axis,
-        average=average, two_way=two_way,
+        average=average, two_way=two_way, tier=tier,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("compressor", "axis", "average", "mesh", "tier"),
+)
+def _compressed_reduce_scatter_impl(
+    x,
+    base_rng,
+    *,
+    compressor: Compressor,
+    mesh: Mesh,
+    axis: str,
+    average: bool,
+    tier: str,
+):
+    n = mesh.shape[axis]
+
+    def inner(blk, rng):
+        return compressed_reduce_scatter_local(
+            blk[0], rng, compressor, axis, n, average=average, tier=tier,
+        )
+
+    return jax.shard_map(
+        inner, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(axis),
+        check_vma=False,
+    )(x, base_rng)
+
+
+def compressed_reduce_scatter_flat(
+    x: jnp.ndarray,
+    compressor: Compressor,
+    mesh: Mesh,
+    axis: Optional[str] = None,
+    average: bool = False,
+    rng: Optional[jnp.ndarray] = None,
+    tier: Optional[str] = None,
+):
+    """Compressed reduce-scatter of (N, L): device j ends up holding the
+    codec-aggregated segment j — a flat ``(n·ceil(L/n),)`` array sharded
+    over ``axis``, layout-compatible with :func:`reduce_scatter_flat`
+    (same padding, same trim contract downstream). The pod sum is the
+    codec approximation Σ_w D(C(g_w)) in fp32 — the ``ici-compressed``
+    wire: each link carries (n−1)/n · compressed bytes instead of
+    (n−1)/n · L · 4. Default ``average=False`` (a REDUCE is a sum).
+    ``tier`` as in :func:`compressed_allreduce_flat`."""
+    axis = axis or mesh.axis_names[0]
+    tier = _resolve_tier(tier)
+    _count_dispatch("compressed_reduce_scatter")
+    _account_compressed(compressor, x.shape[1], mesh.shape[axis],
+                        two_way=False, pull=False)
+    rng = _require_rng(compressor, rng)
+    return _compressed_reduce_scatter_impl(
+        x, rng, compressor=compressor, mesh=mesh, axis=axis,
+        average=average, tier=tier,
     )

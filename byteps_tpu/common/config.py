@@ -240,6 +240,15 @@ class Config:
     dp_axis: str = "dp"
     # Reduce dtype on the aggregation tier. The reference PS sums in fp32.
     reduce_dtype: str = "float32"
+    # Wire transport of the compressed ICI collectives (comm/ici.py):
+    # "staged" = one monolithic all_to_all + all_gather (codec and wire
+    # serialize); "ring" = the ici-compressed tier — payloads ride n-1
+    # ring hops (Pallas make_async_remote_copy kernels on TPU,
+    # lax.ppermute twins elsewhere) with per-hop DMA/codec overlap,
+    # pinned bit-exact vs staged for deterministic codecs. Under "ring"
+    # the hybrid pipeline's REDUCE stage also rides the compressed wire
+    # (compressed bytes on ICI) for qualifying partitions.
+    ici_tier: str = "staged"
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -307,6 +316,7 @@ class Config:
             auto_tune=_env_bool("BYTEPS_AUTO_TUNE"),
             dp_axis=_env_str("BYTEPS_DP_AXIS", "dp"),
             reduce_dtype=_env_str("BYTEPS_REDUCE_DTYPE", "float32"),
+            ici_tier=_env_str("BYTEPS_ICI_TIER", "staged"),
         )
         return c
 

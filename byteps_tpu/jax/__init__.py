@@ -62,6 +62,7 @@ from byteps_tpu.comm.ici import (
     allreduce_flat,
     broadcast_flat,
     compressed_allreduce_flat,
+    compressed_reduce_scatter_flat,
     reduce_scatter_flat,
 )
 from byteps_tpu.comm.mesh import device_mesh
@@ -456,15 +457,38 @@ def _reduce_stage(task: PartitionTask):
     holding its segment of the pod sum — half the ICI bytes of a full
     allreduce (the ALLGATHER tail pays the other half AFTER the DCN round
     trip, reassembling the *global* sums), and on a multi-host pod each
-    controller then only d2h's its own segments."""
+    controller then only d2h's its own segments.
+
+    Under ``BYTEPS_ICI_TIER=ring`` (the ici-compressed wire tier) a
+    compressed job's qualifying partitions ride the compressed ring
+    collective instead of the raw psum: compressed bytes on the ICI
+    links, pod sums approximated by the codec (Σ D(C(g)) in fp32 —
+    stateless at this hop; the DCN tier's EF keeps recirculating its own
+    wire error as before). The layout contract is unchanged — same
+    padded ``(n·ceil(L/n),)`` scattered form (or replicated ``(L,)``
+    unsharded), so COPYD2H/DECOMPRESS/ALLGATHER need no changes."""
     x = task.context["x2d"]
     p = task.partition
     chunk = jax.lax.slice_in_dim(x, p.offset, p.offset + p.length, axis=1)
+    cfg = _state.cfg
+    spec = task.context["spec"]
+    ici_compressed = (
+        cfg.ici_tier == "ring" and spec.enabled and pod_size() > 1
+        and p.length * 4 >= cfg.min_compress_bytes
+    )
     with _state.ici_lock:
-        if _state.cfg.hybrid_sharded:
-            return reduce_scatter_flat(chunk, _state.mesh,
-                                       _state.cfg.dp_axis)
-        return allreduce_flat(chunk, _state.mesh, _state.cfg.dp_axis,
+        if ici_compressed:
+            rng = jax.random.fold_in(task.context["rng"], p.part_idx)
+            if cfg.hybrid_sharded:
+                return compressed_reduce_scatter_flat(
+                    chunk, spec.compressor, _state.mesh, cfg.dp_axis,
+                    average=False, rng=rng, tier="ring")
+            return compressed_allreduce_flat(
+                chunk, spec.compressor, _state.mesh, cfg.dp_axis,
+                average=False, rng=rng, two_way=spec.two_way, tier="ring")
+        if cfg.hybrid_sharded:
+            return reduce_scatter_flat(chunk, _state.mesh, cfg.dp_axis)
+        return allreduce_flat(chunk, _state.mesh, cfg.dp_axis,
                               average=False)
 
 

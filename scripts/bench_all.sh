@@ -50,6 +50,7 @@ run_trend_leg --mode throttled           # compression race on emulated slow DCN
 run --mode tune                          # joint (partition, credit) auto-tune
 run_trend_leg --mode chaos               # goodput vs fault rate (+BENCH_chaos.json)
 run_trend_leg --mode hybrid              # sharded-wire hierarchical race (+BENCH_hybrid.json)
+run_trend_leg --mode ici                 # compressed ICI tier race: staged vs ring vs native psum (+BENCH_ici.json)
 
 # Perf-trend regression gate LAST: the legs above rewrote
 # BENCH_{throttled,chaos,hybrid,serve}.json in place; compare the fresh
