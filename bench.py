@@ -2073,6 +2073,125 @@ def bench_chaos(payload_mb: int = 8, rounds: int = 4, reps: int = 3) -> dict:
                 r["goodput_vs_clean"] = round(
                     clean / r["sec_per_round_med"], 3)
 
+    # ---- bounded-staleness slow-worker leg (ROADMAP item 3) --------------
+    # One deterministic straggler (worker1:slow — every wire attempt of
+    # worker 1 pays slow_ms) at {0, 2x, 5x} the measured median step,
+    # x K in {0, 1, 4} x {raw, onebit}. K=0 reproduces today's cliff:
+    # every round closes at the straggler's pace, so the fast worker's
+    # goodput IS the straggler's. K>=1 (BYTEPS_STALENESS) lets the fast
+    # worker pipeline K+1 rounds (scheduler window) while the server
+    # serves <=K-stale aggregates and force-closes straggler-held rounds
+    # over their contributors (quorum-scaled, unbiased) — goodput tracks
+    # the MEDIAN worker. Headline: best-K>=1 goodput / K=0 goodput under
+    # the 5x straggler, worst codec — floor-gated in BENCH_trend.json.
+    from collections import deque
+
+    st_rounds = max(8, 2 * rounds)
+    st_flat1 = np.random.default_rng(2).standard_normal(nelems).astype(
+        np.float32)
+    results["staleness"] = {}
+    for cname, mk in codecs:
+        legs = {}
+        base_round_s = None
+        for factor in (0, 2, 5):
+            for K in (0, 1, 4):
+                p0 = base_port + run_id * 2
+                run_id += 1
+                slow_ms = 0
+                if factor:
+                    # the straggler pays slow_ms on each of its
+                    # 2*n_parts wire ops per round — sized so its step
+                    # lands at ~(1+factor)x the clean median
+                    slow_ms = max(1, int(factor * base_round_s * 1e3
+                                         / (2 * n_parts)))
+                spec = f"worker1:slow@ms={slow_ms}" if slow_ms else ""
+                cfg = _dc.replace(
+                    base_cfg, num_worker=2, num_server=1,
+                    staleness=K, fault_spec=spec, fault_seed=0,
+                    retry_limit=8, retry_backoff_ms=10,
+                )
+                config_mod.set_config(cfg)
+                start_server(port=p0, num_workers=2, engine_threads=4,
+                             async_mode=False, staleness=K)
+                servers_ = [("127.0.0.1", p0)]
+                errs = []
+                el = {}
+                gate = threading.Barrier(2, timeout=300)
+
+                def fast_body(codec_mk=mk, win=K, srv=servers_,
+                              g=gate, e=errs, out=el):
+                    # the MEDIAN worker: keeps K+1 rounds in flight (the
+                    # staleness window) and is the goodput we time
+                    core = DcnCore(servers=srv, worker_id=0)
+                    try:
+                        g.wait()
+                        pend = deque()
+                        t0 = time.perf_counter()
+                        for _ in range(st_rounds):
+                            pend.append(core.push_pull_async(
+                                flat, name="stale", codec=codec_mk()))
+                            while len(pend) > win:
+                                DcnCore.assemble(pend.popleft(),
+                                                 timeout=600.0)
+                        while pend:
+                            DcnCore.assemble(pend.popleft(), timeout=600.0)
+                        out["fast"] = time.perf_counter() - t0
+                    except BaseException as exc:  # noqa: BLE001
+                        e.append(exc)
+                    finally:
+                        core.shutdown()
+
+                def slow_body(codec_mk=mk, srv=servers_, g=gate, e=errs):
+                    core = DcnCore(servers=srv, worker_id=1)
+                    try:
+                        g.wait()
+                        for _ in range(st_rounds):
+                            DcnCore.assemble(core.push_pull_async(
+                                st_flat1, name="stale", codec=codec_mk()),
+                                timeout=600.0)
+                    except BaseException as exc:  # noqa: BLE001
+                        e.append(exc)
+                    finally:
+                        core.shutdown()
+
+                ts = [threading.Thread(target=fast_body),
+                      threading.Thread(target=slow_body)]
+                try:
+                    for t in ts:
+                        t.start()
+                    for t in ts:
+                        t.join(timeout=600)
+                        assert not t.is_alive(), (
+                            f"staleness leg f{factor}_k{K} wedged")
+                    if errs:
+                        raise errs[0]
+                finally:
+                    stop_server()
+                    config_mod.reset_config()
+                sec = el["fast"] / st_rounds
+                if factor == 0 and K == 0:
+                    base_round_s = sec
+                legs[f"f{factor}_k{K}"] = {
+                    "sec_per_round": round(sec, 4),
+                    "slow_ms": slow_ms,
+                    "rounds": st_rounds,
+                }
+                _log(f"chaos staleness {cname:>6} straggler={factor}x "
+                     f"K={K}: {sec * 1e3:7.1f} ms/round (fast worker)")
+        for factor in (2, 5):
+            k0 = legs[f"f{factor}_k0"]["sec_per_round"]
+            for K in (1, 4):
+                legs[f"f{factor}_k{K}"]["goodput_vs_k0"] = round(
+                    k0 / legs[f"f{factor}_k{K}"]["sec_per_round"], 3)
+        results["staleness"][cname] = legs
+
+    # headline: under the 5x straggler, how much of the cliff does
+    # bounded staleness win back (worst codec, best K>=1)
+    straggler_ratio = min(
+        max(results["staleness"][c][f"f5_k{K}"]["goodput_vs_k0"]
+            for K in (1, 4))
+        for c, _ in codecs)
+
     worst = min(
         [results[f][c]["goodput_vs_clean"]
          for f, _ in configs for c, _ in codecs]
@@ -2083,10 +2202,17 @@ def bench_chaos(payload_mb: int = 8, rounds: int = 4, reps: int = 3) -> dict:
                    "clean / 5% push-ack loss / one server down on a "
                    "1-worker+2-server matrix, plus a worker-death leg — "
                    "kill 1 of 2 workers mid-run under the membership "
-                   "lease, survivor vs clean 2-worker baseline)"),
+                   "lease, survivor vs clean 2-worker baseline — and the "
+                   "bounded-staleness slow-worker leg: worker1:slow "
+                   "straggler at {0,2,5}x the median step x "
+                   "BYTEPS_STALENESS K in {0,1,4})"),
         "value": worst,
         "unit": "x of clean goodput (worst chaos config)",
         "vs_baseline": worst,
+        # bounded staleness vs the straggler cliff: fast-worker goodput
+        # at best K>=1 over K=0 under the 5x straggler (worst codec);
+        # acceptance bar >= 2x, floor-gated via BENCH_trend.json
+        "straggler_ratio": round(straggler_ratio, 3),
         "payload_mb": payload_mb,
         "rounds_per_rep": rounds,
         "reps": reps,
@@ -2237,6 +2363,7 @@ _TREND_SPECS = (
     ("BENCH_throttled.json", "results.200.topk.speedup_vs_raw"),
     ("BENCH_hybrid.json", "value"),
     ("BENCH_chaos.json", "value"),
+    ("BENCH_chaos.json", "straggler_ratio"),
     ("BENCH_serve.json", "value"),
     ("BENCH_ici.json", "ring_vs_staged_best"),
     ("BENCH_ici.json", "ring_bus_bw_best"),

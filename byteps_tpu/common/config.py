@@ -175,6 +175,17 @@ class Config:
     # StallError carrying per-stage/per-server counters instead of
     # blocking forever. 0 = only the caller's own timeout applies.
     handle_deadline_ms: int = 0
+    # Bounded-staleness PS rounds (BYTEPS_STALENESS=K, docs/robustness.md
+    # §bounded staleness): K > 0 lets the summation servers answer a pull
+    # for round v from the newest CLOSED round >= v-K — and force-close a
+    # straggler-held round over its contributors (quorum-scaled, exactly
+    # like an eviction-shrunk round) — so one slow worker no longer sets
+    # the global step time; the worker pipeline keeps K rounds of pushes
+    # in flight (per-key scheduler window) while PULL consumes whatever
+    # round the server serves, and responses stamp the SERVED round.
+    # K=0 = today's synchronous tier, bit-identical; BYTEPS_ENABLE_ASYNC
+    # is the K=inf limit and wins when both are set.
+    staleness: int = 0
 
     # --- telemetry plane (docs/observability.md) ---------------------------
     # Always-on metrics registry (common/metrics.py): counters, gauges,
@@ -294,6 +305,7 @@ class Config:
             degraded_ok=_env_bool("BYTEPS_DEGRADED_OK", True),
             worker_lease_ms=_env_int("BYTEPS_WORKER_LEASE_MS", 0),
             handle_deadline_ms=_env_int("BYTEPS_HANDLE_DEADLINE_MS", 0),
+            staleness=max(0, _env_int("BYTEPS_STALENESS", 0)),
             metrics_on=_env_bool("BYTEPS_METRICS_ON", True),
             flight_recorder_steps=_env_int("BYTEPS_FLIGHT_RECORDER_STEPS",
                                            64),

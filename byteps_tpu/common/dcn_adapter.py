@@ -37,6 +37,7 @@ from byteps_tpu.compression.wire import (
     Fp16Wire,
     WireCodec,
     WirePlan,
+    pull_seed,
     wire_seed,
 )
 from byteps_tpu.server import (
@@ -275,6 +276,12 @@ class DcnCore:
             credit=cfg.scheduling_credit,
             tracer=get_tracer(),
             credit_scope="owner" if pod_controllers > 1 else "global",
+            # bounded staleness (BYTEPS_STALENESS=K): a pipelining caller
+            # may keep K+1 rounds of one key in flight — PUSH of round
+            # r+K no longer gates on round r's PULL, the server serves
+            # whatever closed round is within K, and the window bounds
+            # the run-ahead (the credit gate generalized to rounds)
+            rounds_window=cfg.staleness if cfg.staleness > 0 else None,
         )
         # keys each OWNER has successfully init'ed on the servers: a new
         # owner (post-failover) must re-run the idempotent init before
@@ -437,6 +444,11 @@ class DcnCore:
                 p.key, capacity, task.payload, codec_id)
         except BaseException as e:  # noqa: BLE001 - owner-death classify
             self._owner_giveup(task, owner, e)
+        # the round the server actually SERVED (== requested on the
+        # strict-sync tier; up to BYTEPS_STALENESS behind under bounded
+        # staleness) — DECOMPRESS derives its seed from it, so a stale
+        # aggregate decodes with the round it was BUILT from
+        task.served_round = self.workers[owner].last_pull_round()
         # record the round's OWN live count per partition (from the
         # response's epoch stamp) so averaging consumers (torch/tf
         # synchronize) divide each slice by the membership its round
@@ -458,7 +470,13 @@ class DcnCore:
         p = task.partition
         plan: Optional[WirePlan] = task.context["plans"][p.part_idx]
         buf = np.ascontiguousarray(task.payload)
-        seed = wire_seed(task.name, task.context["version"], p.part_idx)
+        # the served round may trail the requested one under bounded
+        # staleness — pull_seed owns the served-round → seed contract
+        seed = pull_seed(
+            task.name, task.context["version"], p.part_idx,
+            served_round=getattr(task, "served_round", None),
+            staleness=self.cfg.staleness,
+            degraded=getattr(task, "degraded", False))
         if plan is None:
             return buf.view(np.float32)
         if getattr(task, "degraded", False):
@@ -517,7 +535,7 @@ class DcnCore:
                 p, owner=self._owner_of(p.key),
                 **({"priority": priority} if priority is not None else {}))
             tasks.append(PartitionTask(partition=p, name=name, handle=handle,
-                                       context=shared))
+                                       context=shared, round=version))
         self.scheduler.enqueue(tasks)
         return handle
 

@@ -13,6 +13,7 @@ Spec grammar (semicolon-separated rules)::
     BYTEPS_FAULT_SPEC = rule (';' rule)*
     rule   = scope ':' kind ['@' cond (',' cond)*]
     scope  = 'push' | 'pull' | 'init' | 'all' | 'server<N>' | 'worker'
+           | 'worker<N>'
              # push/pull/all match DATA-PLANE ops only ('all' = push+pull);
              # 'init' matches key-init attempts only (kill = the init
              # never reached the server; timeout = applied, ack lost);
@@ -23,7 +24,12 @@ Spec grammar (semicolon-separated rules)::
              # WorkerKilledError, heartbeats stop — the server lease
              # evicts it); hang = the worker wedges for ms= milliseconds
              # (ops block then time out, heartbeats stop) and then may
-             # rejoin
+             # rejoin; worker<N> is the worker scope RESTRICTED to the
+             # plan whose worker_id is N — the same spec string is handed
+             # to every worker, so 'worker1:slow@ms=80' makes exactly
+             # worker 1 a deterministic straggler (every one of its wire
+             # attempts pays 80 ms) while its peers run clean — the
+             # bounded-staleness bench's slow-worker leg
     kind   = 'timeout' | 'kill' | 'slow' | 'corrupt' | 'down' | 'hang'
     cond   = 'p=' FLOAT          # per-op Bernoulli (seeded RNG)
            | 'op=' A ['..' [B]]  # plan-op window, inclusive; open end ok
@@ -115,6 +121,10 @@ class FaultRule:
     window: Optional[Tuple[int, Optional[int]]] = None  # [a, b] op window
     latency_ms: int = 50       # for kind == 'slow' / 'hang'
     server: Optional[int] = None  # parsed from 'server<N>' scopes
+    # parsed from 'worker<N>' scopes: the rule only fires on the plan
+    # whose worker_id is N (the shared spec string selects ONE worker);
+    # None = the bare 'worker' scope, every plan's own worker
+    worker: Optional[int] = None
 
     def to_spec(self) -> str:
         """Render back to the BYTEPS_FAULT_SPEC grammar (round-trip:
@@ -128,10 +138,13 @@ class FaultRule:
                          f"op={a}.." + ("" if b is None else str(b)))
         if self.latency_ms != (300000 if self.kind == "hang" else 50):
             conds.append(f"ms={self.latency_ms}")
-        head = f"{self.scope}:{self.kind}"
+        head = (f"worker{self.worker}:{self.kind}"
+                if self.scope == "worker" and self.worker is not None
+                else f"{self.scope}:{self.kind}")
         return head + ("@" + ",".join(conds) if conds else "")
 
-    def matches(self, op: str, sidx: int, step: int, rng) -> bool:
+    def matches(self, op: str, sidx: int, step: int, rng,
+                worker_id: Optional[int] = None) -> bool:
         if self.server is not None:
             # server scopes hit EVERY op against that server — data plane,
             # init, and the health monitor's pings (that is what lets a
@@ -139,9 +152,12 @@ class FaultRule:
             if sidx != self.server:
                 return False
         elif self.scope == "worker":
-            # worker scopes simulate THIS process's death/wedge, so they
-            # match every wire attempt regardless of target server or op
-            pass
+            # worker scopes simulate THIS process's death/wedge/slowness,
+            # so they match every wire attempt regardless of target
+            # server or op; a worker<N> scope additionally requires the
+            # plan to BE worker N (per-worker straggler targeting)
+            if self.worker is not None and worker_id != self.worker:
+                return False
         elif self.scope == "init":
             if op != "init":
                 return False
@@ -198,11 +214,8 @@ def parse_fault_spec(spec: str) -> List[FaultRule]:
                 raise ValueError(
                     f"unknown fault kind {kind!r} (expected one of "
                     f"{'|'.join(KINDS)})")
-            if kind == "hang" and scope != "worker":
-                raise ValueError(
-                    "'hang' simulates THIS worker wedging and only takes "
-                    "the 'worker' scope (worker:hang@...)")
             server = None
+            worker = None
             if scope.startswith("server") and scope not in SCOPES:
                 idx = scope[len("server"):]
                 if not idx.isdigit():
@@ -212,10 +225,22 @@ def parse_fault_spec(spec: str) -> List[FaultRule]:
                         f"bad server index {idx!r} in scope {scope!r} "
                         "(expected server<N>, e.g. server1)")
                 server = int(idx)
+            elif scope.startswith("worker") and scope not in SCOPES:
+                idx = scope[len("worker"):]
+                if not idx.isdigit():
+                    raise ValueError(
+                        f"bad worker index {idx!r} in scope {scope!r} "
+                        "(expected worker<N>, e.g. worker1)")
+                worker = int(idx)
+                scope = "worker"
             elif scope not in SCOPES:
                 raise ValueError(
                     f"unknown fault scope {scope!r} (expected one of "
-                    f"{'|'.join(SCOPES)} or server<N>)")
+                    f"{'|'.join(SCOPES)}, server<N>, or worker<N>)")
+            if kind == "hang" and scope != "worker":
+                raise ValueError(
+                    "'hang' simulates a worker wedging and only takes "
+                    "the 'worker'/'worker<N>' scopes (worker:hang@...)")
             p = None
             window = None
             latency_ms = 300000 if kind == "hang" else 50
@@ -245,7 +270,7 @@ def parse_fault_spec(spec: str) -> List[FaultRule]:
                 window = (0, None)
             rules.append(FaultRule(scope=scope, kind=kind, p=p,
                                    window=window, latency_ms=latency_ms,
-                                   server=server))
+                                   server=server, worker=worker))
         except ValueError as e:
             raise ValueError(
                 f"bad BYTEPS_FAULT_SPEC rule {part!r}: {e}") from None
@@ -297,7 +322,8 @@ class FaultPlan:
         with self._lock:
             self._step += 1
             for r in self.rules:
-                if not r.matches(op, sidx, self._step, self._rng):
+                if not r.matches(op, sidx, self._step, self._rng,
+                                 worker_id=self.worker_id):
                     continue
                 if r.kind == "slow":
                     self.injected["slow"] += 1

@@ -348,6 +348,13 @@ class PartitionTask:
     payload: Any = None        # stage functions read/replace this
     stage_idx: int = 0
     context: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # The aggregation ROUND this task belongs to (the tensor's version
+    # counter at enqueue). Only consulted when the scheduler's
+    # ``rounds_window`` is armed (bounded-staleness pipelining): a task
+    # may not issue while its key still has a round more than ``window``
+    # behind it in flight — the per-key run-ahead bound that generalizes
+    # the credit gate from partitions to rounds. None = ungated.
+    round: Optional[int] = None
     # perf_counter of the last queue insertion (set by _StageQueue.push):
     # issue_time − queued_at is the stage DWELL the metrics registry
     # tracks per stage — queue wait is the quantity the priority
@@ -438,13 +445,24 @@ class PipelineScheduler:
         credit: int = 4,
         tracer: Optional[TraceRecorder] = None,
         credit_scope: str = "global",
+        rounds_window: Optional[int] = None,
     ) -> None:
         """``credit_scope="owner"`` gives each partition OWNER (the pod
         controller whose NIC carries it in sharded-wire hybrid mode) its
         own credit pool of ``credit``: the bound models per-NIC queue
         depth, so one owner's slow/faulted wire backs off only its own
         partitions instead of starving every sibling NIC of issue slots.
-        "global" (default) is the single shared pool (one NIC)."""
+        "global" (default) is the single shared pool (one NIC).
+
+        ``rounds_window=K`` (bounded staleness, BYTEPS_STALENESS) arms a
+        per-KEY run-ahead bound on top of the credit gate: a task whose
+        ``round`` is more than K rounds ahead of its key's oldest
+        still-in-flight round is held in its queue — so a pipelining
+        caller keeps at most K+1 rounds of one key's pushes in flight
+        while PULL consumes whatever round the server serves, and a
+        straggler-parked round bounds its own key's memory instead of
+        the process's. A round-blocked head is SKIPPED (other keys keep
+        flowing); None = ungated (the pre-staleness behavior)."""
         if credit_scope not in ("global", "owner"):
             raise ValueError(f"unknown credit_scope {credit_scope!r}")
         self.stages = list(stages)
@@ -453,12 +471,15 @@ class PipelineScheduler:
         # cost is the metric's own lock + arithmetic, never a name
         # lookup) — docs/observability.md
         _reg = get_registry()
+        sid = next(_SCHED_SEQ)
         self._m_run = [_reg.histogram(f"scheduler.stage.{s.name}.run_us")
                        for s in self.stages]
         self._m_dwell = [_reg.histogram(f"scheduler.stage.{s.name}.dwell_us")
                          for s in self.stages]
         self._m_credit_in_use = _reg.gauge(
-            f"scheduler.s{next(_SCHED_SEQ)}.credits_in_use")
+            f"scheduler.s{sid}.credits_in_use")
+        self._m_rounds_inflight = _reg.gauge(
+            f"scheduler.s{sid}.rounds_inflight")
         self._m_tasks_done = _reg.counter("scheduler.tasks_done")
         self._m_tasks_failed = _reg.counter("scheduler.tasks_failed")
         self._m_stage_retries = _reg.counter("scheduler.stage_retries")
@@ -469,6 +490,11 @@ class PipelineScheduler:
         self._credits = self._credit_total
         # owner scope: pool id -> available credits, created on first use
         self._owner_credits: Dict[int, int] = {}
+        # per-key in-flight ROUNDS (rounds_window): key -> set of rounds
+        # with at least one task between enqueue and finish
+        self._rounds_window = (None if rounds_window is None
+                               else max(0, int(rounds_window)))
+        self._key_rounds: Dict[int, set] = {}
         self._lock = threading.Lock()
         self._tracer = tracer
         self._pools: List[ThreadPoolExecutor] = [
@@ -489,7 +515,11 @@ class PipelineScheduler:
         with self._lock:
             for t in tasks:
                 self._inflight += 1
+                if self._rounds_window is not None and t.round is not None:
+                    self._key_rounds.setdefault(
+                        t.partition.key, set()).add(t.round)
                 self._queues[t.stage_idx].push(t)
+            self._update_rounds_gauge_locked()
         self._pump()
 
     def set_credit(self, credit: int) -> None:
@@ -501,6 +531,36 @@ class PipelineScheduler:
             for pool in self._owner_credits:
                 self._owner_credits[pool] += delta
         self._pump()
+
+    # -- round-window accounting (call with self._lock held) ----------------
+    def _round_ready_locked(self, task: PartitionTask) -> bool:
+        """True when ``task`` is within the per-key run-ahead window: its
+        round is at most ``rounds_window`` ahead of the oldest round its
+        key still has in flight. Unblocks monotonically — rounds only
+        LEAVE the in-flight set at finish, so a task that passes here
+        keeps passing at every later stage."""
+        if self._rounds_window is None or task.round is None:
+            return True
+        rounds = self._key_rounds.get(task.partition.key)
+        if not rounds:
+            return True
+        return task.round - min(rounds) <= self._rounds_window
+
+    def _retire_round_locked(self, task: PartitionTask) -> None:
+        if self._rounds_window is None or task.round is None:
+            return
+        rounds = self._key_rounds.get(task.partition.key)
+        if rounds is not None:
+            rounds.discard(task.round)
+            if not rounds:
+                del self._key_rounds[task.partition.key]
+        self._update_rounds_gauge_locked()
+
+    def _update_rounds_gauge_locked(self) -> None:
+        if self._rounds_window is None:
+            return
+        self._m_rounds_inflight.set(
+            max((len(r) for r in self._key_rounds.values()), default=0))
 
     # -- credit accounting (call with self._lock held) ----------------------
     def _credit_available(self, task: PartitionTask) -> bool:
@@ -573,6 +633,7 @@ class PipelineScheduler:
                     stranded.append(t)
                     self._release_credit_locked(t)
             self._inflight -= len(stranded)
+            self._key_rounds.clear()  # window state dies with the pipeline
         err = RuntimeError("PipelineScheduler is shut down")
         for t in stranded:
             t.handle._partition_failed(err, t.partition.part_idx)
@@ -598,14 +659,22 @@ class PipelineScheduler:
                     # A task acquires at most one credit for its whole
                     # lifetime (reference: credit held from PUSH until the
                     # partition completes); one already holding a credit
-                    # passes later credited stages freely.
-                    if stage.credited and self._credit_scope == "owner":
+                    # passes later credited stages freely. With the
+                    # rounds window armed, a round-blocked head is
+                    # SKIPPED (its unblockers are earlier rounds in
+                    # LATER stages, never behind it in this queue — so
+                    # skipping loses no ordering, while head-blocking
+                    # would stall sibling keys whose window is open).
+                    if self._rounds_window is not None or (
+                            stage.credited
+                            and self._credit_scope == "owner"):
                         task = q.pop_ready(
-                            lambda t: t.holds_credit
-                            or self._credit_available(t))
+                            lambda t: self._round_ready_locked(t)
+                            and (not stage.credited or t.holds_credit
+                                 or self._credit_available(t)))
                         if task is None:
                             continue
-                        if not task.holds_credit:
+                        if stage.credited and not task.holds_credit:
                             self._acquire_credit_locked(task)
                     else:
                         head = q.peek()
@@ -751,6 +820,7 @@ class PipelineScheduler:
         """Reference analog: FinishOrProceed's terminal arm."""
         with self._lock:
             self._release_credit_locked(task)
+            self._retire_round_locked(task)
             self._inflight -= 1
         if error is not None:
             self._m_tasks_failed.inc()

@@ -40,6 +40,24 @@ def wire_seed(name: str, version: int, part_idx: int, salt: int = 0) -> int:
     base = zlib.crc32(name.encode()) & 0xFFFFFFFF
     return (base * 1000003 + version * 8191 + part_idx + salt) % (2 ** 63)
 
+
+def pull_seed(name: str, context_version: int, part_idx: int,
+              served_round=None, staleness: int = 0,
+              degraded: bool = False, salt: int = 0) -> int:
+    """Seed for decoding a PULLED round result — the one place that owns
+    the served-round → version-counter contract under bounded staleness
+    (BYTEPS_STALENESS): server round N was pushed at version counter
+    N−1, so a seed-keyed pull decode (randomk's positional store) must
+    use the seed of the round the served aggregate was BUILT from, not
+    the round the caller asked for. K=0 leaves served == requested and
+    the seed bit-identical to the sync tier; a DEGRADED payload is the
+    PUSH-side encoding of the caller's own round, so it keeps the
+    caller's version."""
+    v = context_version
+    if staleness > 0 and served_round and not degraded:
+        v = served_round - 1
+    return wire_seed(name, v, part_idx, salt=salt)
+
 # Codec ids — must match server/csrc/codec.h Codec enum.
 WIRE_RAW = 0
 WIRE_FP16 = 1

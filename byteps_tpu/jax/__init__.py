@@ -238,6 +238,12 @@ def init(
             credit=cfg.scheduling_credit,
             tracer=tracer,
             credit_scope="owner" if n_ctl > 1 else "global",
+            # bounded staleness (BYTEPS_STALENESS=K): PUSH of round r+K
+            # no longer gates on round r's PULL — a pipelining caller
+            # keeps K+1 rounds of one key in flight and the window
+            # bounds the run-ahead (docs/robustness.md §bounded
+            # staleness)
+            rounds_window=cfg.staleness if cfg.staleness > 0 else None,
         )
     else:
         # Eager ICI pipeline: PUSHPULL issues the jitted chunk collective
@@ -730,6 +736,11 @@ def _dcn_pull_stage(task: PartitionTask):
         # the averaging divisor for THIS partition, even if the current
         # membership has already moved on
         task.round_live = worker.last_round_live()
+        # the round the server actually SERVED (bounded staleness may
+        # answer up to K rounds behind the requested one) — DECOMPRESS
+        # keys its seed off it so the aggregate decodes with the round
+        # it was built from
+        task.served_round = worker.last_pull_round()
         return out
     except BaseException as e:  # noqa: BLE001 - owner-death classify
         _owner_giveup(task, owner, e)
@@ -747,8 +758,15 @@ def _decompress_stage(task: PartitionTask):
         # format never existed for this round)
         return plan.codec.decode(np.ascontiguousarray(buf), p.length,
                                  _wire_seed(task))
-    return plan.decode_pull(np.ascontiguousarray(buf), p.length,
-                            _wire_seed(task))
+    # the served round may trail the requested one under bounded
+    # staleness — pull_seed owns the served-round → seed contract
+    from byteps_tpu.compression.wire import pull_seed
+
+    seed = pull_seed(task.name, task.context["version"], p.part_idx,
+                     served_round=getattr(task, "served_round", None),
+                     staleness=_state.cfg.staleness,
+                     salt=task.context["spec"].seed)
+    return plan.decode_pull(np.ascontiguousarray(buf), p.length, seed)
 
 
 def _live_size() -> int:
@@ -951,7 +969,8 @@ def push_pull_async(
         if overrides:
             p = dataclasses.replace(p, **overrides)
         tasks.append(
-            PartitionTask(partition=p, name=name, handle=handle, context=shared)
+            PartitionTask(partition=p, name=name, handle=handle,
+                          context=shared, round=version)
         )
     if multiproc:
         # SPMD determinism: every controller must issue IDENTICAL

@@ -167,6 +167,7 @@ def start_server(
     pull_timeout_ms: Optional[int] = None,
     enable_schedule: Optional[bool] = None,
     lease_ms: Optional[int] = None,
+    staleness: Optional[int] = None,
 ) -> int:
     """Start the native summation service in this process (non-blocking).
 
@@ -174,6 +175,15 @@ def start_server(
     worker membership: a worker silent past the lease is evicted, the
     membership epoch bumps, open rounds re-target the live worker set,
     and stuck barriers release (docs/robustness.md §elastic membership).
+
+    ``staleness`` (default ``BYTEPS_STALENESS``) > 0 arms BOUNDED-
+    STALENESS rounds: a pull for round v is served from the newest
+    CLOSED round >= v-K, a pull past the bound force-closes straggler-
+    held rounds over their contributors (quorum-scaled), and responses
+    stamp the served round — so one slow worker no longer sets the
+    global step time (docs/robustness.md §bounded staleness). K=0 is
+    bit-identical to the synchronous tier; ``BYTEPS_ENABLE_ASYNC`` is
+    the K=inf limit and wins when both are set.
     """
     global _INPROC_SERVER_ID
     cfg = get_config()
@@ -192,6 +202,7 @@ def start_server(
         1 if (enable_schedule if enable_schedule is not None
               else cfg.server_enable_schedule) else 0,
         lease_ms if lease_ms is not None else cfg.worker_lease_ms,
+        staleness if staleness is not None else cfg.staleness,
     )
     if rc != 0:
         raise RuntimeError(f"bps_server_start failed (rc={rc}, port={port})")
@@ -320,11 +331,20 @@ class PSWorker:
         # --- robustness state (docs/robustness.md) -------------------------
         self._plan = (fault_plan if fault_plan is not None
                       else plan_from_env(cfg, worker_id=self._worker_id))
-        # CRC is forced on while injection is armed: corruption must be
-        # *detected* to be retryable instead of silently summed
-        self._crc = bool(cfg.wire_crc) or self._plan is not None
+        # CRC is forced on while LOSS/CORRUPTION injection is armed:
+        # corruption must be *detected* to be retryable instead of
+        # silently summed. A pure-latency plan (only 'slow' rules — the
+        # bounded-staleness straggler leg) loses and corrupts nothing,
+        # so it does not force the 2×-per-payload software CRC pass onto
+        # every worker sharing the spec string.
+        self._crc = bool(cfg.wire_crc) or (
+            self._plan is not None
+            and any(r.kind != "slow" for r in self._plan.rules))
         self._retry_limit = max(0, cfg.retry_limit)
         self._backoff_ms = max(1, cfg.retry_backoff_ms)
+        # bounded staleness (BYTEPS_STALENESS): armed here so pull_bytes
+        # can re-sync the mint counter off a serve-ahead response
+        self._staleness = max(0, cfg.staleness)
         # seeded jitter: reproducible backoff schedules per worker
         self._retry_rng = random.Random(
             0xC0FFEE ^ (self._worker_id * 7919) ^ cfg.fault_seed)
@@ -376,6 +396,17 @@ class PSWorker:
         self._m_pull_bytes_nic = _reg.counter(
             f"wire.{self._nic_tag}.pull_bytes")
         self._m_push_size = _reg.histogram("wire.push_size_bytes")
+        # bounded-staleness observability (docs/observability.md):
+        # requested − served per pull (how stale the aggregate this
+        # worker consumed was), and how many rounds this worker's newest
+        # minted push runs ahead of the round it last consumed. The
+        # gauge is per-NIC (two NICs sharing one series would mask each
+        # other last-writer-wins); the plain series is the most recent
+        # pull in the process — the per-step flight-recorder view.
+        self._m_staleness = _reg.histogram("server.staleness")
+        self._m_rounds_ahead = _reg.gauge("psworker.rounds_ahead")
+        self._m_rounds_ahead_nic = _reg.gauge(
+            f"psworker.{self._nic_tag}.rounds_ahead")
         self._m_attempts = {
             op: (_reg.counter(f"wire.{op}_attempts"),
                  _reg.counter(f"wire.{self._nic_tag}.{op}_attempts"))
@@ -654,6 +685,14 @@ class PSWorker:
         divide by for THAT round (``None`` before any pull). Thread-local,
         like the connections themselves."""
         return getattr(self._tls, "round_live", None)
+
+    def last_pull_round(self) -> Optional[int]:
+        """The round the calling thread's most recent :meth:`pull_bytes`
+        was actually SERVED from (the response's round stamp). Under
+        bounded staleness (``BYTEPS_STALENESS``) it may trail the
+        requested round by up to K — requested − served is the pull's
+        effective staleness. ``None`` before any pull; thread-local."""
+        return getattr(self._tls, "round_served", None)
 
     def sync_rounds(self, sidx: int) -> None:
         """Adopt server ``sidx``'s per-key (round, nbytes) watermarks —
@@ -988,9 +1027,11 @@ class PSWorker:
                 import ctypes
 
                 ep = ctypes.c_uint64(0)
-                got = load_lib().bps_local_pull2(
+                served = ctypes.c_uint64(0)
+                got = load_lib().bps_local_pull3(
                     key, codec, version, self._recv_timeout,
                     out.ctypes.data, out.nbytes, ctypes.byref(ep),
+                    ctypes.byref(served),
                 )
                 if got < 0:
                     raise RuntimeError(f"local pull failed (rc={got})")
@@ -1000,6 +1041,7 @@ class PSWorker:
                 # epoch the returned ROUND closed under
                 self._tls.round_live = self._live_at(
                     sidx, int(ep.value) & 0xFFFF)
+                self._tls.round_served = int(served.value)
                 return out, int(got)
             inj = self._inject_pre("pull", sidx)
             conn = self._conn(sidx)
@@ -1033,6 +1075,7 @@ class PSWorker:
             # authority for averaging; the current epoch may be newer)
             self._tls.round_live = self._live_at(sidx,
                                                  conn.last_pull_epoch())
+            self._tls.round_served = conn.last_pull_round()
             return out, int(got)
 
         out, got = self._retry_loop("pull", key, attempt)
@@ -1040,6 +1083,31 @@ class PSWorker:
             self.bytes_pulled += got
         self._m_pull_bytes.inc(got)
         self._m_pull_bytes_nic.inc(got)
+        # bounded-staleness telemetry: requested − served = how stale the
+        # consumed aggregate was (0 on the strict-sync tier), and minted −
+        # served = how far this worker's pipeline runs ahead of the round
+        # it just consumed (≈ K when the window is full)
+        served = getattr(self._tls, "round_served", None)
+        if served is not None and version > 0:
+            self._m_staleness.observe(max(0, int(version) - int(served)))
+            with self._vlock:
+                # Serve-AHEAD re-sync (staleness only): a straggler whose
+                # rounds were force-closed past it gets served a NEWER
+                # round than it asked for. Its mint counter must adopt
+                # that round — its next push then targets the OPEN round
+                # and rejoins the quorum, instead of minting ever-late
+                # versions the server consumes silently forever (a
+                # transient slowdown would otherwise exclude the worker
+                # for the rest of the job). Max-merge, same contract as
+                # adopt_rounds; in strict sync served == requested ≤ the
+                # counter, so this is structurally a no-op there.
+                if (self._staleness > 0
+                        and int(served) > self._versions.get(key, 0)):
+                    self._versions[key] = int(served)
+                minted = self._versions.get(key, int(version))
+            ahead = max(0, int(minted) - int(served))
+            self._m_rounds_ahead.set(ahead)
+            self._m_rounds_ahead_nic.set(ahead)
         return out[:got]
 
     def push(self, key: int, data: np.ndarray) -> int:

@@ -13,10 +13,10 @@ extern "C" {
 
 int bps_server_start(uint16_t port, int num_workers, int engine_threads,
                      int async_mode, int pull_timeout_ms, int server_id,
-                     int enable_schedule, int lease_ms) {
+                     int enable_schedule, int lease_ms, int staleness) {
   return bps::StartServer(port, num_workers, engine_threads, async_mode != 0,
                           pull_timeout_ms, server_id, enable_schedule != 0,
-                          lease_ms);
+                          lease_ms, staleness);
 }
 
 // Elastic-membership observability: the in-process server's epoch and
@@ -92,6 +92,22 @@ int64_t bps_local_pull2(uint64_t key, uint8_t codec, uint64_t version,
   return static_cast<int64_t>(blob.size());
 }
 
+// As bps_local_pull2, additionally surfacing the SERVED round (the TCP
+// response header's version field): under bounded staleness
+// (BYTEPS_STALENESS) it may differ from the requested round — requested
+// minus served is the worker's effective staleness.
+int64_t bps_local_pull3(uint64_t key, uint8_t codec, uint64_t version,
+                        int timeout_ms, void* out, uint64_t cap,
+                        uint64_t* out_epoch, uint64_t* out_round) {
+  std::vector<char> blob;
+  int rc = bps::LocalPull(key, codec, version, timeout_ms, &blob,
+                          out_epoch, out_round);
+  if (rc != 0) return rc;
+  if (blob.size() > cap) return -5;
+  std::memcpy(out, blob.data(), blob.size());
+  return static_cast<int64_t>(blob.size());
+}
+
 // ---- TCP client -----------------------------------------------------------
 void* bps_client_connect(const char* host, uint16_t port, int timeout_ms,
                          int recv_timeout_ms) {
@@ -143,6 +159,23 @@ int bps_client_pull2(void* client, uint64_t key, void* data,
   int rc = static_cast<bps::Client*>(client)->Pull(
       key, data, nbytes, version, codec, out_bytes, want_crc != 0, out_crc,
       worker_id, &ep);
+  if (out_epoch != nullptr) *out_epoch = ep;
+  return rc;
+}
+
+// As bps_client_pull2, additionally surfacing the SERVED round (response
+// header version) — under bounded staleness (BYTEPS_STALENESS) the server
+// answers from the newest closed round >= requested − K, and the worker
+// reads its effective staleness off this stamp.
+int bps_client_pull3(void* client, uint64_t key, void* data,
+                     uint64_t nbytes, uint64_t version, uint8_t codec,
+                     int want_crc, uint64_t* out_bytes, uint32_t* out_crc,
+                     int worker_id, uint32_t* out_epoch,
+                     uint64_t* out_round) {
+  uint16_t ep = 0;
+  int rc = static_cast<bps::Client*>(client)->Pull(
+      key, data, nbytes, version, codec, out_bytes, want_crc != 0, out_crc,
+      worker_id, &ep, out_round);
   if (out_epoch != nullptr) *out_epoch = ep;
   return rc;
 }

@@ -177,14 +177,17 @@ int Client::Push(uint64_t key, const void* data, uint64_t nbytes,
 
 int Client::Pull(uint64_t key, void* data, uint64_t nbytes, uint64_t version,
                  uint8_t codec, uint64_t* out_bytes, bool want_crc,
-                 uint32_t* out_crc, int worker_id, uint16_t* out_epoch) {
+                 uint32_t* out_crc, int worker_id, uint16_t* out_epoch,
+                 uint64_t* out_round) {
   std::lock_guard<std::mutex> lk(mu_);
   const uint16_t wid =
       worker_id >= 0 ? static_cast<uint16_t>(worker_id + 1) : 0;
   // request crc = 1 is the "checksum the response" marker (any nonzero
-  // value works; the pull request itself has no payload to checksum)
+  // value works; the pull request itself has no payload to checksum);
+  // out_round = the response header's version field, i.e. the round the
+  // server actually SERVED (>= requested − BYTEPS_STALENESS)
   return Roundtrip(kPull, key, version, nullptr, 0, data, nbytes,
-                   out_bytes, codec, wid, nullptr, want_crc ? 1u : 0u,
+                   out_bytes, codec, wid, out_round, want_crc ? 1u : 0u,
                    out_crc, out_epoch);
 }
 

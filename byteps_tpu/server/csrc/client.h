@@ -54,10 +54,13 @@ class Client {
   // worker's membership lease (a worker blocked in a long pull is alive).
   // *out_epoch receives the membership epoch the pulled ROUND closed
   // under (its header stamp) — the divisor authority for averaging.
+  // *out_round receives the SERVED round (response header version):
+  // under bounded staleness (BYTEPS_STALENESS) it may differ from the
+  // requested round — requested − served is the effective staleness.
   int Pull(uint64_t key, void* data, uint64_t nbytes, uint64_t version,
            uint8_t codec, uint64_t* out_bytes, bool want_crc = false,
            uint32_t* out_crc = nullptr, int worker_id = -1,
-           uint16_t* out_epoch = nullptr);
+           uint16_t* out_epoch = nullptr, uint64_t* out_round = nullptr);
   // `worker_id` >= 0 rides the barrier/shutdown frame so the server can
   // refresh the worker's lease (barrier) or mark it DEPARTED (shutdown);
   // -1 keeps the anonymous legacy frame.

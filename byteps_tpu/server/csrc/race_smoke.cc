@@ -114,7 +114,7 @@ int main() {
   if (bps::StartServer(kPort, kWorkers, /*engine_threads=*/2,
                        /*async=*/false, /*pull_timeout_ms=*/20000,
                        /*server_id=*/0, /*schedule=*/true,
-                       /*lease_ms=*/5000) != 0) {
+                       /*lease_ms=*/5000, /*staleness=*/0) != 0) {
     std::fprintf(stderr, "server start failed\n");
     return 1;
   }

@@ -89,10 +89,16 @@ using ConnPtr = std::shared_ptr<Conn>;
 
 struct PendingPull {
   ConnPtr conn;
-  uint64_t version;  // respond when store version >= this
+  uint64_t version;  // respond when store version >= this (under bounded
+                     // staleness: the requested round minus K — the
+                     // oldest round this pull may legally be served from)
   uint8_t codec;     // response encoding the worker asked for
   bool want_crc;     // checksummed response requested
   int64_t enq_ms;    // steady clock, for the timeout sweep
+  uint64_t force_min = 0;  // bounded staleness: the round this pull may
+                           // FORCE-close up to (0 = may not force) — a
+                           // later push apply re-checks it so a parked
+                           // pull can make progress off the straggler
 };
 
 struct DeferredPush {
@@ -179,13 +185,16 @@ class Server {
  public:
   int Start(uint16_t port, int num_workers, int engine_threads, bool async,
             int pull_timeout_ms, int server_id, bool schedule,
-            int lease_ms) {
+            int lease_ms, int staleness) {
     num_workers_ = num_workers;
     async_ = async;
     pull_timeout_ms_ = pull_timeout_ms;
     server_id_ = server_id;
     schedule_ = schedule;
     lease_ms_ = lease_ms;
+    // bounded staleness is a SYNC-mode ladder; async is its K=inf limit
+    // and keeps its own free-running code path
+    staleness_ = async ? 0 : std::max(0, staleness);
     // membership starts fully live even with the lease disabled, so every
     // live-set consumer (round completion, barriers, shutdown gate) reads
     // one uniform source of truth
@@ -343,10 +352,13 @@ class Server {
     if (!running_) return -10;
     KeyStore* ks = Get(key);
     if (ks == nullptr) return -1;
-    if (!async_ && worker >= num_workers_) return -2;
+    // bounds/liveness hold in ASYNC mode too: an out-of-range or evicted
+    // worker id must not silently sum into the free-running aggregate
+    // (it would also never refresh a lease slot, leaving kMembers lying)
+    if (worker >= num_workers_) return -2;
     // IPC analog of the TCP path's "worker evicted" kErr
-    if (!async_ && !WorkerLive(worker)) return -11;
-    if (!async_ && lease_ms_ > 0 && version != 0) {
+    if (!WorkerLive(worker)) return -11;
+    if (!async_ && staleness_ <= 0 && lease_ms_ > 0 && version != 0) {
       // stale-round guard (see the kPush handler): a round the worker
       // was evicted out of closed without it — reject, don't sum
       std::lock_guard<std::mutex> lk(ks->mu);
@@ -365,7 +377,7 @@ class Server {
 
   int LocalPull(uint64_t key, uint8_t codec, uint64_t version,
                 int timeout_ms, std::vector<char>* out,
-                uint64_t* out_epoch) {
+                uint64_t* out_epoch, uint64_t* out_version) {
     if (!running_) return -10;
     KeyStore* ks = Get(key);
     if (ks == nullptr) return -1;
@@ -373,12 +385,28 @@ class Server {
     CodecHint hint;
     uint64_t v = 0;
     uint64_t epoch = 0;
+    // bounded staleness: same serve/force ladder as the TCP path
+    const uint64_t serve_min = ServeMin(version);
+    const uint64_t force_min = ForceMin(version);
     {
       std::unique_lock<std::mutex> lk(ks->mu);
       const auto deadline = std::chrono::steady_clock::now() +
                             std::chrono::milliseconds(timeout_ms);
       while (running_ &&
-             !(async_ ? ks->version > 0 : ks->version >= version)) {
+             !(async_ ? ks->version > 0 : ks->version >= serve_min)) {
+        if (force_min > ks->version && ks->arrived > 0) {
+          std::vector<ReadyResp> released;
+          auto memb = Members();
+          ForceAdvanceLocked(ks, *memb, force_min, &released);
+          if (!released.empty()) {
+            // TCP pulls satisfied by OUR force-close must not wait for
+            // this local pull's own condition — hand them off now
+            lk.unlock();
+            DispatchReady(key, ks, released);
+            lk.lock();
+          }
+          continue;
+        }
         if (ks->cv.wait_until(lk, deadline) == std::cv_status::timeout) {
           return -4;
         }
@@ -396,6 +424,7 @@ class Server {
       }
     }
     if (out_epoch != nullptr) *out_epoch = epoch;
+    if (out_version != nullptr) *out_version = v;
     *out = *EncodeResponse(ks, snap, hint, v, codec);
     return 0;
   }
@@ -715,6 +744,9 @@ class Server {
           if (RoundCompleteLocked(ks, *memb)) {
             CloseRoundLocked(ks, *memb, &ready);
           }
+          // a shrink can also unblock a parked bounded-staleness pull
+          // (the dead worker was the missing contributor)
+          ForcePendingLocked(ks, *memb, &ready);
         }
         ks->cv.notify_all();
       }
@@ -824,6 +856,50 @@ class Server {
     uint64_t epoch;  // membership epoch the round CLOSED under
   };
 
+  // ---- bounded staleness (BYTEPS_STALENESS=K, sync mode) ------------------
+  // A pull for round v may be served from any CLOSED round >= v-K; the
+  // oldest legal serve is also the round the pull may FORCE-close up to
+  // when the straggler holds it open past the bound. The first K rounds
+  // (v <= K) never force: the job starts with one naturally-closed
+  // round, so the ladder's base is a real quorum sum, not served zeros.
+  uint64_t ServeMin(uint64_t version) const {
+    if (async_ || staleness_ <= 0) return version;
+    const uint64_t k = static_cast<uint64_t>(staleness_);
+    return version > k ? version - k : 1;
+  }
+
+  uint64_t ForceMin(uint64_t version) const {
+    if (async_ || staleness_ <= 0) return 0;
+    const uint64_t k = static_cast<uint64_t>(staleness_);
+    return version > k ? version - k : 0;
+  }
+
+  // Close open rounds up to `target` over whoever contributed (the
+  // eviction-analog: each close quorum-scales the partial sum to the
+  // live count, so the global average stays unbiased). Stops at an
+  // EMPTY open round — a round nobody joined yet cannot close, and the
+  // parked pull waits for the next push apply to re-trigger.
+  void ForceAdvanceLocked(KeyStore* ks, const Membership& memb,
+                          uint64_t target,
+                          std::vector<ReadyResp>* ready) {
+    while (ks->version < target && ks->arrived > 0) {
+      CloseRoundLocked(ks, memb, ready);
+    }
+  }
+
+  // Re-check every parked pull's force bound after a push apply: the
+  // push that just landed may be the contribution that lets a blocked
+  // fast worker's round ladder advance.
+  void ForcePendingLocked(KeyStore* ks, const Membership& memb,
+                          std::vector<ReadyResp>* ready) {
+    if (async_ || staleness_ <= 0 || ks->pending.empty()) return;
+    uint64_t target = 0;
+    for (const auto& p : ks->pending) {
+      target = std::max(target, p.force_min);
+    }
+    if (target > ks->version) ForceAdvanceLocked(ks, memb, target, ready);
+  }
+
   // Round completion over the LIVE membership: closed when every live
   // worker contributed. Contributions from workers evicted mid-round may
   // already sit in accum — the close-time quorum scaling handles them.
@@ -842,12 +918,14 @@ class Server {
   void CloseRoundLocked(KeyStore* ks, const Membership& memb,
                         std::vector<ReadyResp>* ready) {
     // Quorum scaling: a worker evicted mid-round may have contributed to
-    // accum, but the survivors will average this sum over the LIVE count
-    // (the membership their epoch adoption reports). Scale the sum to
-    // the survivors so the global *average* stays unbiased. A clean
+    // accum (contributors > live), and a bounded-staleness FORCE-close
+    // fires before every live worker arrived (contributors < live) —
+    // either way the pullers will average this sum over the LIVE count
+    // (the membership their epoch adoption reports), so scale the sum by
+    // live/contributors to keep the global *average* unbiased. A clean
     // round (contributors == live) takes no multiply at all — healthy
-    // and post-eviction epochs stay bit-exact.
-    if (memb.count > 0 && ks->arrived > memb.count) {
+    // epochs (and the whole K=0 ladder) stay bit-exact.
+    if (memb.count > 0 && ks->arrived > 0 && ks->arrived != memb.count) {
       const float s = static_cast<float>(memb.count) /
                       static_cast<float>(ks->arrived);
       for (auto& v : ks->accum) v *= s;
@@ -909,6 +987,20 @@ class Server {
     if (version != 0 && worker < ks->applied_version.size() &&
         version <= ks->applied_version[worker]) {
       return;  // duplicate of an already-summed push
+    }
+    if (staleness_ > 0 && !async_ && version != 0 &&
+        version <= ks->version) {
+      // Bounded staleness: the round this push belongs to already closed
+      // over its contributors (a fast worker's pull force-closed it) —
+      // a straggler's late push is EXPECTED and consumed silently, never
+      // an error. The applied watermark still advances so a retry
+      // engine's replay of this same round dedupes as before, and the
+      // straggler's next pull serves it the newest closed round to
+      // catch up from.
+      if (worker < ks->applied_version.size()) {
+        ks->applied_version[worker] = version;
+      }
+      return;
     }
     if (lease_ms_ > 0 && !async_ && version != 0 &&
         version <= ks->version) {
@@ -986,6 +1078,12 @@ class Server {
       auto memb = Members();
       ApplyPushLocked(ks, *memb, worker, codec, version, std::move(buf),
                       &ready);
+      // bounded staleness: this push may be the contribution a parked
+      // fast-worker pull was waiting on — re-check the force bounds of
+      // every pending pull, and wake in-process (LocalPull) waiters so
+      // they re-evaluate their own bound
+      ForcePendingLocked(ks, *memb, &ready);
+      if (staleness_ > 0 && !async_) ks->cv.notify_all();
       if (async_) {
         auto it = ks->pending.begin();
         while (it != ks->pending.end()) {
@@ -1068,11 +1166,23 @@ class Server {
     uint64_t epoch = 0;
     std::shared_ptr<const FloatBuf> snap;
     CodecHint hint;
+    // bounded staleness: serve the NEWEST closed round as long as it is
+    // within K of the requested one; a pull past the bound force-closes
+    // the straggler-held rounds up to version-K (quorum-scaled over
+    // their contributors) instead of parking forever behind it
+    const uint64_t serve_min = ServeMin(version);
+    const uint64_t force_min = ForceMin(version);
+    std::vector<ReadyResp> released;
     {
       std::lock_guard<std::mutex> lk(ks->mu);
-      ready = async_ ? ks->version > 0 : ks->version >= version;
+      if (force_min > ks->version) {
+        auto memb = Members();
+        ForceAdvanceLocked(ks, *memb, force_min, &released);
+      }
+      ready = async_ ? ks->version > 0 : ks->version >= serve_min;
       if (!ready) {
-        ks->pending.push_back({c, version, codec, want_crc, steady_ms()});
+        ks->pending.push_back(
+            {c, serve_min, codec, want_crc, steady_ms(), force_min});
       } else {
         v = ks->version;
         if (async_) {
@@ -1086,6 +1196,8 @@ class Server {
         }
       }
     }
+    // pulls from OTHER workers satisfied by the force-close
+    DispatchReady(key, ks, released);
     if (ready) {
       SubmitEngine(key, [this, c, key, ks, codec, want_crc, v, hint, epoch,
                          snap = std::move(snap)] {
@@ -1177,11 +1289,14 @@ class Server {
             SendErr(c, h.key, "push before init");
             break;
           }
-          if (!async_ && h.reserved >= num_workers_) {
+          // validated in ASYNC mode too: an out-of-range or evicted
+          // worker must not silently sum into the free-running
+          // aggregate (and its Touch below keeps kMembers truthful)
+          if (h.reserved >= num_workers_) {
             SendErr(c, h.key, "worker id out of range");
             break;
           }
-          if (!async_ && !WorkerLive(h.reserved)) {
+          if (!WorkerLive(h.reserved)) {
             // an evicted worker's stale round must not leak into the
             // post-eviction sums; it rejoins first (kPing heartbeat +
             // kRounds watermark adoption) and re-sends under the new
@@ -1189,8 +1304,12 @@ class Server {
             SendErr(c, h.key, "worker evicted: rejoin required");
             break;
           }
-          if (!async_ && lease_ms_ > 0 && h.version != 0) {
-            // Stale-round guard: a worker evicted MID-ROUND whose
+          if (!async_ && staleness_ <= 0 && lease_ms_ > 0 &&
+              h.version != 0) {
+            // Stale-round guard (strict-sync only — under bounded
+            // staleness a late round is EXPECTED and consumed silently
+            // by ApplyPushLocked, never a rejoin-forcing error): a
+            // worker evicted MID-ROUND whose
             // heartbeat already re-admitted it (monitor rejoin after a
             // wedge) may still re-send the round it was evicted out of.
             // That round CLOSED without it — summing the payload now
@@ -1363,6 +1482,7 @@ class Server {
   int pull_timeout_ms_ = 0;
   int server_id_ = 0;
   int lease_ms_ = 0;
+  int staleness_ = 0;  // bounded-staleness K (0 = strict sync rounds)
   // elastic membership (see the helper block above): per-worker lease +
   // state under members_mu_; live count and epoch are atomics so the
   // data plane (SendFrame's epoch stamp, barrier targets) reads them
@@ -1418,7 +1538,7 @@ Server* GetServer() {
 
 int StartServer(uint16_t port, int num_workers, int engine_threads,
                 bool async, int pull_timeout_ms, int server_id,
-                bool schedule, int lease_ms) {
+                bool schedule, int lease_ms, int staleness) {
   std::lock_guard<std::mutex> lk(g_server_mu);
   if (g_server != nullptr) {
     if (g_server->IsRunning()) return -10;  // already running
@@ -1430,7 +1550,8 @@ int StartServer(uint16_t port, int num_workers, int engine_threads,
   }
   auto* s = new Server();
   int rc = s->Start(port, num_workers, engine_threads, async,
-                    pull_timeout_ms, server_id, schedule, lease_ms);
+                    pull_timeout_ms, server_id, schedule, lease_ms,
+                    staleness);
   if (rc != 0) {
     delete s;  // never published: no other thread can hold it
     return rc;
@@ -1499,10 +1620,12 @@ int LocalPush(uint16_t worker, uint64_t key, uint8_t codec,
 }
 
 int LocalPull(uint64_t key, uint8_t codec, uint64_t version, int timeout_ms,
-              std::vector<char>* out, uint64_t* out_epoch) {
+              std::vector<char>* out, uint64_t* out_epoch,
+              uint64_t* out_version) {
   Server* s = GetServer();
   return s != nullptr
-             ? s->LocalPull(key, codec, version, timeout_ms, out, out_epoch)
+             ? s->LocalPull(key, codec, version, timeout_ms, out, out_epoch,
+                            out_version)
              : -10;
 }
 

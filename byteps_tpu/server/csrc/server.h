@@ -32,9 +32,20 @@ namespace bps {
 // (epoch bumps again); pushes from an evicted worker are rejected with a
 // "worker evicted" kErr until it rejoins, so its stale rounds can never
 // leak into a post-eviction sum. 0 = fixed membership (legacy).
+// `staleness` > 0 arms BOUNDED-STALENESS rounds (BYTEPS_STALENESS=K, sync
+// mode only — async is the K=inf limit): a pull for round v is served from
+// the newest CLOSED round v' >= v-K instead of blocking on v itself, and a
+// pull that would otherwise wait past the bound FORCE-closes open rounds
+// (each over its contributors, quorum-scaled exactly like an
+// eviction-shrunk round) up to v-K so one straggler can no longer set the
+// global step time. A straggler's push for a round that already closed is
+// consumed silently (watermark advanced, payload dropped) — backpressure
+// and catch-up, never an error. K=0 is bit-identical to the synchronous
+// tier. Responses stamp the SERVED round in the version field, so the
+// worker knows its effective staleness.
 int StartServer(uint16_t port, int num_workers, int engine_threads,
                 bool async, int pull_timeout_ms, int server_id,
-                bool schedule, int lease_ms);
+                bool schedule, int lease_ms, int staleness);
 // Current membership epoch of the in-process server (0 if none running) —
 // the IPC-path analog of the epoch carried in every TCP response header.
 uint64_t ServerEpoch();
@@ -67,7 +78,11 @@ int LocalPush(uint16_t worker, uint64_t key, uint8_t codec,
 // response encoded as `codec`. *out_epoch (optional) receives the
 // membership epoch the returned ROUND closed under — the averaging
 // divisor authority, same contract as the TCP response header stamp.
+// *out_version (optional) receives the SERVED round — under bounded
+// staleness it may differ from the requested one (the TCP analog is the
+// response header's version field).
 int LocalPull(uint64_t key, uint8_t codec, uint64_t version, int timeout_ms,
-              std::vector<char>* out, uint64_t* out_epoch = nullptr);
+              std::vector<char>* out, uint64_t* out_epoch = nullptr,
+              uint64_t* out_version = nullptr);
 
 }  // namespace bps

@@ -78,17 +78,19 @@ def load_lib() -> ctypes.CDLL:
             _build()
             lib = ctypes.CDLL(_SO)
         try:
-            # staleness probe: a prebuilt .so predating the elastic
-            # membership API would otherwise be dlopen'd with a
-            # mismatched bps_server_start signature
-            lib.bps_client_members
+            # staleness probe: a prebuilt .so predating the bounded-
+            # staleness API (bps_client_pull3; implies the membership API
+            # too) would otherwise be dlopen'd with a mismatched
+            # bps_server_start signature
+            lib.bps_client_pull3
         except AttributeError:
-            log.warning("native library predates membership API; rebuilding")
+            log.warning(
+                "native library predates bounded-staleness API; rebuilding")
             os.remove(_SO)
             _build()
             lib = ctypes.CDLL(_SO)
             try:
-                lib.bps_client_members
+                lib.bps_client_pull3
             except AttributeError:
                 # dlopen matched the ALREADY-MAPPED stale object by path
                 # (nothing dlcloses the first handle), so the rebuild
@@ -102,6 +104,7 @@ def load_lib() -> ctypes.CDLL:
         lib.bps_server_start.argtypes = [
             ctypes.c_uint16, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int,
         ]
         lib.bps_server_start.restype = ctypes.c_int
         lib.bps_server_wait.argtypes = []
@@ -143,6 +146,13 @@ def load_lib() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_uint64),
         ]
         lib.bps_local_pull2.restype = ctypes.c_int64
+        lib.bps_local_pull3.argtypes = [
+            ctypes.c_uint64, ctypes.c_uint8, ctypes.c_uint64, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.bps_local_pull3.restype = ctypes.c_int64
         lib.bps_client_connect.argtypes = [
             ctypes.c_char_p, ctypes.c_uint16, ctypes.c_int, ctypes.c_int,
         ]
@@ -176,6 +186,15 @@ def load_lib() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_uint32),
         ]
         lib.bps_client_pull2.restype = ctypes.c_int
+        lib.bps_client_pull3.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint8,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.bps_client_pull3.restype = ctypes.c_int
         lib.bps_client_barrier.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.bps_client_barrier.restype = ctypes.c_int
         lib.bps_client_shutdown.argtypes = [ctypes.c_void_p, ctypes.c_int]
@@ -246,6 +265,7 @@ class NativeClient:
         # close() draining a straggler, bounded by the recv timeout.
         self._op_lock = threading.Lock()
         self._last_pull_epoch = 0
+        self._last_pull_round = 0
         self._h: Optional[int] = self._lib.bps_client_connect(
             host.encode(), port, timeout_ms, recv_timeout_ms
         )
@@ -286,22 +306,29 @@ class NativeClient:
         server-side (a worker blocked in a long pull is still alive).
         The epoch the pulled ROUND closed under is retained on this
         client (:meth:`last_pull_epoch`) — the averaging divisor
-        authority under elastic membership."""
+        authority under elastic membership — and so is the SERVED round
+        (:meth:`last_pull_round`): under bounded staleness
+        (``BYTEPS_STALENESS``) the server answers from the newest closed
+        round >= requested − K, and requested − served is this pull's
+        effective staleness."""
         assert out.flags.c_contiguous
         with self._op_lock:
             self._require_open()
             got = ctypes.c_uint64(0)
             crc = ctypes.c_uint32(0)
             ep = ctypes.c_uint32(0)
+            served = ctypes.c_uint64(0)
             self._check(
-                self._lib.bps_client_pull2(
+                self._lib.bps_client_pull3(
                     self._h, key, out.ctypes.data, out.nbytes, version,
                     codec, 1 if want_crc else 0, ctypes.byref(got),
                     ctypes.byref(crc), worker_id, ctypes.byref(ep),
+                    ctypes.byref(served),
                 ),
                 "pull",
             )
             self._last_pull_epoch = int(ep.value)
+            self._last_pull_round = int(served.value)
             if want_crc:
                 return int(got.value), int(crc.value)
             return int(got.value)
@@ -310,6 +337,12 @@ class NativeClient:
         """Membership epoch (low 16 bits) the most recently pulled round
         CLOSED under — see :meth:`pull`."""
         return self._last_pull_epoch
+
+    def last_pull_round(self) -> int:
+        """The round the most recent :meth:`pull` was actually SERVED
+        from (response header version) — under bounded staleness it may
+        trail the requested round by up to ``BYTEPS_STALENESS``."""
+        return self._last_pull_round
 
     def barrier(self, worker_id: int = -1) -> None:
         """``worker_id`` >= 0 also refreshes that worker's membership

@@ -78,6 +78,7 @@ def test_parse_fault_spec_structured_errors():
         ("serverX:down", "server<N>"),
         ("server:down", "server<N>"),
         ("server1x:down", "server<N>"),
+        ("worker1x:slow", "worker<N>"),
         ("push:timeout@p=x", "float"),
         ("push:kill@op=x", "int"),
         ("server1:down@step=1..y", "int"),
@@ -107,6 +108,11 @@ def test_fault_spec_round_trip_every_documented_form():
         "worker:kill@step=8..",
         "worker:hang@step=3,ms=250",
         "worker:hang@step=3",  # default hang latency
+        # per-worker straggler targeting (worker<N> scope): the bounded-
+        # staleness bench's slow-worker leg, plus kill/hang variants
+        "worker1:slow@ms=80",
+        "worker0:kill@step=8..",
+        "worker2:hang@step=3,ms=250",
     ]
     for form in forms:
         rules = parse_fault_spec(form)
@@ -116,6 +122,28 @@ def test_fault_spec_round_trip_every_documented_form():
     spec = ";".join(forms)
     rules = parse_fault_spec(spec)
     assert parse_fault_spec(rules_to_spec(rules)) == rules
+
+
+def test_worker_scoped_rule_targets_one_worker():
+    """Satellite: ``worker<N>`` restricts a worker-scope rule to the plan
+    whose worker_id is N — the same BYTEPS_FAULT_SPEC string is handed to
+    every worker, and exactly one of them becomes the deterministic
+    straggler (slow fires per intercepted wire attempt) or victim."""
+    (r,) = parse_fault_spec("worker1:slow@ms=1")
+    assert r.scope == "worker" and r.worker == 1 and r.kind == "slow"
+    target = FaultPlan([r], seed=0, worker_id=1)
+    other = FaultPlan([r], seed=0, worker_id=0)
+    for _ in range(4):
+        target.intercept("push", 0)
+        other.intercept("push", 0)
+    assert target.counters()["slow"] == 4
+    assert other.counters()["slow"] == 0
+    # kill variant: only the targeted worker's plan returns the injection
+    (k,) = parse_fault_spec("worker0:kill@op=1")
+    assert (FaultPlan([k], seed=0, worker_id=0)
+            .intercept("push", 0) is not None)
+    assert (FaultPlan([k], seed=0, worker_id=1)
+            .intercept("push", 0) is None)
 
 
 def test_fault_plan_bit_identical_across_processes():
