@@ -963,6 +963,14 @@ def bench_serve(reps: int = 3, n_requests: int = 24,
     * **offered-load sweep** — arrivals paced at fractions of the
       measured saturation request rate: p50/p99 TTFT and per-token
       latency show where the latency knee sits below saturation.
+    * **shared-prefix race** — N requests sharing one long system
+      prompt with short unique tails (the dominant traffic shape at
+      "millions of users"), submitted at saturation with the radix
+      prefix cache ON vs OFF: a hit maps the shared blocks out of the
+      pool's prefix index and skips their prefill chunks entirely
+      (docs/serving.md §prefix cache). Headline
+      ``prefix_ttft_p50_speedup`` (trend-gated, >= 2x acceptance bar);
+      on/off token streams are asserted identical in-run.
 
     Outputs are bit-identical to the sequential leg's tokens by the
     serve tier's exactness contract (pinned in tests/test_serve.py);
@@ -1034,9 +1042,10 @@ def bench_serve(reps: int = 3, n_requests: int = 24,
         assert sched.cache.leaked_blocks() == 0, "KV block leak"
         return makespan, res
 
-    def leg_stats(runs):
+    def leg_stats(runs, n_new=None):
         """Aggregate a leg's reps: makespan med/spread + latency
         percentiles over every (rep, request, token)."""
+        n_new = total_new if n_new is None else n_new
         mks = sorted(m for m, _ in runs)
         med = float(np.median(mks))
         ttfts, gaps = [], []
@@ -1049,7 +1058,7 @@ def bench_serve(reps: int = 3, n_requests: int = 24,
         return {
             "sec_med": round(med, 4),
             "sec_spread": [round(mks[0], 4), round(mks[-1], 4)],
-            "tokens_per_s": round(total_new / med, 1),
+            "tokens_per_s": round(n_new / med, 1),
             "ttft_ms_p50": round(float(np.percentile(ttfts, 50)), 2),
             "ttft_ms_p99": round(float(np.percentile(ttfts, 99)), 2),
             "token_ms_p50": round(float(np.percentile(gaps, 50)), 3),
@@ -1084,11 +1093,60 @@ def bench_serve(reps: int = 3, n_requests: int = 24,
                 for _ in range(max(1, reps - 1))]
         results[f"offered_{frac}"] = leg_stats(runs)
 
+    # --- shared-prefix race: radix prefix cache on vs off ------------------
+    if quick:
+        sys_len, tail_len, pref_new, n_pref = 24, 4, 5, 6
+    else:
+        sys_len, tail_len, pref_new, n_pref = 160, 8, 8, 16
+    sys_prompt = rng.integers(0, cfg.vocab_size, sys_len).astype(np.int32)
+    tails = [rng.integers(0, cfg.vocab_size, tail_len).astype(np.int32)
+             for _ in range(n_pref)]
+
+    def run_prefix(on):
+        """The shared-prefix trace at saturation through a FRESH
+        scheduler: request 0 commits the system prompt's blocks cold,
+        every later request maps them out of the radix index (on) or
+        re-prefills them from scratch (off)."""
+        sched = Scheduler(params, cfg, max_batch=max_batch,
+                          prefill_chunk=prefill_chunk, prefix_cache=on)
+        t0 = time.monotonic()
+        reqs = [Request(rid=i,
+                        prompt=np.concatenate([sys_prompt, tails[i]]),
+                        max_new=pref_new) for i in range(n_pref)]
+        res = sched.serve(reqs)
+        makespan = time.monotonic() - t0
+        assert sched.cache.leaked_blocks() == 0, "KV block leak"
+        return makespan, res
+
+    run_prefix(True)                      # warm the prefix-leg shapes
+    pref_reps = max(1, reps - 1)
+    on_runs = [run_prefix(True) for _ in range(pref_reps)]
+    off_runs = [run_prefix(False) for _ in range(pref_reps)]
+    # exactness rides along: hot-cache greedy tokens must be
+    # bit-identical to the cache-off run (the tests pin this against
+    # solo generate too; here it guards the measured legs themselves)
+    for (_, ron), (_, roff) in zip(on_runs, off_runs):
+        for i in range(n_pref):
+            if not np.array_equal(ron[i]["tokens"], roff[i]["tokens"]):
+                raise AssertionError(
+                    f"prefix-cache on/off outputs diverged for request {i}")
+    pref_on = leg_stats(on_runs, n_new=n_pref * pref_new)
+    pref_off = leg_stats(off_runs, n_new=n_pref * pref_new)
+    results["prefix_shared_on"] = pref_on
+    results["prefix_shared_off"] = pref_off
+    pref_p50 = pref_off["ttft_ms_p50"] / pref_on["ttft_ms_p50"]
+    pref_p99 = pref_off["ttft_ms_p99"] / pref_on["ttft_ms_p99"]
+
     _log(f"serve: {n_requests} requests ({total_new} new tokens) — "
          f"sequential {sequential['tokens_per_s']} tok/s, saturation "
          f"{sat['tokens_per_s']} tok/s ({speedup:.2f}x), TTFT p50/p99 "
          f"{sat['ttft_ms_p50']}/{sat['ttft_ms_p99']} ms, token p50/p99 "
          f"{sat['token_ms_p50']}/{sat['token_ms_p99']} ms")
+    _log(f"serve prefix: {n_pref} requests x ({sys_len} shared + "
+         f"{tail_len} unique) tokens — TTFT p50 "
+         f"{pref_off['ttft_ms_p50']} -> {pref_on['ttft_ms_p50']} ms "
+         f"({pref_p50:.2f}x), p99 {pref_off['ttft_ms_p99']} -> "
+         f"{pref_on['ttft_ms_p99']} ms ({pref_p99:.2f}x)")
     return {
         "metric": (f"continuous-batching serve, {n_requests} mixed-length "
                    f"requests (GPT d{cfg.d_model}/L{cfg.n_layers}, prompts "
@@ -1098,6 +1156,10 @@ def bench_serve(reps: int = 3, n_requests: int = 24,
         "value": round(speedup, 3),
         "unit": "x serve vs sequential tokens/s",
         "vs_baseline": round(speedup, 3),
+        "prefix_ttft_p50_speedup": round(pref_p50, 3),
+        "prefix_ttft_p99_speedup": round(pref_p99, 3),
+        "prefix_trace": {"n_requests": n_pref, "shared_tokens": sys_len,
+                         "tail_tokens": tail_len, "max_new": pref_new},
         "tokens_per_s_per_chip": sat["tokens_per_s"],
         "sequential": sequential,
         "results": results,
@@ -2365,6 +2427,7 @@ _TREND_SPECS = (
     ("BENCH_chaos.json", "value"),
     ("BENCH_chaos.json", "straggler_ratio"),
     ("BENCH_serve.json", "value"),
+    ("BENCH_serve.json", "prefix_ttft_p50_speedup"),
     ("BENCH_ici.json", "ring_vs_staged_best"),
     ("BENCH_ici.json", "ring_bus_bw_best"),
 )

@@ -230,6 +230,14 @@ class Config:
     serve_quant_cache: bool = False
     # Default spec_len for per-request speculative policies.
     serve_spec_len: int = 4
+    # Radix prefix cache over the paged KV pool (docs/serving.md
+    # §prefix cache): committed prefill blocks are published to a
+    # content-addressed radix index with per-block refcounts; requests
+    # sharing a prompt prefix map their leading table entries to the
+    # SAME physical pages (copy-on-write at the divergence block) and
+    # skip the shared prefill chunks. Default-on — outputs are pinned
+    # bit-identical either way; 0 is the escape hatch.
+    serve_prefix_cache: bool = True
     # Replica lease for the serve router (serve/router.py): a replica
     # silent past this many ms (no completed scheduler step) is evicted
     # — epoch bump, its in-flight requests re-queue to survivors.
@@ -318,6 +326,8 @@ class Config:
             serve_prefill_chunk=_env_int("BYTEPS_SERVE_PREFILL_CHUNK", 32),
             serve_quant_cache=_env_bool("BYTEPS_SERVE_QUANT_CACHE"),
             serve_spec_len=_env_int("BYTEPS_SERVE_SPEC_LEN", 4),
+            serve_prefix_cache=_env_bool("BYTEPS_SERVE_PREFIX_CACHE",
+                                         True),
             serve_replica_lease_ms=_env_int(
                 "BYTEPS_SERVE_REPLICA_LEASE_MS", 1000),
             trace_on=_env_bool("BYTEPS_TRACE_ON"),

@@ -8,7 +8,12 @@ fixed-shape cache. This subsystem is the vLLM/Orca-shaped completion:
 * ``paged_cache`` — a block-paged KV pool: fixed-size KV blocks
   preallocated once, per-request block tables, so sequences of wildly
   different lengths pack one device batch (PagedAttention's memory
-  model).
+  model). Pages are refcounted and shareable: a radix prefix index
+  over committed prefill blocks (RadixAttention's organization) lets
+  requests with a common prompt prefix map the SAME physical pages,
+  with copy-on-write at the divergence block and LRU eviction of
+  cached-but-idle pages under pool pressure
+  (``BYTEPS_SERVE_PREFIX_CACHE``, default-on).
 * ``scheduler`` — iteration-level request scheduling: continuous
   admission from a queue, chunked prefill so long prompts can't starve
   decoders, preemption under block-pool pressure with

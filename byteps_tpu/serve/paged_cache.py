@@ -20,10 +20,26 @@ quantized pools reuse ``_quantize_block``'s absmax arithmetic — so a
 request served out of the paged pool emits tokens bit-identical to a
 solo ``make_generate_fn`` run (pinned in tests/test_serve.py).
 
+Pages are SHARED, not owned: every physical block carries a refcount
+and a radix/prefix index maps token content → committed prefill blocks
+(SGLang's RadixAttention organized over vLLM's paged pool). Requests
+whose prompts share a leading prefix — the dominant traffic shape at
+"millions of users" (one long system prompt, short unique tails) — map
+their leading table entries to the SAME physical pages and skip the
+shared prefill entirely. Divergence inside a block is copy-on-write: a
+writer whose table entry has refcount > 1 gets a fresh block with the
+shared contents copied (dense and int8 ``_QuantSlot`` paths), so
+sharing changes where bytes live, never what attention reads — hot-
+cache greedy outputs stay BIT-identical to cold runs (pinned). Cached-
+but-idle prefix pages are evicted LRU under pool pressure before any
+allocation fails: the prefix cache can never cause
+:class:`PoolExhausted` for live traffic.
+
 Three layers:
 
 * :class:`PagedKVCache` — the host-side allocator: pool arrays, block
-  tables, alloc/free/defrag, leak accounting. Block 0 is a reserved
+  tables + per-block refcounts, the radix prefix index,
+  alloc/adopt/CoW/free/defrag, leak accounting. Block 0 is a reserved
   scratch block: inactive decode rows scatter there and no table ever
   references it, so a padded batch slot can't corrupt live state.
 * :func:`make_paged_decode_fn` — ONE jitted packed decode step:
@@ -89,6 +105,29 @@ class PoolExhausted(RuntimeError):
 _POOL_SEQ = itertools.count()
 
 
+class _PrefixNode:
+    """One committed KV block in the radix prefix index.
+
+    The index is a block-granular radix tree: a node's edge label is
+    the EXACT ``block_size`` token ids its block holds (content-
+    addressed — children are keyed by the raw token bytes, chained
+    through the parent, so two different contexts can never collide
+    the way a rolling hash could). ``tick`` is the LRU clock stamped on
+    every lookup touch; eviction takes the least-recently-used
+    reclaimable subtree first."""
+
+    __slots__ = ("key", "tokens", "block", "parent", "children", "tick")
+
+    def __init__(self, key: bytes, tokens: np.ndarray, block: int,
+                 parent: "_PrefixNode"):
+        self.key = key
+        self.tokens = tokens
+        self.block = block
+        self.parent = parent
+        self.children: Dict[bytes, "_PrefixNode"] = {}
+        self.tick = 0
+
+
 class PagedKVCache:
     """Host-side block allocator + per-request block tables.
 
@@ -135,27 +174,89 @@ class PagedKVCache:
         # LIFO free list over blocks 1..NB-1 (0 = scratch, reserved)
         self._free: List[int] = list(range(pool_blocks - 1, 0, -1))
         self._tables: Dict[object, List[int]] = {}
+        # per-block refcount: one ref per table entry referencing the
+        # block plus one for its prefix-index node (if any). A shared
+        # block frees only at refcount 0.
+        self._ref: List[int] = [0] * pool_blocks
+        self._in_use = 0                  # distinct blocks with ref > 0
+        # radix prefix index over committed prefill blocks
+        self._root = _PrefixNode(b"", np.zeros(0, np.int32), -1, None)  # type: ignore[arg-type]
+        self._node_of_block: Dict[int, _PrefixNode] = {}
+        self._lru_tick = 0
+        # bumped on every commit_prefix insert: lets the scheduler's
+        # mid-prefill re-match skip the walk when nothing new committed
+        self.index_version = 0
         _reg = get_registry()
         # per-POOL gauge series (global instance sequence, the PR 6
         # scheduler.s<N>/pacer.p<N> pattern): two replicas' pools must
         # not mask each other last-writer-wins
         seq = next(_POOL_SEQ)
         self._g_in_use = _reg.gauge(f"serve.pool{seq}.kv_blocks_in_use")
+        self._g_prefix = _reg.gauge(f"serve.pool{seq}.prefix_blocks")
         self._c_alloc_fail = _reg.counter("serve.kv_alloc_failures")
+        self._c_prefix_evict = _reg.counter("serve.prefix_evictions")
 
     # -- accounting ---------------------------------------------------------
     @property
     def free_blocks(self) -> int:
         return len(self._free)
 
+    def _live_blocks(self) -> set:
+        """Ground-truth occupancy: the DISTINCT physical blocks
+        referenced by any live table or the prefix index — computed
+        from the references themselves, not ``_ref``, so the leak pin
+        stays truthful even against a refcount bookkeeping bug."""
+        live = {b for t in self._tables.values() for b in t}
+        live.update(self._node_of_block)
+        return live
+
     @property
     def blocks_in_use(self) -> int:
-        return sum(len(t) for t in self._tables.values())
+        """Distinct physical blocks occupied (shared pages count ONCE —
+        the whole point of sharing). Maintained incrementally: it moves
+        only when a refcount crosses 0<->1 (_alloc_block/_decref), so
+        the per-mutation gauge update stays O(1) instead of walking
+        every table (check_refcounts pins it against the ground
+        truth)."""
+        return self._in_use
+
+    @property
+    def prefix_blocks(self) -> int:
+        """Blocks held by the radix prefix index."""
+        return len(self._node_of_block)
 
     def leaked_blocks(self) -> int:
-        """Blocks neither free nor owned by a live table — must be 0 at
-        drain (the CI smoke's leak pin)."""
-        return (self.pool_blocks - 1) - len(self._free) - self.blocks_in_use
+        """Blocks neither free nor referenced by a live table or the
+        prefix index — must be 0 at drain (the CI smoke's leak pin)."""
+        return (self.pool_blocks - 1) - len(self._free) \
+            - len(self._live_blocks())
+
+    def reclaimable_blocks(self, exclude=()) -> int:
+        """Blocks LRU eviction could actually return to the free list:
+        prefix-index blocks no live table references (refcount 1 —
+        cached-but-idle). ``exclude`` masks blocks the caller is about
+        to adopt (adoption pins them, so they stop being reclaimable
+        the moment the admission that counted them proceeds)."""
+        ex = set(exclude)
+        return sum(1 for b in self._node_of_block
+                   if self._ref[b] == 1 and b not in ex)
+
+    def check_refcounts(self) -> None:
+        """Debug/test invariant: ``_ref`` must equal the reference
+        ground truth (table entries + index nodes) for every block, and
+        never go negative. Raises ``AssertionError`` on drift."""
+        want = [0] * self.pool_blocks
+        for t in self._tables.values():
+            for b in t:
+                want[b] += 1
+        for b in self._node_of_block:
+            want[b] += 1
+        assert self._ref == want, (
+            f"refcount drift: {[(b, self._ref[b], want[b]) for b in range(self.pool_blocks) if self._ref[b] != want[b]]}")
+        assert all(r >= 0 for r in self._ref)
+        assert self._in_use == len(self._live_blocks()), (
+            self._in_use, len(self._live_blocks()))
+        assert self.leaked_blocks() >= 0
 
     def blocks_for(self, n_tokens: int) -> int:
         return -(-n_tokens // self.block_size)
@@ -170,29 +271,286 @@ class PagedKVCache:
             raise ValueError(f"request {rid!r} already registered")
         self._tables[rid] = []
 
+    def _alloc_block(self) -> int:
+        b = self._free.pop()
+        self._ref[b] = 1
+        self._in_use += 1
+        return b
+
+    def _decref(self, b: int) -> None:
+        r = self._ref[b] - 1
+        if r < 0:
+            raise RuntimeError(
+                f"refcount underflow on block {b} — a release/evict "
+                "path double-freed a shared page")
+        self._ref[b] = r
+        if r == 0:
+            self._free.append(b)
+            self._in_use -= 1
+
+    def _exhausted_msg(self, rid, need: int) -> str:
+        """Occupancy breakdown so a preemption-storm post-mortem is
+        diagnosable straight off the flight recorder: live (table-
+        referenced) vs cached-but-idle shared-prefix vs free blocks."""
+        live = {b for t in self._tables.values() for b in t}
+        cached_idle = sum(1 for b in self._node_of_block if b not in live)
+        leaked = self.leaked_blocks()
+        return (
+            f"request {rid!r} needs {need} more block(s), pool has "
+            f"{len(self._free)} free — occupancy: "
+            f"{self.pool_blocks - 1} allocatable = {len(live)} live + "
+            f"{cached_idle} cached-prefix + {len(self._free)} free"
+            + (f" + {leaked} LEAKED" if leaked else ""))
+
     def ensure(self, rid, n_tokens: int) -> None:
-        """Grow ``rid``'s table to cover ``n_tokens`` positions; raises
+        """Grow ``rid``'s table to cover ``n_tokens`` positions with
+        FRESH (refcount-1, private) blocks; raises
         :class:`PoolExhausted` (allocating nothing) when the pool can't
-        — all-or-nothing so a failed grow never strands blocks."""
+        — all-or-nothing so a failed grow never strands blocks.
+        Cached-but-idle prefix pages are LRU-evicted first: the prefix
+        cache must never cause :class:`PoolExhausted` for live
+        traffic."""
         table = self._tables[rid]
         need = self.blocks_for(n_tokens) - len(table)
         if need <= 0:
             return
         if need > len(self._free):
+            self._evict_prefix(need - len(self._free))
+        if need > len(self._free):
             self._c_alloc_fail.inc()
-            raise PoolExhausted(
-                f"request {rid!r} needs {need} more block(s), pool has "
-                f"{len(self._free)} free")
+            raise PoolExhausted(self._exhausted_msg(rid, need))
         for _ in range(need):
-            table.append(self._free.pop())
+            table.append(self._alloc_block())
         self._g_in_use.set(self.blocks_in_use)
 
     def release(self, rid) -> None:
-        """Return every block of ``rid`` to the pool and drop its table
-        (request completion, preemption, replica drain)."""
+        """Drop ``rid``'s table, decrementing each block's refcount
+        (request completion, preemption, replica drain). A shared block
+        returns to the pool only at refcount 0 — pages still backing
+        the prefix index (or a sibling's table) stay resident."""
         table = self._tables.pop(rid)
-        self._free.extend(reversed(table))
+        for b in reversed(table):
+            self._decref(b)
         self._g_in_use.set(self.blocks_in_use)
+
+    def adopt_prefix(self, rid, blocks: List[int]) -> None:
+        """Seed ``rid``'s (empty) table with shared prefix pages from a
+        :meth:`match_prefix` hit — each gains a reference and becomes
+        read-only for this request until :meth:`ensure_writable` CoWs
+        it."""
+        table = self._tables[rid]
+        if table:
+            raise ValueError(
+                f"adopt_prefix needs an empty table; {rid!r} holds "
+                f"{len(table)} block(s)")
+        for b in blocks:
+            self._ref[b] += 1
+            table.append(b)
+        self._g_in_use.set(self.blocks_in_use)
+
+    def readopt_prefix(self, rid, blocks: List[int],
+                       first_block: int) -> int:
+        """Mid-prefill adoption: swap ``rid``'s table entries
+        ``[first_block, first_block + len(blocks))`` for shared pages a
+        SIBLING committed after this request was admitted — the
+        saturation shape, where everyone admits before anyone commits,
+        so the admission-time lookup alone would miss almost every
+        share. The displaced private blocks free immediately (or drop a
+        reference if they were themselves shared). The caller only
+        swaps entries at/above its prefill watermark: everything below
+        is already written and stays put."""
+        table = self._tables[rid]
+        swapped = 0
+        for i, b in enumerate(blocks):
+            bi = first_block + i
+            old = table[bi]
+            if old == b:
+                continue
+            self._ref[b] += 1
+            self._decref(old)
+            table[bi] = b
+            swapped += 1
+        if swapped:
+            self._g_in_use.set(self.blocks_in_use)
+        return swapped
+
+    def ensure_writable(self, rid, lo: int, hi: int) -> int:
+        """Copy-on-write every block covering token positions
+        ``[lo, hi)``: a table entry with refcount > 1 gets a fresh
+        block with the shared contents copied (dense and int8
+        ``_QuantSlot`` paths — k/v and their scales), the shared page's
+        refcount drops, and the table points at the private copy.
+        Returns the number of blocks copied. Raises
+        :class:`PoolExhausted` when no fresh block can be found even
+        after LRU eviction."""
+        if hi <= lo:
+            return 0
+        table = self._tables[rid]
+        copied = 0
+        for bi in range(lo // self.block_size,
+                        -(-hi // self.block_size)):
+            b = table[bi]
+            if self._ref[b] <= 1:
+                continue
+            if not self._free:
+                self._evict_prefix(1)
+            if not self._free:
+                self._c_alloc_fail.inc()
+                raise PoolExhausted(self._exhausted_msg(rid, 1))
+            nb = self._alloc_block()
+            st = self.state
+            self.state = PoolState(
+                k=st.k.at[:, nb].set(st.k[:, b]),
+                v=st.v.at[:, nb].set(st.v[:, b]),
+                k_scale=(None if st.k_scale is None
+                         else st.k_scale.at[:, nb].set(st.k_scale[:, b])),
+                v_scale=(None if st.v_scale is None
+                         else st.v_scale.at[:, nb].set(st.v_scale[:, b])),
+            )
+            self._decref(b)
+            table[bi] = nb
+            copied += 1
+        if copied:
+            self._g_in_use.set(self.blocks_in_use)
+        return copied
+
+    # -- radix prefix index -------------------------------------------------
+    def _touch(self) -> int:
+        self._lru_tick += 1
+        return self._lru_tick
+
+    def match_prefix(self, tokens,
+                     full_blocks_only: bool = False
+                     ) -> "tuple[List[int], int]":
+        """Longest committed prefix of ``tokens`` in the radix index.
+
+        Returns ``(blocks, n_tokens)``: a chain of full-block hits plus
+        optionally ONE divergence block matched on a partial leading
+        run (``n_tokens % block_size != 0`` then) — the caller adopts
+        the chain, CoWs the partial tail, and starts chunked prefill at
+        ``n_tokens``. Touches every matched node's LRU tick.
+        ``full_blocks_only`` skips the divergence scan (a numpy compare
+        over the deepest node's children) — the mid-prefill jump only
+        swaps whole blocks, so it never pays for a partial match."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        bs = self.block_size
+        node = self._root
+        blocks: List[int] = []
+        matched = 0
+        tick = self._touch()
+        while matched + bs <= tokens.size:
+            child = node.children.get(tokens[matched:matched + bs]
+                                      .tobytes())
+            if child is None:
+                break
+            child.tick = tick
+            blocks.append(child.block)
+            matched += bs
+            node = child
+        rem = tokens[matched:]
+        if rem.size and not full_blocks_only:
+            # divergence block: the child sharing the longest leading
+            # run with the remaining tokens (>= 1 token to be worth a
+            # CoW copy)
+            best, best_n = None, 0
+            for child in node.children.values():
+                m = min(rem.size, child.tokens.size)
+                n = int(np.cumprod(child.tokens[:m] == rem[:m]).sum())
+                if n > best_n:
+                    best, best_n = child, n
+            if best is not None:
+                best.tick = tick
+                blocks.append(best.block)
+                matched += best_n
+        return blocks, matched
+
+    def commit_prefix(self, rid, tokens, n_tokens: int) -> int:
+        """Publish ``rid``'s fully-written leading blocks (covering
+        ``tokens[:n_tokens]``) into the radix index; each inserted node
+        takes one reference on its block, keeping the page resident
+        after the request finishes (cached-but-idle, LRU-evictable).
+        Only FULL blocks are committed — a partial tail block is still
+        being written and never enters the index. Returns the number of
+        nodes inserted."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        bs = self.block_size
+        table = self._tables[rid]
+        node = self._root
+        inserted = 0
+        tick = self._touch()
+        for bi in range(n_tokens // bs):
+            seg = tokens[bi * bs:(bi + 1) * bs]
+            key = seg.tobytes()
+            child = node.children.get(key)
+            if child is None:
+                b = table[bi]
+                if b in self._node_of_block:
+                    # this physical page already backs a node on another
+                    # chain — cannot happen for content-addressed private
+                    # blocks; stop rather than alias two chains
+                    break
+                child = _PrefixNode(key, seg.copy(), b, node)
+                node.children[key] = child
+                self._node_of_block[b] = child
+                self._ref[b] += 1
+                inserted += 1
+            # an existing node may be backed by a DIFFERENT physical
+            # block (this request recomputed a prefix that was cached
+            # after its admission); the chain continues through the
+            # index's block — content-identical by construction
+            child.tick = tick
+            node = child
+        if inserted:
+            self.index_version += 1
+            self._g_prefix.set(len(self._node_of_block))
+        return inserted
+
+    def _evict_node(self, node: _PrefixNode) -> None:
+        """Drop one node (and its subtree, depth-first) from the index:
+        each dropped block loses the index's reference and frees at
+        refcount 0."""
+        for child in list(node.children.values()):
+            self._evict_node(child)
+        del node.parent.children[node.key]
+        del self._node_of_block[node.block]
+        self._decref(node.block)
+        self._c_prefix_evict.inc()
+
+    def _evict_prefix(self, want_free: int) -> int:
+        """LRU-evict cached-but-idle prefix subtrees until
+        ``want_free`` blocks came back to the free list or nothing
+        reclaimable remains. Victims are nodes whose block only the
+        index holds (refcount 1 — evicting anything else frees no
+        memory); a victim's descendants go with it (they are
+        unreachable without the parent edge), shared ones merely
+        leaving the index."""
+        freed0 = len(self._free)
+        # one snapshot, tick-sorted: eviction only ever REMOVES nodes
+        # (it can't mint new refcount-1 candidates with older ticks),
+        # so rescanning the whole index per evicted subtree would be
+        # O(k * index) for nothing — re-check each candidate instead
+        victims = sorted((n for n in self._node_of_block.values()
+                          if self._ref[n.block] == 1),
+                         key=lambda n: n.tick)
+        for n in victims:
+            if len(self._free) - freed0 >= want_free:
+                break
+            if self._node_of_block.get(n.block) is not n:
+                continue      # went down with an ancestor's subtree
+            self._evict_node(n)
+        self._g_prefix.set(len(self._node_of_block))
+        return len(self._free) - freed0
+
+    def drop_prefix_cache(self) -> int:
+        """Release every cached prefix page (tests, replica teardown,
+        the ``BYTEPS_SERVE_PREFIX_CACHE=0`` escape hatch); live tables
+        keep their references. Returns the number of nodes dropped."""
+        n = len(self._node_of_block)
+        for child in list(self._root.children.values()):
+            self._evict_node(child)
+        self._g_prefix.set(0)
+        self._g_in_use.set(self.blocks_in_use)
+        return n
 
     def table_row(self, rid, width: Optional[int] = None) -> np.ndarray:
         """``(width,)`` int32 physical-block row for the packed step
@@ -212,15 +570,18 @@ class PagedKVCache:
 
     def defrag(self) -> int:
         """Compact live blocks to the lowest physical ids (one device
-        gather per pool array), rewriting every table. Correctness
-        never needs this — tables make fragmentation invisible — but a
-        long-lived replica's pool walks toward high ids and compaction
-        restores allocation locality for the gather. Returns the number
-        of blocks moved."""
-        live = [b for t in self._tables.values() for b in t]
+        gather per pool array), rewriting every table, the prefix
+        index, and the refcounts. A SHARED page moves once and every
+        alias follows it — table aliasing and shared-page contents are
+        preserved exactly (pinned in tests/test_serve_prefix.py).
+        Correctness never needs this — tables make fragmentation
+        invisible — but a long-lived replica's pool walks toward high
+        ids and compaction restores allocation locality for the gather.
+        Returns the number of blocks moved."""
+        live = sorted(self._live_blocks())
         perm = np.arange(self.pool_blocks)
         moved = 0
-        for new_id, old_id in enumerate(sorted(live), start=1):
+        for new_id, old_id in enumerate(live, start=1):
             perm[new_id] = old_id
             if new_id != old_id:
                 moved += 1
@@ -228,7 +589,7 @@ class PagedKVCache:
             # already compact (free-list order may still differ; reset it)
             self._free = list(range(self.pool_blocks - 1, len(live), -1))
             return 0
-        remap = {old: new for new, old in enumerate(sorted(live), start=1)}
+        remap = {old: new for new, old in enumerate(live, start=1)}
         src = jnp.asarray(perm)
         self.state = PoolState(
             k=self.state.k[:, src],
@@ -240,6 +601,14 @@ class PagedKVCache:
         )
         for t in self._tables.values():
             t[:] = [remap[b] for b in t]
+        ref = [0] * self.pool_blocks
+        for old, new in remap.items():
+            ref[new] = self._ref[old]
+        self._ref = ref
+        self._node_of_block = {remap[b]: n
+                               for b, n in self._node_of_block.items()}
+        for new, node in self._node_of_block.items():
+            node.block = new
         self._free = list(range(self.pool_blocks - 1, len(live), -1))
         return moved
 
@@ -278,6 +647,11 @@ def make_paged_decode_fn(cfg: GPTConfig, block_size: int,
     their logits. The gathered key width is ``tables.shape[1] *
     block_size`` — callers pass width-bucketed tables so short requests
     don't pay max_seq-wide gathers, and jit retraces once per bucket.
+    Table rows may alias SHARED prefix pages (refcount > 1): those are
+    read-only by host contract — the scheduler CoWs the write-target
+    block (``ensure_writable``) before this step scatters into
+    ``tables[r][pos // bs]``, so the scatter below only ever lands in a
+    private block (or scratch).
     Dense-MLP GPT families only (the MoE block's no-drop capacity
     logic hasn't been paged yet — detected from the params and
     rejected loudly).
@@ -390,7 +764,9 @@ def make_paged_prefill_fn(cfg: GPTConfig, block_size: int, chunk_len: int,
     same computation a solo ``make_generate_fn`` prefill performs — and
     scatter the C newly written cache rows back into the pool. The
     dense view's length is ``table.shape[0] * block_size`` (callers
-    bucket W). Also the speculative verify forward: C proposed tokens
+    bucket W). Like the decode step, the table may alias shared prefix
+    pages below ``pos0`` — read via the gather only; the C written rows
+    land at/after ``pos0`` in blocks the host made private first. Also the speculative verify forward: C proposed tokens
     in, per-position logits out, and only the committed prefix of the
     written rows is ever counted live (the fill level rewinds exactly
     like ``speculative.py``'s cache contract). ``with_readout=False``

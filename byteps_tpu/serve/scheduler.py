@@ -8,7 +8,14 @@ four-phase iteration (``step()``):
 1. **Admission** — requests whose arrival time has passed join the
    running set as soon as a decode slot AND enough free KV blocks
    exist. FIFO in arrival order; preempted requests re-queue at the
-   FRONT (they are the oldest work).
+   FRONT (they are the oldest work). With the prefix cache on
+   (``BYTEPS_SERVE_PREFIX_CACHE``, default), admission first consults
+   the pool's radix index: a hit maps the request's leading table
+   entries to shared read-only pages (committed by earlier prefills),
+   CoWs the divergence block when the match ends mid-block, and starts
+   chunked prefill at the divergence — the shared chunks are skipped
+   entirely, which is where the shared-prefix TTFT headline comes from
+   (``bench.py --mode serve``, prefix leg).
 2. **Prefill** — one prompt chunk (``serve_prefill_chunk`` tokens) per
    iteration through the per-request paged prefill, so a long prompt
    interleaves with everyone else's decode steps instead of stalling
@@ -161,7 +168,7 @@ class _Run:
     __slots__ = ("req", "full_input", "emitted", "pending", "cache_len",
                  "prefill_done", "state", "t_submit", "t_origin", "t_admit",
                  "t_first", "t_last", "preemptions", "spec_rounds",
-                 "draft_cache", "tok_s")
+                 "draft_cache", "tok_s", "idx_seq")
 
     def __init__(self, req: Request, resume_tokens: List[int],
                  t_submit: float):
@@ -186,6 +193,9 @@ class _Run:
         self.spec_rounds = 0
         self.draft_cache = None
         self.tok_s: List[float] = []
+        # prefix-index version this run last matched against: the
+        # mid-prefill re-match is skipped until a new commit bumps it
+        self.idx_seq = -1
 
 
 class NoProgressError(RuntimeError):
@@ -207,6 +217,7 @@ class Scheduler:
                  pool_blocks: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
                  quant_cache: Optional[bool] = None,
+                 prefix_cache: Optional[bool] = None,
                  fault_plan: Optional[FaultPlan] = None,
                  replica_id: int = 0,
                  clock=time.monotonic):
@@ -220,6 +231,8 @@ class Scheduler:
         self.prefill_chunk = prefill_chunk if prefill_chunk is not None \
             else c.serve_prefill_chunk
         self.default_spec_len = c.serve_spec_len
+        self._prefix_on = prefix_cache if prefix_cache is not None \
+            else c.serve_prefix_cache
         quant = quant_cache if quant_cache is not None \
             else c.serve_quant_cache
         bs = block_size if block_size is not None else c.serve_block_size
@@ -264,6 +277,9 @@ class Scheduler:
             "decode_tokens": _reg.counter("serve.decode_tokens"),
             "spec_rounds": _reg.counter("serve.spec_rounds"),
             "spec_tokens": _reg.counter("serve.spec_tokens"),
+            "prefix_hits": _reg.counter("serve.prefix_hits"),
+            "prefix_misses": _reg.counter("serve.prefix_misses"),
+            "prefix_saved": _reg.counter("serve.prefix_saved_tokens"),
             "iterations": _reg.counter("serve.iterations"),
             "ttft_ms": _reg.histogram("serve.ttft_ms"),
             "token_ms": _reg.histogram("serve.token_ms"),
@@ -444,13 +460,23 @@ class Scheduler:
             {"replica": self.replica_id, "rid": str(run.req.rid),
              "emitted": len(run.emitted)})
 
-    def _ensure_or_preempt(self, run: _Run, n_tokens: int) -> bool:
-        """Grow ``run``'s block table to ``n_tokens``, preempting the
-        youngest admitted request as often as needed. Returns False when
-        ``run`` itself became the victim (the caller skips it)."""
+    def _ensure_or_preempt(self, run: _Run, n_tokens: int,
+                           write_lo: Optional[int] = None,
+                           write_hi: Optional[int] = None) -> bool:
+        """Grow ``run``'s block table to ``n_tokens`` — and, when a
+        write span is given, CoW any shared page inside it — preempting
+        the youngest admitted request as often as needed. Returns False
+        when ``run`` itself became the victim (the caller skips it).
+        The write span is belt-and-braces: scheduler writes only ever
+        target fresh or admission-CoW'd private blocks, but a shared
+        page must NEVER be scattered into, so the invariant is enforced
+        here rather than assumed."""
         while True:
             try:
                 self.cache.ensure(run.req.rid, n_tokens)
+                if write_lo is not None:
+                    self.cache.ensure_writable(run.req.rid, write_lo,
+                                               write_hi)
                 return True
             except PoolExhausted:
                 victim = None
@@ -515,7 +541,7 @@ class Scheduler:
         pol = run.req.spec
         K = pol.spec_len or self.default_spec_len
         pos0 = run.cache_len
-        if not self._ensure_or_preempt(run, pos0 + K):
+        if not self._ensure_or_preempt(run, pos0 + K, pos0, pos0 + K):
             return
         draft_len0 = None
         if pol.kind == "draft":
@@ -583,12 +609,65 @@ class Scheduler:
                and len(self._running) < self._admit_cap
                and self._waiting[0].req.arrival_s <= now):
             run = self._waiting[0]
-            need = self.cache.blocks_for(len(run.full_input) + 1)
-            if need > self.cache.free_blocks:
+            L = len(run.full_input)
+            hit_blocks: List[int] = []
+            hit_tokens = 0
+            if self._prefix_on:
+                # consult the radix index — capped at L-1 tokens so the
+                # final prefill chunk always runs (its last-position
+                # logits yield the first generated token / TTFT commit)
+                hit_blocks, hit_tokens = self.cache.match_prefix(
+                    run.full_input[:L - 1])
+                run.idx_seq = self.cache.index_version
+            partial = 1 if hit_tokens % self.cache.block_size else 0
+            need = (self.cache.blocks_for(L + 1) - len(hit_blocks)
+                    + partial)
+            if partial and need > (self.cache.free_blocks
+                                   + self.cache.reclaimable_blocks(
+                                       exclude=hit_blocks)):
+                # a partial-divergence hit costs one extra block (the
+                # CoW copy) AND pins an otherwise-evictable page — on a
+                # tight pool that can make admission infeasible where a
+                # cold admission would fit, forever (nothing running to
+                # free blocks). Drop the partial adoption; the
+                # full-block hit alone is never worse than cold.
+                hit_blocks = hit_blocks[:-1]
+                hit_tokens -= hit_tokens % self.cache.block_size
+                partial = 0
+                need = self.cache.blocks_for(L + 1) - len(hit_blocks)
+            if need > (self.cache.free_blocks
+                       + self.cache.reclaimable_blocks(
+                           exclude=hit_blocks)):
                 break
             self._waiting.popleft()
             self.cache.register(run.req.rid)
-            self.cache.ensure(run.req.rid, len(run.full_input) + 1)
+            try:
+                if hit_blocks:
+                    self.cache.adopt_prefix(run.req.rid, hit_blocks)
+                self.cache.ensure(run.req.rid, L + 1)
+                if partial:
+                    # the match ends mid-block: CoW the divergence
+                    # block so the request owns a private copy carrying
+                    # the shared KV below hit_tokens
+                    self.cache.ensure_writable(run.req.rid, hit_tokens,
+                                               hit_tokens + 1)
+            except PoolExhausted:
+                # the reclaimable estimate can be beaten by pathological
+                # tree shapes; roll the admission back losslessly and
+                # retry next iteration
+                self.cache.release(run.req.rid)
+                self._waiting.appendleft(run)
+                break
+            if self._prefix_on:
+                if hit_tokens:
+                    self._m["prefix_hits"].inc()
+                    self._m["prefix_saved"].inc(hit_tokens)
+                else:
+                    self._m["prefix_misses"].inc()
+            # a hit starts chunked prefill at the divergence — the
+            # shared chunks are never recomputed
+            run.prefill_done = hit_tokens
+            run.cache_len = hit_tokens
             run.state = "prefill"
             run.t_admit = now
             self._running.append(run)
@@ -600,10 +679,42 @@ class Scheduler:
         for run in list(self._running):
             if run.state != "prefill":
                 continue
+            L = len(run.full_input)
+            if (self._prefix_on and run.prefill_done < L - 1
+                    and run.idx_seq != self.cache.index_version):
+                # re-consult the index mid-prefill: at saturation every
+                # request admits before ANY has committed the shared
+                # prefix, so the admission lookup misses — but the
+                # oldest sibling prefills first and commits, and this
+                # jump maps its pages instead of recomputing them. The
+                # block at the watermark swaps too when matched (its
+                # written-so-far rows are content-identical by
+                # construction); prefill resumes at the match end.
+                # Gated on the index VERSION (bumped per commit) and
+                # matched full-blocks-only, so an unchanged index costs
+                # nothing and a re-match never pays the divergence scan.
+                bs = self.cache.block_size
+                run.idx_seq = self.cache.index_version
+                hit_blocks, hit_tokens = self.cache.match_prefix(
+                    run.full_input[:L - 1], full_blocks_only=True)
+                jump = hit_tokens
+                if jump > run.prefill_done:
+                    bp = run.prefill_done // bs
+                    self.cache.readopt_prefix(
+                        run.req.rid, hit_blocks[bp:jump // bs], bp)
+                    self._m["prefix_hits"].inc()
+                    self._m["prefix_saved"].inc(jump - run.prefill_done)
+                    run.prefill_done = jump
+                    run.cache_len = jump
             C = min(self.prefill_chunk,
                     len(run.full_input) - run.prefill_done)
             toks = run.full_input[run.prefill_done:run.prefill_done + C]
             final = run.prefill_done + C == len(run.full_input)
+            # the chunk scatters C rows — CoW any shared page in its
+            # span (a no-op by construction: admission already CoW'd
+            # the divergence block; enforced, not assumed)
+            self.cache.ensure_writable(run.req.rid, run.prefill_done,
+                                       run.prefill_done + C)
             # intermediate chunks skip the vocab readout — only the
             # final chunk's last-position logits are ever read
             logits, self.cache.state = self._prefill_fn(C, final)(
@@ -614,6 +725,13 @@ class Scheduler:
             run.prefill_done += C
             run.cache_len = run.prefill_done
             self._m["prefill_tokens"].inc(C)
+            if self._prefix_on:
+                # publish the newly fully-written leading blocks so the
+                # NEXT request sharing this prefix maps them instead of
+                # recomputing (refcount +1 per node keeps them resident
+                # after this request finishes — cached-but-idle, LRU)
+                self.cache.commit_prefix(run.req.rid, run.full_input,
+                                         run.prefill_done)
             progress = True
             if run.prefill_done == len(run.full_input):
                 # device-side last-position slice: only vocab floats
@@ -647,7 +765,8 @@ class Scheduler:
                 continue
             if len(packed) >= self.max_batch:
                 break
-            if self._ensure_or_preempt(run, run.cache_len + 1):
+            if self._ensure_or_preempt(run, run.cache_len + 1,
+                                       run.cache_len, run.cache_len + 1):
                 if run.state == "decode":     # survived any preemptions
                     packed.append(run)
         packed = [r for r in packed if r.state == "decode"]

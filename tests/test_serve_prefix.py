@@ -1,0 +1,434 @@
+"""Radix prefix cache over the paged KV pool (docs/serving.md §prefix
+cache): refcounted shared pages, copy-on-write divergence, LRU eviction
+of cached-but-idle pages.
+
+The acceptance bar mirrors the serve tier's: sharing changes where
+bytes live, never what attention reads — hot-cache greedy outputs are
+BIT-identical to cold runs and to solo ``make_generate_fn``; refcounts
+never underflow; ``leaked_blocks()`` is 0 at every point of any
+schedule; ``defrag()`` preserves shared-page contents and table
+aliasing; and cached pages never cause ``PoolExhausted`` for live
+traffic (they evict first)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from byteps_tpu.common.metrics import get_registry
+from byteps_tpu.models import GPTConfig, gpt_init
+from byteps_tpu.models.generate import make_generate_fn
+from byteps_tpu.serve import Request, Scheduler
+from byteps_tpu.serve.paged_cache import (
+    PagedKVCache,
+    PoolExhausted,
+    PoolState,
+)
+
+CFG = GPTConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt_init(jax.random.PRNGKey(0), CFG)
+
+
+def _solo(params, req):
+    gen = make_generate_fn(CFG, req.max_new)
+    out = gen(params, jnp.asarray(req.prompt)[None], jax.random.PRNGKey(0),
+              0.0)
+    return np.asarray(out)[0]
+
+
+def _stamp(cache, block, value):
+    """Write a recognizable constant into one pool block (all layers)."""
+    st = cache.state
+    cache.state = PoolState(
+        k=st.k.at[:, block].set(value),
+        v=st.v.at[:, block].set(-value),
+        k_scale=(None if st.k_scale is None
+                 else st.k_scale.at[:, block].set(float(value))),
+        v_scale=(None if st.v_scale is None
+                 else st.v_scale.at[:, block].set(float(value) + 0.5)),
+    )
+
+
+# ---- refcounts + sharing at the cache level ---------------------------------
+def test_shared_pages_refcount_and_release():
+    cache = PagedKVCache(CFG, block_size=4, pool_blocks=17, max_batch=2)
+    toks = np.arange(12, dtype=np.int32)
+    cache.register("a")
+    cache.ensure("a", 12)                    # 3 private blocks
+    cache.commit_prefix("a", toks, 12)       # all 3 published
+    assert cache.prefix_blocks == 3
+    # a second request adopting the chain shares the SAME physical pages
+    blocks, matched = cache.match_prefix(toks)
+    assert matched == 12
+    assert blocks == list(cache.table_row("a")[:3])
+    cache.register("b")
+    cache.adopt_prefix("b", blocks)
+    assert cache.blocks_in_use == 3          # distinct pages, counted once
+    cache.check_refcounts()
+    # releasing one sharer frees nothing (refcount > 0 remains)
+    cache.release("a")
+    assert cache.free_blocks == 16 - 3
+    cache.check_refcounts()
+    # releasing the other still keeps the pages: the index holds them
+    cache.release("b")
+    assert cache.free_blocks == 16 - 3 and cache.prefix_blocks == 3
+    assert cache.leaked_blocks() == 0
+    # dropping the cache returns every page
+    cache.drop_prefix_cache()
+    assert cache.free_blocks == 16 and cache.leaked_blocks() == 0
+    cache.check_refcounts()
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_copy_on_write_divergence(quant):
+    """A writer whose table entry has refcount > 1 gets a fresh block
+    with the shared contents copied — dense and int8 paths."""
+    cache = PagedKVCache(CFG, block_size=4, pool_blocks=9, max_batch=2,
+                         quant=quant)
+    toks = np.arange(4, dtype=np.int32)
+    cache.register("a")
+    cache.ensure("a", 4)
+    shared = int(cache.table_row("a")[0])
+    _stamp(cache, shared, 7)
+    cache.commit_prefix("a", toks, 4)
+    cache.register("b")
+    cache.adopt_prefix("b", [shared])
+    assert int(cache.table_row("b")[0]) == shared      # aliased
+    copied = cache.ensure_writable("b", 2, 3)
+    assert copied == 1
+    priv = int(cache.table_row("b")[0])
+    assert priv != shared                              # b owns a copy now
+    assert int(cache.table_row("a")[0]) == shared      # a untouched
+    # shared contents were copied, scales included on the int8 path
+    np.testing.assert_array_equal(np.asarray(cache.state.k[:, priv]),
+                                  np.asarray(cache.state.k[:, shared]))
+    np.testing.assert_array_equal(np.asarray(cache.state.v[:, priv]),
+                                  np.asarray(cache.state.v[:, shared]))
+    if quant:
+        np.testing.assert_array_equal(
+            np.asarray(cache.state.k_scale[:, priv]),
+            np.asarray(cache.state.k_scale[:, shared]))
+        np.testing.assert_array_equal(
+            np.asarray(cache.state.v_scale[:, priv]),
+            np.asarray(cache.state.v_scale[:, shared]))
+    cache.check_refcounts()
+    # a private entry is NOT copied again
+    assert cache.ensure_writable("b", 2, 3) == 0
+    cache.release("a")
+    cache.release("b")
+    assert cache.leaked_blocks() == 0
+
+
+def test_defrag_preserves_shared_contents_and_aliasing():
+    """defrag() moves a shared page ONCE and every alias follows it —
+    two tables plus the index keep pointing at identical bytes."""
+    cache = PagedKVCache(CFG, block_size=4, pool_blocks=33, max_batch=2)
+    toks = np.arange(8, dtype=np.int32)
+    # the filler occupies the low ids (LIFO free list), parking "a" on
+    # high ones so compaction has something to move
+    cache.register("filler")
+    cache.ensure("filler", 4 * 20)
+    cache.register("a")
+    cache.ensure("a", 8)
+    for b in cache.table_row("a")[:2]:
+        _stamp(cache, int(b), int(b))
+    cache.commit_prefix("a", toks, 8)
+    hit, matched = cache.match_prefix(toks)
+    assert matched == 8
+    cache.register("b")
+    cache.adopt_prefix("b", hit)
+    cache.release("filler")
+    before = {int(b): np.asarray(cache.state.k[:, int(b)])
+              for b in cache.table_row("a")[:2]}
+    assert cache.defrag() > 0
+    row_a = [int(x) for x in cache.table_row("a")[:2]]
+    row_b = [int(x) for x in cache.table_row("b")[:2]]
+    assert row_a == row_b, "defrag broke table aliasing"
+    hit2, matched2 = cache.match_prefix(toks)
+    assert matched2 == 8 and hit2 == row_a, "defrag broke the index"
+    for old, new in zip(sorted(before), row_a):
+        np.testing.assert_array_equal(before[old],
+                                      np.asarray(cache.state.k[:, new]))
+    cache.check_refcounts()
+    cache.release("a")
+    cache.release("b")
+    cache.drop_prefix_cache()
+    assert cache.leaked_blocks() == 0
+
+
+def test_lru_eviction_never_exhausts_live_traffic():
+    """Cached-but-idle prefix pages are LRU-evicted under pool pressure
+    — a pool FULL of cached pages still admits live work, and the
+    least-recently-touched chain goes first."""
+    cache = PagedKVCache(CFG, block_size=4, pool_blocks=9, max_batch=2)
+    old = np.arange(100, 116, dtype=np.int32)
+    new = np.arange(200, 216, dtype=np.int32)
+    for name, toks in (("old", old), ("new", new)):
+        cache.register(name)
+        cache.ensure(name, 16)
+        cache.commit_prefix(name, toks, 16)
+        cache.release(name)
+    assert cache.free_blocks == 0 and cache.prefix_blocks == 8
+    cache.match_prefix(new)                  # touch: "new" is now MRU
+    cache.register("live")
+    cache.ensure("live", 16)                 # evicts instead of raising
+    assert cache.table_len("live") == 4
+    assert get_registry().snapshot()["counters"][
+        "serve.prefix_evictions"] == 4
+    # the LRU chain ("old") was the victim; "new" survived
+    assert cache.match_prefix(old)[1] == 0
+    assert cache.match_prefix(new)[1] == 16
+    cache.check_refcounts()
+    assert cache.leaked_blocks() == 0
+    cache.release("live")
+    cache.drop_prefix_cache()
+    assert cache.free_blocks == 8
+
+
+def test_pool_exhausted_carries_occupancy_breakdown():
+    """The PoolExhausted message names live vs cached-prefix vs free
+    blocks so a preemption-storm post-mortem reads off the flight
+    recorder."""
+    cache = PagedKVCache(CFG, block_size=4, pool_blocks=9, max_batch=2)
+    cache.register("a")
+    cache.ensure("a", 24)                    # 6 live blocks
+    cache.commit_prefix("a", np.arange(8, dtype=np.int32), 8)
+    with pytest.raises(PoolExhausted, match=r"6 live"):
+        cache.ensure("a", 40)
+    with pytest.raises(PoolExhausted, match=r"2 free"):
+        cache.ensure("a", 40)
+    # all-or-nothing still holds
+    assert cache.table_len("a") == 6
+    cache.release("a")
+    # with "a" gone its committed pages read as cached-prefix
+    cache.register("b")
+    with pytest.raises(PoolExhausted, match=r"cached-prefix"):
+        # 2 cached pages are reclaimed, but 9 > 8 allocatable
+        cache.ensure("b", 36)
+    assert cache.leaked_blocks() == 0
+
+
+def test_randomized_schedule_refcount_invariants():
+    """Randomized admit/grow/adopt/commit/CoW/release/evict/defrag
+    schedule: refcounts never drift or underflow, leaked_blocks() == 0
+    at EVERY point, and the pool drains clean."""
+    rng = np.random.default_rng(1234)
+    cache = PagedKVCache(CFG, block_size=4, pool_blocks=65, max_batch=8)
+    # small corpus of base sequences → real prefix overlap
+    bases = [rng.integers(0, 64, 16).astype(np.int32) for _ in range(3)]
+    live = {}
+    next_rid = 0
+    for _ in range(400):
+        op = rng.choice(["admit", "grow", "commit", "cow", "release",
+                         "defrag", "drop"],
+                        p=[0.3, 0.2, 0.2, 0.1, 0.12, 0.05, 0.03])
+        if op == "admit":
+            base = bases[rng.integers(len(bases))]
+            toks = np.concatenate(
+                [base[:rng.integers(4, 17)],
+                 rng.integers(0, 64, rng.integers(0, 8)).astype(np.int32)])
+            rid = f"r{next_rid}"
+            next_rid += 1
+            hit, matched = cache.match_prefix(toks)
+            try:
+                cache.register(rid)
+                if hit:
+                    cache.adopt_prefix(rid, hit)
+                cache.ensure(rid, toks.size)
+                if matched % 4:
+                    cache.ensure_writable(rid, matched, matched + 1)
+            except PoolExhausted:
+                cache.release(rid)
+            else:
+                live[rid] = toks
+        elif op == "grow" and live:
+            rid = list(live)[rng.integers(len(live))]
+            try:
+                cache.ensure(rid, min(CFG.max_seq,
+                                      live[rid].size
+                                      + int(rng.integers(1, 9))))
+            except PoolExhausted:
+                pass
+        elif op == "commit" and live:
+            rid = list(live)[rng.integers(len(live))]
+            n = min(live[rid].size, cache.table_len(rid) * 4)
+            cache.commit_prefix(rid, live[rid], n)
+        elif op == "cow" and live:
+            rid = list(live)[rng.integers(len(live))]
+            n = cache.table_len(rid) * 4
+            lo = int(rng.integers(0, n))
+            try:
+                cache.ensure_writable(rid, lo,
+                                      min(n, lo + int(rng.integers(1, 6))))
+            except PoolExhausted:
+                pass
+        elif op == "release" and live:
+            rid = list(live)[rng.integers(len(live))]
+            cache.release(rid)
+            del live[rid]
+        elif op == "defrag":
+            cache.defrag()
+        elif op == "drop":
+            cache.drop_prefix_cache()
+        cache.check_refcounts()
+        assert cache.leaked_blocks() == 0
+    for rid in list(live):
+        cache.release(rid)
+    cache.drop_prefix_cache()
+    cache.check_refcounts()
+    assert cache.leaked_blocks() == 0
+    assert cache.free_blocks == cache.pool_blocks - 1
+
+
+# ---- exactness at the scheduler level ---------------------------------------
+def test_hot_cache_bit_identical_to_cold_and_solo(params):
+    """The tentpole pin: greedy outputs with the prefix cache HOT are
+    bit-identical to cold runs, to prefix-cache-off runs, and to solo
+    make_generate_fn — sharing changes where bytes live, never what
+    attention reads."""
+    rng = np.random.default_rng(41)
+    shared = rng.integers(0, CFG.vocab_size, 13).astype(np.int32)
+    reqs = []
+    for i in range(4):
+        tail = rng.integers(0, CFG.vocab_size, 2 + i).astype(np.int32)
+        reqs.append(Request(rid=f"h{i}",
+                            prompt=np.concatenate([shared, tail]),
+                            max_new=6))
+
+    def serve_all(sched):
+        out = {}
+        for r in reqs:
+            out.update(sched.serve([Request(rid=r.rid, prompt=r.prompt,
+                                            max_new=r.max_new)]))
+        return out
+
+    hot_sched = Scheduler(params, CFG, max_batch=2, prefill_chunk=4,
+                          block_size=4, prefix_cache=True)
+    cold = serve_all(Scheduler(params, CFG, max_batch=2, prefill_chunk=4,
+                               block_size=4, prefix_cache=False))
+    warm1 = serve_all(hot_sched)    # first pass populates the index
+    warm2 = serve_all(hot_sched)    # second pass is fully hot
+    for r in reqs:
+        want = _solo(params, r)
+        np.testing.assert_array_equal(cold[r.rid]["tokens"], want)
+        np.testing.assert_array_equal(warm1[r.rid]["tokens"], want)
+        np.testing.assert_array_equal(warm2[r.rid]["tokens"], want)
+    snap = get_registry().snapshot()["counters"]
+    assert snap["serve.prefix_hits"] >= 4
+    assert snap["serve.prefix_saved_tokens"] > 0
+    hot_sched.cache.check_refcounts()
+    assert hot_sched.cache.leaked_blocks() == 0
+
+
+def test_partial_hit_never_blocks_admission_cold_would_pass(params):
+    """Regression: a partial-divergence hit costs one extra block (the
+    CoW copy) and pins an otherwise-evictable cached page — on a tight
+    pool that made admission permanently infeasible where a cold
+    admission fit, spinning to NoProgressError. Admission must drop the
+    partial adoption and fall back to the full-block hit (never worse
+    than cold)."""
+    rng = np.random.default_rng(59)
+    a_prompt = rng.integers(0, CFG.vocab_size, 12).astype(np.int32)
+    # shares A's first 5 tokens: 1 full block + 1 partial token
+    b_prompt = np.concatenate(
+        [a_prompt[:5],
+         rng.integers(0, CFG.vocab_size, 24).astype(np.int32)])
+    sched = Scheduler(params, CFG, max_batch=2, prefill_chunk=8,
+                      block_size=4, pool_blocks=1 + 8)
+    ra = Request(rid="a", prompt=a_prompt, max_new=4)
+    rb = Request(rid="b", prompt=b_prompt, max_new=3)   # needs all 8 blocks
+    res = sched.serve([ra])
+    res.update(sched.serve([rb]))                       # must not deadlock
+    for r in (ra, rb):
+        np.testing.assert_array_equal(res[r.rid]["tokens"],
+                                      _solo(params, r))
+    sched.cache.check_refcounts()
+    assert sched.cache.leaked_blocks() == 0
+
+
+def test_concurrent_admission_jumps_mid_prefill(params):
+    """The saturation shape: every request admits before ANY commits
+    the shared prefix, so admission lookups all miss — the mid-prefill
+    re-match maps the oldest sibling's freshly-committed pages and
+    jumps the prefill watermark over them. Outputs stay bit-exact."""
+    rng = np.random.default_rng(53)
+    shared = rng.integers(0, CFG.vocab_size, 16).astype(np.int32)
+    reqs = [Request(rid=f"c{i}",
+                    prompt=np.concatenate(
+                        [shared,
+                         rng.integers(0, CFG.vocab_size, 3).astype(
+                             np.int32)]),
+                    max_new=5) for i in range(3)]
+    sched = Scheduler(params, CFG, max_batch=4, prefill_chunk=4,
+                      block_size=4)
+    res = sched.serve(reqs)          # all submitted at once
+    for r in reqs:
+        np.testing.assert_array_equal(res[r.rid]["tokens"],
+                                      _solo(params, r))
+    snap = get_registry().snapshot()["counters"]
+    # everyone admitted cold...
+    assert snap["serve.prefix_misses"] == 3
+    # ...but the two younger siblings still mapped the shared pages
+    assert snap["serve.prefix_hits"] >= 2
+    assert snap["serve.prefix_saved_tokens"] >= 2 * 16
+    # skipped volume is real: computed prefill == prompts - saved
+    assert snap["serve.prefill_tokens"] == \
+        sum(len(r.prompt) for r in reqs) - snap["serve.prefix_saved_tokens"]
+    sched.cache.check_refcounts()
+    assert sched.cache.leaked_blocks() == 0
+
+
+def test_preempt_resume_shares_own_prefix(params):
+    """Preemption + resume release and re-adopt pages through the same
+    refcount path — and a resumed request HITS its own committed
+    prefix, so recompute-on-resume skips the shared chunks. Outputs
+    stay exact; zero leaks."""
+    rng = np.random.default_rng(43)
+    shared = rng.integers(0, CFG.vocab_size, 12).astype(np.int32)
+    reqs = [Request(rid=f"p{i}",
+                    prompt=np.concatenate(
+                        [shared,
+                         rng.integers(0, CFG.vocab_size, 2).astype(
+                             np.int32)]),
+                    max_new=10) for i in range(2)]
+    # tight enough to force preemption even WITH the prefix shared
+    # (each request peaks at 7 blocks, 3 of them shareable)
+    sched = Scheduler(params, CFG, max_batch=2, prefill_chunk=8,
+                      block_size=4, pool_blocks=1 + 8)
+    res = sched.serve(reqs)
+    for r in reqs:
+        np.testing.assert_array_equal(res[r.rid]["tokens"],
+                                      _solo(params, r))
+    assert sum(res[r.rid]["preemptions"] for r in reqs) > 0, \
+        "pool was large enough that preemption never engaged"
+    sched.cache.check_refcounts()
+    assert sched.cache.leaked_blocks() == 0
+    snap = get_registry().snapshot()["counters"]
+    assert snap["serve.prefix_hits"] > 0
+
+
+def test_prefix_cache_off_escape_hatch(params, monkeypatch):
+    """BYTEPS_SERVE_PREFIX_CACHE=0 disables sharing entirely: no hits,
+    no index pages, outputs unchanged."""
+    monkeypatch.setenv("BYTEPS_SERVE_PREFIX_CACHE", "0")
+    from byteps_tpu.common.config import reset_config
+    reset_config()
+    rng = np.random.default_rng(47)
+    shared = rng.integers(0, CFG.vocab_size, 12).astype(np.int32)
+    sched = Scheduler(params, CFG, max_batch=2, block_size=4)
+    for i in range(2):
+        prompt = np.concatenate(
+            [shared, rng.integers(0, CFG.vocab_size, 2).astype(np.int32)])
+        req = Request(rid=f"o{i}", prompt=prompt, max_new=5)
+        res = sched.serve([req])
+        np.testing.assert_array_equal(res[f"o{i}"]["tokens"],
+                                      _solo(params, req))
+    assert sched.cache.prefix_blocks == 0
+    snap = get_registry().snapshot()["counters"]
+    assert snap.get("serve.prefix_hits", 0) == 0
+    assert sched.cache.free_blocks == sched.cache.pool_blocks - 1
