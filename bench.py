@@ -2247,6 +2247,238 @@ def bench_chaos(payload_mb: int = 8, rounds: int = 4, reps: int = 3) -> dict:
                     k0 / legs[f"f{factor}_k{K}"]["sec_per_round"], 3)
         results["staleness"][cname] = legs
 
+    # ---- churn leg (scale-up elasticity): 2→4→3→5 join/leave schedule ----
+    # Mid-stream JOIN as a first-class protocol event (kJoin, ROADMAP
+    # item 4): the job starts with workers {0,1}, grows to {0,1,2,3}
+    # (two FRESH ids admitted mid-stream — the server's membership table
+    # and per-key vectors grow), shrinks to {0,2,3} (worker1:kill + the
+    # lease eviction), then grows to {0,1,2,3,4} (the evicted id
+    # re-admitted beside another fresh one). The whole schedule lives in
+    # the fault grammar — joins fire through each joiner's own
+    # worker<N>:join plan on its first wire op, the death through the
+    # victim's worker1:kill, and churn_events() reads the same string
+    # back for the orchestration. Goodput per phase = live ×
+    # worker-rounds/sec off the median round time (transition rounds at
+    # each phase head excluded: join adoption and the eviction stall are
+    # membership events, not steady-state goodput). The per-worker CLEAN
+    # goodput is measured per live count by a static-membership LADDER
+    # (all N workers present from the start, same payload/server):
+    # emulating N workers in ONE process shares a GIL and one loopback,
+    # so absolute round time grows with N — the ladder controls that
+    # CPU-twin artifact away and the headline isolates what ELASTICITY
+    # itself adds (epoch churn, adoption checks, stall leakage).
+    # churn_goodput_tracking = mean_p[goodput_p / (live_p × per-worker
+    # clean goodput at live_p)] = mean_p[med_ladder(live_p) / med_p] —
+    # 1.0 means a mid-stream-grown membership runs as fast as one born
+    # at that size.
+    from byteps_tpu.common.autoscaler import record_decision
+    from byteps_tpu.common.faults import (
+        FaultPlan,
+        WorkerKilledError,
+        churn_events,
+        parse_fault_spec,
+    )
+    from byteps_tpu.server import PSWorker
+
+    ch_elems = (1 << 20) // 4   # 1 MiB gradient per worker per round
+    ch_rounds = 8               # rounds per phase
+    ch_lease = 500
+    ch_phases = [("2w", (0, 1)), ("4w", (0, 1, 2, 3)),
+                 ("3w", (0, 2, 3)), ("5w", (0, 1, 2, 3, 4))]
+    ch_target = len(ch_phases) * ch_rounds
+    # the victim's op count through phases 2w+4w: init + 2 ops/round
+    kill_step = 1 + 2 * (2 * ch_rounds) + 1
+    ch_spec = ("worker2:join@step=1;worker3:join@step=1;"
+               f"worker1:kill@step={kill_step}..;"
+               "worker1:join@step=1;worker4:join@step=1")
+    ch_schedule = churn_events(parse_fault_spec(ch_spec))
+    ch_rng = np.random.default_rng(11)
+    ch_vec = {w: ch_rng.standard_normal(ch_elems).astype(np.float32)
+              for w in range(5)}
+    ch_skip = 3  # transition/warmup rounds excluded at each phase head
+
+    def _member_body(wid, servers, n_rounds, round_ts, errs, spec,
+                     health_ms=100):
+        # every worker heartbeats (the monitor's ping keeps its lease
+        # alive while it sits blocked in a pull across the eviction
+        # stall) EXCEPT the victim: pings tick its fault plan, and the
+        # kill step must stay the deterministic op count of its own
+        # data-plane schedule
+        plan = (FaultPlan(parse_fault_spec(spec), seed=0, worker_id=wid)
+                if spec else None)
+        w = PSWorker(servers=servers, worker_id=wid, fault_plan=plan,
+                     health_interval_ms=health_ms)
+        try:
+            w.init_key(0, ch_elems * 4)  # a join rule fires before this
+            while True:
+                v = w.push(0, ch_vec[wid])
+                w.pull(0, ch_elems, v)
+                if wid == 0:
+                    round_ts.append(time.perf_counter())
+                if v >= n_rounds:
+                    return
+        except WorkerKilledError:
+            return  # the grammar-scheduled mid-stream death
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            errs.append((wid, e))
+        finally:
+            if wid == 0:
+                w.shutdown()
+            else:
+                w.close()
+
+    # clean ladder: static membership of n workers, same payload/server
+    # shape — the per-live-count goodput baseline the churn phases are
+    # judged against
+    ladder_med = {}
+    for n in sorted({len(ids) for _, ids in ch_phases}):
+        p0 = base_port + run_id * 2
+        run_id += 1
+        cfg = _dc.replace(
+            base_cfg, num_worker=n, num_server=1,
+            worker_lease_ms=ch_lease, retry_limit=8, retry_backoff_ms=10,
+        )
+        config_mod.set_config(cfg)
+        start_server(port=p0, num_workers=n, engine_threads=4,
+                     async_mode=False, lease_ms=ch_lease)
+        servers_n = [("127.0.0.1", p0)]
+        ts_n, errs_n = [], []
+        threads_n = [
+            threading.Thread(target=_member_body,
+                             args=(wid, servers_n, ch_rounds, ts_n,
+                                   errs_n, ""))
+            for wid in range(n)
+        ]
+        t0_n = time.perf_counter()
+        try:
+            for t in threads_n:
+                t.start()
+            for t in threads_n:
+                t.join(timeout=300)
+                assert not t.is_alive(), f"ladder {n}w worker hung"
+            if errs_n:
+                raise errs_n[0][1]
+        finally:
+            stop_server()
+            config_mod.reset_config()
+        durs_n = np.diff([t0_n] + ts_n)
+        ladder_med[n] = float(np.median(durs_n[ch_skip:]))
+        _log(f"chaos churn ladder {n}w clean: "
+             f"{ladder_med[n] * 1e3:6.1f} ms/round")
+
+    # the churn run itself
+    p0 = base_port + run_id * 2
+    run_id += 1
+    cfg = _dc.replace(
+        base_cfg, num_worker=2, num_server=1,
+        worker_lease_ms=ch_lease, retry_limit=8, retry_backoff_ms=10,
+        fault_seed=0,
+    )
+    config_mod.set_config(cfg)
+    start_server(port=p0, num_workers=2, engine_threads=4,
+                 async_mode=False, lease_ms=ch_lease)
+    ch_servers = [("127.0.0.1", p0)]
+    round_ts = []    # worker 0 stamps each completed global round
+    ch_errs = []
+
+    def churn_body(wid, spec, health_ms=100):
+        _member_body(wid, ch_servers, ch_target, round_ts, ch_errs,
+                     spec, health_ms)
+
+    def _await_round(n, timeout=180):
+        deadline = time.time() + timeout
+        while time.time() < deadline and len(round_ts) < n:
+            time.sleep(0.002)
+        if len(round_ts) < n:
+            raise RuntimeError(
+                f"churn leg stalled before round {n} "
+                f"(completed {len(round_ts)}; errors {ch_errs})")
+
+    ch_threads = {}
+    t_start = time.perf_counter()
+    try:
+        for wid, spec, hb in ((0, "", 100),
+                              (1, f"worker1:kill@step={kill_step}..",
+                               0)):
+            ch_threads[wid] = threading.Thread(
+                target=churn_body, args=(wid, spec, hb))
+            ch_threads[wid].start()
+        _await_round(ch_rounds)            # phase 2w complete
+        for wid in (2, 3):
+            record_decision("train", "admit",
+                            "churn schedule: fresh worker joins "
+                            "mid-stream", target=wid, live=4)
+            ch_threads[wid] = threading.Thread(
+                target=churn_body,
+                args=(wid, f"worker{wid}:join@step=1"))
+            ch_threads[wid].start()
+        _await_round(2 * ch_rounds)        # phase 4w complete; the
+        # victim's kill rule fires on its next push and the lease
+        # eviction shrinks the membership — record WHY through the
+        # shared decision path, like the serve router's lease sweep
+        record_decision("train", "evict",
+                        "churn schedule: worker1:kill + lease eviction",
+                        target=1, live=3)
+        _await_round(3 * ch_rounds)        # phase 3w complete
+        record_decision("train", "admit",
+                        "churn schedule: evicted id re-admitted",
+                        target=1, live=5)
+        ch_threads["1b"] = threading.Thread(
+            target=churn_body, args=(1, "worker1:join@step=1"))
+        ch_threads["1b"].start()
+        record_decision("train", "admit",
+                        "churn schedule: fresh worker joins mid-stream",
+                        target=4, live=5)
+        ch_threads[4] = threading.Thread(
+            target=churn_body, args=(4, "worker4:join@step=1"))
+        ch_threads[4].start()
+        for t in ch_threads.values():
+            t.join(timeout=300)
+            assert not t.is_alive(), "churn leg worker thread hung"
+        if ch_errs:
+            raise ch_errs[0][1]
+        assert len(round_ts) == ch_target, (len(round_ts), ch_target)
+    finally:
+        stop_server()
+        config_mod.reset_config()
+
+    durs = []
+    t_prev = t_start
+    for ts in round_ts:
+        durs.append(ts - t_prev)
+        t_prev = ts
+    ch_stats = []
+    for p, (pname, live_ids) in enumerate(ch_phases):
+        window = durs[p * ch_rounds + ch_skip:(p + 1) * ch_rounds]
+        med = float(np.median(window))
+        clean = ladder_med[len(live_ids)]
+        ch_stats.append({
+            "phase": pname, "live": len(live_ids),
+            "workers": sorted(live_ids),
+            "sec_per_round_med": round(med, 5),
+            "sec_spread": [round(min(window), 5),
+                           round(max(window), 5)],
+            "clean_ladder_sec_per_round": round(clean, 5),
+            "goodput_worker_rounds_per_s": round(len(live_ids) / med, 2),
+            "tracking": round(clean / med, 3),
+        })
+        _log(f"chaos churn {pname:>3} live={len(live_ids)}: "
+             f"{med * 1e3:6.1f} ms/round vs clean {clean * 1e3:.1f}, "
+             f"tracking {ch_stats[-1]['tracking']:.3f}")
+    churn_tracking = float(np.mean([s["tracking"] for s in ch_stats]))
+    results["churn"] = {
+        "spec": ch_spec,
+        "schedule": [list(e) for e in ch_schedule],
+        "rounds_per_phase": ch_rounds,
+        "transition_rounds_excluded": ch_skip,
+        "payload_mb": round(ch_elems * 4 / (1 << 20), 3),
+        "lease_ms": ch_lease,
+        "clean_ladder": {str(n): round(v, 5)
+                         for n, v in sorted(ladder_med.items())},
+        "phases": ch_stats,
+        "goodput_tracking": round(churn_tracking, 3),
+    }
+
     # headline: under the 5x straggler, how much of the cliff does
     # bounded staleness win back (worst codec, best K>=1)
     straggler_ratio = min(
@@ -2267,7 +2499,9 @@ def bench_chaos(payload_mb: int = 8, rounds: int = 4, reps: int = 3) -> dict:
                    "lease, survivor vs clean 2-worker baseline — and the "
                    "bounded-staleness slow-worker leg: worker1:slow "
                    "straggler at {0,2,5}x the median step x "
-                   "BYTEPS_STALENESS K in {0,1,4})"),
+                   "BYTEPS_STALENESS K in {0,1,4} — and the scale-up "
+                   "churn leg: a 2→4→3→5 mid-stream join/leave schedule "
+                   "via the fault grammar's worker<N>:join/kill rules)"),
         "value": worst,
         "unit": "x of clean goodput (worst chaos config)",
         "vs_baseline": worst,
@@ -2275,6 +2509,11 @@ def bench_chaos(payload_mb: int = 8, rounds: int = 4, reps: int = 3) -> dict:
         # at best K>=1 over K=0 under the 5x straggler (worst codec);
         # acceptance bar >= 2x, floor-gated via BENCH_trend.json
         "straggler_ratio": round(straggler_ratio, 3),
+        # scale-up elasticity: goodput tracking the live worker count
+        # through the 2→4→3→5 mid-stream join/leave schedule (mean over
+        # phases of goodput_phase / (live × per-worker clean goodput));
+        # acceptance bar >= 0.7, floor-gated via BENCH_trend.json
+        "churn_goodput_tracking": round(churn_tracking, 3),
         "payload_mb": payload_mb,
         "rounds_per_rep": rounds,
         "reps": reps,
@@ -2426,6 +2665,7 @@ _TREND_SPECS = (
     ("BENCH_hybrid.json", "value"),
     ("BENCH_chaos.json", "value"),
     ("BENCH_chaos.json", "straggler_ratio"),
+    ("BENCH_chaos.json", "churn_goodput_tracking"),
     ("BENCH_serve.json", "value"),
     ("BENCH_serve.json", "prefix_ttft_p50_speedup"),
     ("BENCH_ici.json", "ring_vs_staged_best"),

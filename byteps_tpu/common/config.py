@@ -186,6 +186,26 @@ class Config:
     # K=0 = today's synchronous tier, bit-identical; BYTEPS_ENABLE_ASYNC
     # is the K=inf limit and wins when both are set.
     staleness: int = 0
+    # --- autoscaler policy defaults (common/autoscaler.py) -----------------
+    # One ScalingPolicy class drives BOTH elasticity domains: train
+    # worker admit/evict off the telemetry registry (goodput/worker
+    # trend, server.staleness p99, rounds_ahead straggler spread) and
+    # serve replica spawn/drain off queue depth + TTFT. These knobs are
+    # the shared decision dynamics; the load thresholds themselves are
+    # per-policy constructor arguments (their units differ per domain).
+    # Relative dead band around each threshold — decisions fire only
+    # OUTSIDE load*(1±hysteresis), so a load oscillating on a threshold
+    # cannot flap the membership.
+    autoscale_hysteresis: float = 0.1
+    # Policy steps to HOLD after any admit/evict (lets the epoch bump,
+    # shard remap, and goodput trend settle before the next decision).
+    autoscale_cooldown: int = 3
+    # Consecutive out-of-band samples required before acting ("sustained
+    # goodput headroom", not one lucky step).
+    autoscale_sustain: int = 2
+    # Unit-count bounds the policy will never cross.
+    autoscale_min: int = 1
+    autoscale_max: int = 16
 
     # --- telemetry plane (docs/observability.md) ---------------------------
     # Always-on metrics registry (common/metrics.py): counters, gauges,
@@ -314,6 +334,12 @@ class Config:
             worker_lease_ms=_env_int("BYTEPS_WORKER_LEASE_MS", 0),
             handle_deadline_ms=_env_int("BYTEPS_HANDLE_DEADLINE_MS", 0),
             staleness=max(0, _env_int("BYTEPS_STALENESS", 0)),
+            autoscale_hysteresis=_env_float("BYTEPS_AUTOSCALE_HYSTERESIS",
+                                            0.1),
+            autoscale_cooldown=_env_int("BYTEPS_AUTOSCALE_COOLDOWN", 3),
+            autoscale_sustain=_env_int("BYTEPS_AUTOSCALE_SUSTAIN", 2),
+            autoscale_min=_env_int("BYTEPS_AUTOSCALE_MIN", 1),
+            autoscale_max=_env_int("BYTEPS_AUTOSCALE_MAX", 16),
             metrics_on=_env_bool("BYTEPS_METRICS_ON", True),
             flight_recorder_steps=_env_int("BYTEPS_FLIGHT_RECORDER_STEPS",
                                            64),

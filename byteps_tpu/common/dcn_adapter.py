@@ -485,6 +485,19 @@ class DcnCore:
             return plan.codec.decode(buf, p.length, seed)
         return plan.decode_pull(buf, p.length, seed)
 
+    # -- elasticity ---------------------------------------------------------
+    def join(self) -> int:
+        """Mid-stream scale-UP: run the kJoin admission handshake on
+        every controller NIC (:meth:`PSWorker.join` — admission + round-
+        watermark adoption, all NICs under the pod's shared worker id),
+        so a fresh or previously-evicted pod enters a running job at a
+        round boundary. Returns the adopted live pod count — what the
+        caller's data-shard reassignment and LR/batch rescale hooks
+        consume (``data.ElasticShardMap``, ``jax.linear_scale``)."""
+        for w in self.workers:
+            w.join()
+        return self.live_size()
+
     # -- observability ------------------------------------------------------
     def live_size(self) -> int:
         """Live worker (pod) count per the most recently adopted

@@ -31,6 +31,15 @@ Spec grammar (semicolon-separated rules)::
              # attempts pays 80 ms) while its peers run clean — the
              # bounded-staleness bench's slow-worker leg
     kind   = 'timeout' | 'kill' | 'slow' | 'corrupt' | 'down' | 'hang'
+           | 'join'
+             # 'join' (worker/worker<N> scopes only, deterministic —
+             # requires step=, no p=): the worker runs the kJoin
+             # mid-stream admission handshake (PSWorker.join: admission
+             # + round-watermark adoption) once, when its plan step
+             # first enters the window, then the intercepted op
+             # proceeds under the adopted membership — the churn
+             # bench/tests schedule deterministic mid-stream joins with
+             # 'worker<N>:join@step=A'
     cond   = 'p=' FLOAT          # per-op Bernoulli (seeded RNG)
            | 'op=' A ['..' [B]]  # plan-op window, inclusive; open end ok
            | 'step=' ...         # alias of op=
@@ -85,10 +94,10 @@ log = get_logger("faults")
 __all__ = [
     "FaultRule", "FaultPlan", "Injection", "InjectedTimeout",
     "InjectedConnectionError", "ServerDownError", "WorkerKilledError",
-    "parse_fault_spec", "rules_to_spec", "plan_from_env",
+    "parse_fault_spec", "rules_to_spec", "plan_from_env", "churn_events",
 ]
 
-KINDS = ("timeout", "kill", "slow", "corrupt", "down", "hang")
+KINDS = ("timeout", "kill", "slow", "corrupt", "down", "hang", "join")
 SCOPES = ("push", "pull", "all", "init", "worker")
 
 
@@ -241,6 +250,11 @@ def parse_fault_spec(spec: str) -> List[FaultRule]:
                 raise ValueError(
                     "'hang' simulates a worker wedging and only takes "
                     "the 'worker'/'worker<N>' scopes (worker:hang@...)")
+            if kind == "join" and scope != "worker":
+                raise ValueError(
+                    "'join' is a mid-stream worker admission and only "
+                    "takes the 'worker'/'worker<N>' scopes "
+                    "(worker2:join@step=12)")
             p = None
             window = None
             latency_ms = 300000 if kind == "hang" else 50
@@ -265,6 +279,13 @@ def parse_fault_spec(spec: str) -> List[FaultRule]:
                     raise ValueError(
                         f"unknown fault condition {k!r} (expected "
                         "p=|op=|step=|ms=)")
+            if kind == "join" and (window is None or p is not None):
+                # joins are a deterministic SCHEDULE, not weather: the
+                # churn harness derives thread start/stop from the
+                # windows, so a probabilistic or bare join is a spec bug
+                raise ValueError(
+                    "'join' fires deterministically: give a step= "
+                    "window (e.g. worker2:join@step=12), not p=")
             if p is None and window is None:
                 # bare rule: always fires (e.g. 'server1:down')
                 window = (0, None)
@@ -281,6 +302,22 @@ def rules_to_spec(rules: List[FaultRule]) -> str:
     """Inverse of :func:`parse_fault_spec` (each rule via
     :meth:`FaultRule.to_spec`) — pinned by the grammar round-trip test."""
     return ";".join(r.to_spec() for r in rules)
+
+
+def churn_events(rules: List[FaultRule]) -> List[Tuple[int, int, str]]:
+    """The deterministic membership SCHEDULE encoded by a spec's
+    worker-scoped ``join``/``kill`` rules: ``[(step, worker_id, kind)]``
+    sorted by window start. This is what a churn harness (the
+    ``bench.py --mode chaos`` churn leg, elasticity tests) drives worker
+    thread start/stop from — the same string each worker's plan parses,
+    read once at the orchestration layer."""
+    out = [
+        (r.window[0], r.worker if r.worker is not None else -1, r.kind)
+        for r in rules
+        if r.scope == "worker" and r.kind in ("join", "kill")
+        and r.window is not None
+    ]
+    return sorted(out)
 
 
 class FaultPlan:

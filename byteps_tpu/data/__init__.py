@@ -1,6 +1,11 @@
+from byteps_tpu.data.elastic import (
+    ElasticShardMap,
+    live_ids_from_bitmap,
+)
 from byteps_tpu.data.loader import (
     PrefetchLoader,
     shard_batch,
 )
 
-__all__ = ["PrefetchLoader", "shard_batch"]
+__all__ = ["ElasticShardMap", "PrefetchLoader", "live_ids_from_bitmap",
+           "shard_batch"]

@@ -111,6 +111,9 @@ class _BytePSJaxState:
         self.psworkers: List[Any] = []
         self.owners: Optional[OwnerTable] = None
         self.owner_failovers = 0
+        # scale-up elasticity: hooks fired with the live pod count after
+        # join() adopts a membership change (shard remap, LR rescale)
+        self.membership_hooks: List[Any] = []
         # bumped (under lock) by _fail_owner's EF/momentum reset; a
         # COMPRESS that read its state before the bump must not write the
         # stale residual back after it (see _compress_stage)
@@ -339,6 +342,7 @@ def shutdown() -> None:
     _state.ef_state.clear()
     _state.mom_state.clear()
     _state.inited_keys.clear()
+    _state.membership_hooks.clear()
 
 
 def _require_init() -> None:
@@ -781,6 +785,52 @@ def _live_size() -> int:
         return size()
     return pod_size() * max(1, min(w.live_pods()
                                    for w in _state.psworkers))
+
+
+# -- scale-up elasticity (mid-stream join; docs/robustness.md §scale-up) -----
+def on_membership_change(hook) -> None:
+    """Register ``hook(live_pods)`` to run after this process adopts a
+    membership change through :func:`join`. This is where the elastic
+    data-shard reassignment (``byteps_tpu.data.ElasticShardMap.assign``
+    over the live set) and the LR/batch rescale policy
+    (:func:`linear_scale`) hang — the framework owns the protocol event,
+    the hooks own the training-semantics response."""
+    _require_init()
+    _state.membership_hooks.append(hook)
+
+
+def join() -> int:
+    """Mid-stream scale-UP: admit this worker into a RUNNING job — the
+    counterpart of the eviction/rejoin machinery. Runs the kJoin
+    admission + kRounds watermark adoption on every live summation
+    server for each controller NIC (all share the pod's worker id), so
+    the pod enters at a round boundary: the membership epoch bumps
+    (peers adopt it on their next op and rescale their averaging
+    divisor), rounds open at admission close over their contributors,
+    and this pod's first push continues the server's round sequence at
+    the served-round frontier. Fires the registered
+    :func:`on_membership_change` hooks with the adopted live pod count
+    and returns it. On the collectives-only path (no PS tier) the hooks
+    still fire — membership there is ``jax.distributed``'s problem, but
+    shard/LR policies remain the caller's."""
+    _require_init()
+    if _state.psworkers:
+        for w in _state.psworkers:
+            w.join()
+    live = _live_size()
+    for hook in list(_state.membership_hooks):
+        hook(live)
+    return live
+
+
+def linear_scale(base: float, base_live: int, live: int) -> float:
+    """The standard linear LR/batch rescale policy for elastic
+    membership (Goyal et al.'s linear scaling rule applied to the LIVE
+    worker count): ``base`` was tuned at ``base_live`` participants, the
+    job now has ``live`` — scale proportionally. Offered as the default
+    :func:`on_membership_change` policy; jobs with warmup or LARS-style
+    schedules plug their own."""
+    return base * (live / max(1, base_live))
 
 
 def _average_h2d(task: PartitionTask, out: jnp.ndarray) -> jnp.ndarray:

@@ -24,13 +24,28 @@ single-threaded by design (one ``run()`` loop steps every replica
 round-robin): replica parallelism in a real deployment is process- or
 host-level, and this in-process form is what the bench and the chaos
 pins drive deterministically.
+
+The router also closes the scale-UP loop (docs/robustness.md §scale-up
+elasticity): construct it with a
+:class:`~byteps_tpu.common.autoscaler.ScalingPolicy` and a ``spawn``
+callback and it runs one policy tick per step — the SAME policy class
+that drives train-worker admission observes per-replica queue depth +
+TTFT-SLO pressure, spawns replicas on ``admit`` and drains the
+least-loaded one on ``evict``; every decision (the lease sweep's
+evictions included) flows through the shared ``autoscaler.decisions``
+event path, so train and serve share one elasticity story.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
+from byteps_tpu.common.autoscaler import (
+    ScalingPolicy,
+    record_decision,
+    serve_sample,
+)
 from byteps_tpu.common.config import get_config
 from byteps_tpu.common.faults import WorkerKilledError
 from byteps_tpu.common.flight_recorder import get_flight_recorder
@@ -50,9 +65,35 @@ class Router:
 
     def __init__(self, replicas: List[Scheduler],
                  lease_ms: Optional[int] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 policy: Optional[ScalingPolicy] = None,
+                 spawn: Optional[Callable[[], Scheduler]] = None,
+                 ttft_slo_ms: Optional[float] = None):
+        """``policy``/``spawn`` arm replica AUTOSCALING: the same
+        :class:`~byteps_tpu.common.autoscaler.ScalingPolicy` class that
+        drives train-worker admit/evict observes per-replica queue depth
+        (+ TTFT-SLO pressure when ``ttft_slo_ms`` is set, off the
+        ``serve.ttft_ms`` histogram, WINDOWED per tick — see
+        :meth:`_autoscale`) once per :meth:`step`; an ``admit`` spawns a
+        replica via ``spawn()``, an ``evict`` DRAINS the least-loaded
+        one (its unfinished requests re-queue to the survivors — the
+        lease-eviction mechanics, minus the death). A policy without a
+        ``spawn`` callback — or one allowed to evict the last replica —
+        would RECORD decisions the router cannot execute (phantom
+        admits in the post-mortem, cooldowns armed for nothing), so
+        both are rejected up front."""
         if not replicas:
             raise ValueError("router needs at least one replica")
+        if policy is not None:
+            if spawn is None:
+                raise ValueError(
+                    "a Router policy needs a spawn callback: the policy "
+                    "records every decision it makes, and an admit the "
+                    "router cannot execute would be a phantom event")
+            if policy.min_units < 1:
+                raise ValueError(
+                    "Router policy min_units must be >= 1: the router "
+                    "cannot drain its last replica")
         self.replicas = list(replicas)
         self.lease_ms = lease_ms if lease_ms is not None \
             else get_config().serve_replica_lease_ms
@@ -63,12 +104,21 @@ class Router:
         self._live = set(range(len(replicas)))
         self.epoch = 0
         self.results: Dict[Any, Dict[str, Any]] = {}
+        self._policy = policy
+        self._spawn = spawn
+        self._ttft_slo_ms = ttft_slo_ms
+        # (count, sum) of serve.ttft_ms at the previous autoscale tick:
+        # SLO pressure is computed over the DELTA, not the process-
+        # lifetime histogram — a cold-start spike must stop inflating
+        # the load signal as soon as fresh traffic is healthy
+        self._ttft_mark = (0, 0.0)
         _reg = get_registry()
         self._m_dispatch = _reg.counter("serve.router.dispatched")
         self._m_evict = _reg.counter("serve.router.evictions")
         self._m_requeued = _reg.counter("serve.router.requeued")
         self._g_epoch = _reg.gauge("serve.router.epoch")
         self._g_live = _reg.gauge("serve.router.live_replicas")
+        self._h_ttft = _reg.histogram("serve.ttft_ms")
         self._g_live.set(len(self._live))
 
     # -- dispatch -----------------------------------------------------------
@@ -113,6 +163,7 @@ class Router:
             self._beat[i] = now
         self._collect()
         self.sweep()
+        self._autoscale()
         return progress
 
     def sweep(self) -> None:
@@ -132,6 +183,13 @@ class Router:
                 "serve.replica_evicted",
                 {"replica": i, "epoch": self.epoch,
                  "requeued": len(incomplete)})
+            # the ONE shared decision path (common/autoscaler.py): lease
+            # evictions and policy decisions land in the same counters/
+            # FAULT instants, so a post-mortem shows WHY a replica left
+            record_decision(
+                "serve", "evict",
+                f"lease-expired ({self.lease_ms} ms silent)",
+                target=i, live=len(self._live))
             log.warning(
                 "serve router: replica %d lease expired (epoch -> %d), "
                 "re-queueing %d request(s)", i, self.epoch,
@@ -143,6 +201,77 @@ class Router:
                         "request(s) and no survivor remains")
                 self.submit(req, resume_tokens=emitted)
                 self._m_requeued.inc()
+
+    # -- replica autoscaling (common/autoscaler.py) --------------------------
+    def add_replica(self, sched: Scheduler) -> int:
+        """Bring a freshly spawned replica into the routing set (the
+        serve-side JOIN: epoch bump so results stamp the new topology,
+        lease seeded now). Returns its index."""
+        self.replicas.append(sched)
+        i = len(self.replicas) - 1
+        self._beat[i] = self._clock()
+        self._live.add(i)
+        self.epoch += 1
+        self._g_epoch.set(self.epoch)
+        self._g_live.set(len(self._live))
+        log.info("serve router: replica %d admitted (epoch -> %d)", i,
+                 self.epoch)
+        return i
+
+    def drain_replica(self, i: int) -> int:
+        """Voluntarily retire replica ``i``: remove it from the live set
+        (epoch bump) and re-queue its unfinished requests onto the
+        survivors — the lease-eviction mechanics without the death, so
+        drained requests keep their committed tokens (recompute-on-
+        resume). Returns how many requests moved. The CALLER records the
+        decision (policy evictions already did via ``observe``)."""
+        if i not in self._live:
+            raise ValueError(f"replica {i} is not live")
+        if len(self._live) <= 1:
+            raise NoLiveReplicasError(
+                f"cannot drain replica {i}: it is the last live replica")
+        self._live.discard(i)
+        self.epoch += 1
+        self._g_epoch.set(self.epoch)
+        self._g_live.set(len(self._live))
+        incomplete = self.replicas[i].drain_incomplete()
+        for req, emitted in incomplete:
+            self.submit(req, resume_tokens=emitted)
+            self._m_requeued.inc()
+        log.info(
+            "serve router: replica %d drained (epoch -> %d), "
+            "%d request(s) re-queued", i, self.epoch, len(incomplete))
+        return len(incomplete)
+
+    def _autoscale(self) -> None:
+        """One policy tick per router step: observe per-replica queue
+        depth (+ TTFT-SLO pressure over the ticks' DELTA of the
+        ``serve.ttft_ms`` histogram — the registry histogram is
+        process-cumulative, and a lifetime p99 would carry a cold-start
+        spike forever; the windowed mean resets with the traffic) and
+        execute the decision."""
+        if self._policy is None:
+            return
+        depth = sum(self.replicas[i].load for i in self._live)
+        snap = self._h_ttft.snapshot()
+        count = int(snap.get("count", 0))
+        total = float(snap.get("sum", 0.0))
+        dc = count - self._ttft_mark[0]
+        ds = total - self._ttft_mark[1]
+        self._ttft_mark = (count, total)
+        ttft_ms = ds / dc if dc > 0 else 0.0
+        d = self._policy.observe(serve_sample(
+            live=len(self._live), queue_depth=depth,
+            ttft_p99_ms=ttft_ms,
+            ttft_slo_ms=self._ttft_slo_ms))
+        if d.action == "admit":
+            self.add_replica(self._spawn())
+        elif d.action == "evict" and len(self._live) > 1:
+            # drain the LEAST-loaded live replica (cheapest to move);
+            # ties break toward the newest index
+            target = min(sorted(self._live, reverse=True),
+                         key=lambda i: self.replicas[i].load)
+            self.drain_replica(target)
 
     def _collect(self) -> None:
         """DRAIN newly completed results up to the router (stamped with

@@ -72,6 +72,14 @@ from byteps_tpu.common.stage_orders import SERVER_STAGE_ORDER  # noqa: F401,E402
 # per-NIC metric series (wire.nic<N>.*) beside the process aggregates.
 _NIC_SEQ = itertools.count()
 
+# Per-server epochs of (epoch -> live count) divisor history retained in
+# PSWorker._epoch_live: under churn every membership change adds an entry
+# forever, so entries older than the newest adopted epoch minus this
+# window are pruned (a response for a round >window epochs stale falls
+# back to the currently adopted live count — by then the round snapshot
+# itself has long been overwritten).
+_EPOCH_LIVE_WINDOW = 64
+
 
 def wire_crc32(buf) -> int:
     """CRC32 as carried in the frame header: 0 means 'unchecked', so the
@@ -331,15 +339,18 @@ class PSWorker:
         # --- robustness state (docs/robustness.md) -------------------------
         self._plan = (fault_plan if fault_plan is not None
                       else plan_from_env(cfg, worker_id=self._worker_id))
-        # CRC is forced on while LOSS/CORRUPTION injection is armed:
+        # CRC is forced on while CORRUPTION injection is armed:
         # corruption must be *detected* to be retryable instead of
-        # silently summed. A pure-latency plan (only 'slow' rules — the
-        # bounded-staleness straggler leg) loses and corrupts nothing,
-        # so it does not force the 2×-per-payload software CRC pass onto
-        # every worker sharing the spec string.
+        # silently summed. Every other kind needs no checksum — loss
+        # kinds (timeout/kill/down) are caught by the rc/desync
+        # classification and the version dedupe, latency ('slow') and
+        # control ('join'/'hang') kinds touch no payload — so they do
+        # not force the 2×-per-payload software CRC pass onto every
+        # worker sharing the spec string (the churn/straggler legs
+        # would otherwise measure CRC overhead, not elasticity).
         self._crc = bool(cfg.wire_crc) or (
             self._plan is not None
-            and any(r.kind != "slow" for r in self._plan.rules))
+            and any(r.kind == "corrupt" for r in self._plan.rules))
         self._retry_limit = max(0, cfg.retry_limit)
         self._backoff_ms = max(1, cfg.retry_backoff_ms)
         # bounded staleness (BYTEPS_STALENESS): armed here so pull_bytes
@@ -373,11 +384,15 @@ class PSWorker:
         # injected self-death (worker:kill) / wedge window (worker:hang)
         self._self_killed = False
         self._wedged_until = 0.0
+        # one-shot latch for the worker<N>:join fault rule: a join window
+        # wider than one op must not re-run the admission handshake on
+        # every subsequent wire attempt
+        self._join_fired = False
         self.counters: Dict[str, int] = {
             "retries": 0, "timeouts": 0, "conn_errors": 0,
             "crc_errors": 0, "reinits": 0, "give_ups": 0,
             "failovers": 0, "ici_fallbacks": 0,
-            "membership_events": 0, "rejoins": 0,
+            "membership_events": 0, "rejoins": 0, "joins": 0,
         }
         self._counter_lock = threading.Lock()
         # --- always-on metrics registry (docs/observability.md) ------------
@@ -494,6 +509,15 @@ class PSWorker:
                 raise InjectedTimeout(
                     f"injected: worker {self._worker_id} wedged for "
                     f"{inj.rule.latency_ms} ms during {op}")
+            if inj.kind == "join":
+                # deterministic mid-stream admission (worker<N>:join@
+                # step=A): run the kJoin handshake once, then let the
+                # intercepted op proceed under the adopted membership —
+                # the churn bench/tests schedule joins this way
+                if not self._join_fired:
+                    self._join_fired = True
+                    self.join()
+                return None
             # other kinds under worker scope fall through to the generic
             # handling below (e.g. worker:timeout = lose own responses)
         if inj.kind == "down":
@@ -647,7 +671,7 @@ class PSWorker:
         evicted_self = bool(self._worker_id < len(bits)
                             and bits[self._worker_id] == 0)
         with self._vlock:
-            self._epoch_live[(sidx, q_epoch16)] = max(1, int(live_count))
+            self._record_epoch_live(sidx, q_epoch16, int(live_count))
             seen = self._epoch_seen.get(sidx, 0)
             if (q_epoch16 == seen
                     or ((q_epoch16 - seen) & 0xFFFF) >= 0x8000):
@@ -663,6 +687,29 @@ class PSWorker:
             "worker(s)%s", q_epoch16, sidx, live_count,
             " — THIS worker is evicted (rejoin on next push)"
             if evicted_self else "")
+
+    def _record_epoch_live(self, sidx: int, epoch16: int,
+                           live: int) -> None:
+        """Record the (epoch -> live count) divisor pair for ``sidx`` and
+        PRUNE entries older than the recorded epoch minus
+        ``_EPOCH_LIVE_WINDOW`` (mod-2^16 window, same arithmetic as the
+        adoption ordering): under churn every membership change adds an
+        entry forever, and a long-lived worker would otherwise grow this
+        dict without bound. Caller holds ``_vlock``."""
+        self._epoch_live[(sidx, epoch16 & 0xFFFF)] = max(1, int(live))
+        # keep only entries within ±window of the recorded epoch: a
+        # bare backward-window test would strand entries a large epoch
+        # jump pushed onto the "future" half of the mod-2^16 ring —
+        # they would then never age out (the unbounded growth this
+        # prune exists to stop)
+        stale = [
+            k for k in self._epoch_live
+            if k[0] == sidx
+            and ((epoch16 - k[1]) & 0xFFFF) >= _EPOCH_LIVE_WINDOW
+            and ((k[1] - epoch16) & 0xFFFF) >= _EPOCH_LIVE_WINDOW
+        ]
+        for k in stale:
+            del self._epoch_live[k]
 
     def _live_at(self, sidx: int, epoch16: int) -> int:
         """Live worker count at ``epoch16`` on server ``sidx`` — the
@@ -730,6 +777,54 @@ class PSWorker:
                             sidx, type(e).__name__, e)
         self._count("rejoins")
         self._trace_fault("rejoin", servers=live)
+
+    def join(self) -> int:
+        """First-class mid-stream ADMISSION (kJoin) — the scale-UP
+        counterpart of :meth:`rejoin`: register this worker id with
+        every live server. A FRESH id (beyond ``DMLC_NUM_WORKER``) grows
+        the server's membership table and per-key round vectors before
+        the admission is published, so the join lands at a round
+        boundary: the epoch bumps (stamped in every response — peers
+        adopt it on their next op and rescale their averaging divisor),
+        rounds open at admission close over their contributors
+        (quorum-scaled), and this worker adopts round watermarks
+        (``kRounds``) so its first mint continues at the served-round
+        frontier — under ``BYTEPS_STALENESS`` that frontier never trails
+        the force-close watermark. A previously evicted id re-admits the
+        same way. Returns the number of servers that admitted us; raises
+        :class:`NoLiveServersError` when none did (a joiner with no
+        quorum cannot contribute)."""
+        with self._vlock:
+            live = sorted(self._live)
+        joined = []
+        for sidx in live:
+            try:
+                if self._is_local(sidx):
+                    rc = int(load_lib().bps_server_join(self._worker_id))
+                    if rc < 0:
+                        raise RuntimeError(
+                            f"local join failed (rc={rc})")
+                else:
+                    self._conn(sidx).join(self._worker_id)
+                self.sync_rounds(sidx)
+                self._note_epoch(sidx)
+                joined.append(sidx)
+            except Exception as e:  # noqa: BLE001 - mirror rejoin(): a
+                # dead server must not block admission by the live
+                # quorum; its own failover/recovery path owns it, and
+                # its later recovery re-admits us via the eviction →
+                # inline-rejoin handshake
+                log.warning("join against server %d failed: %s: %s",
+                            sidx, type(e).__name__, e)
+        if not joined:
+            raise NoLiveServersError(
+                f"worker {self._worker_id} could not join any summation "
+                "server")
+        self._count("joins")
+        self._trace_fault("join", servers=joined)
+        log.info("worker %d joined mid-stream via server(s) %s",
+                 self._worker_id, joined)
+        return len(joined)
 
     # -- connection management ----------------------------------------------
     def _conn(self, sidx: int) -> NativeClient:

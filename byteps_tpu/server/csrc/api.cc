@@ -29,6 +29,15 @@ int bps_server_members(uint64_t* epoch, uint32_t* live_count,
   return bps::ServerMembers(epoch, live_count, bitmap, cap);
 }
 
+// Mid-stream worker admission against the in-process server (the IPC
+// analog of kJoin; scale-up elasticity). Returns the post-admission
+// epoch, or negative (-1 out of range, -2 fixed membership, -10 no
+// server in this process).
+int64_t bps_server_join(int worker) {
+  if (worker < 0 || worker > 0xFFFF) return -1;
+  return bps::ServerJoin(static_cast<uint16_t>(worker));
+}
+
 void bps_server_wait() { bps::WaitServer(); }
 
 void bps_server_stop() { bps::StopServer(); }
@@ -215,6 +224,14 @@ int bps_client_members(void* client, uint64_t* epoch, uint32_t* live_count,
 int bps_client_rounds(void* client, void* out, uint64_t cap,
                       uint64_t* got) {
   return static_cast<bps::Client*>(client)->Rounds(out, cap, got);
+}
+
+// Mid-stream worker admission (kJoin): a fresh worker id (the server
+// grows its membership table) or a previously evicted/departed one is
+// admitted at a round boundary; *out_epoch receives the post-admission
+// epoch. Adopt round watermarks (bps_client_rounds) before pushing.
+int bps_client_join(void* client, int worker_id, uint64_t* out_epoch) {
+  return static_cast<bps::Client*>(client)->Join(worker_id, out_epoch);
 }
 
 const char* bps_client_last_error(void* client) {

@@ -259,4 +259,18 @@ int Client::Rounds(void* out, uint64_t cap, uint64_t* got) {
                    nullptr);
 }
 
+int Client::Join(int worker_id, uint64_t* out_epoch) {
+  // range-checked BEFORE the uint16 wire encoding: a truncated id would
+  // silently admit a DIFFERENT worker (65536 -> wid 1 -> worker 0).
+  // Mirrors the bps_server_join IPC check; -8 = invalid argument.
+  if (worker_id < 0 || worker_id > 0xFFFE) return -8;
+  std::lock_guard<std::mutex> lk(mu_);
+  const uint16_t wid = static_cast<uint16_t>(worker_id + 1);
+  uint64_t ep = 0;
+  int rc = Roundtrip(kJoin, 0, 0, nullptr, 0, nullptr, 0, nullptr, 0,
+                     wid, &ep);
+  if (rc == 0 && out_epoch != nullptr) *out_epoch = ep;
+  return rc;
+}
+
 }  // namespace bps

@@ -78,6 +78,14 @@ class Client {
   // Per-key round watermarks (u64 key, u64 round, u64 nbytes triples)
   // into `out` (cap bytes); *got = actual bytes. The rejoin handshake.
   int Rounds(void* out, uint64_t cap, uint64_t* got);
+  // Mid-stream worker ADMISSION (kJoin; scale-up elasticity): admit
+  // `worker_id` — a fresh id (the server grows its membership table) or
+  // a previously evicted/departed one — at a round boundary. *out_epoch
+  // (optional) receives the post-admission membership epoch. The caller
+  // must adopt round watermarks (Rounds) before pushing. Returns -8 for
+  // an id outside [0, 0xFFFE] (it would truncate in the wire encoding
+  // and admit a DIFFERENT worker).
+  int Join(int worker_id, uint64_t* out_epoch = nullptr);
   // Membership epoch (low 16 bits) carried by the LAST response this
   // client parsed — workers poll it per op to detect membership changes
   // without an extra round trip.

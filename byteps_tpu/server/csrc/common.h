@@ -29,6 +29,14 @@
 //             u32 live_count | u32 num_workers | u8 live[num_workers]
 //   kRounds   -> kResp, payload = (u64 key, u64 round, u64 nbytes)*
 //             for every key store — the rejoin round-watermark handshake
+//   kJoin     reserved = worker_id + 1: first-class mid-stream ADMISSION.
+//             A fresh id (>= the configured worker count — the membership
+//             table GROWS) or a previously evicted/departed one is
+//             admitted at a round boundary: epoch bump, open rounds close
+//             over their contributors (quorum-scaled), the joiner adopts
+//             round watermarks via kRounds before pushing. -> kAck with
+//             version = post-admission epoch, or kErr (id out of range /
+//             fixed membership)
 //
 // Every server->worker frame carries the current membership EPOCH in the
 // header's reserved field (low 16 bits): workers learn of membership
@@ -71,6 +79,7 @@ enum Cmd : uint8_t {
   kPing = 9,      // clock-offset probe / worker lease heartbeat
   kMembers = 10,  // membership query: epoch + live worker bitmap
   kRounds = 11,   // per-key round watermarks (rejoin adoption)
+  kJoin = 12,     // mid-stream worker admission (scale-up elasticity)
 };
 
 #pragma pack(push, 1)

@@ -180,6 +180,144 @@ int main() {
     }
   }
 
+  // mid-stream JOIN under live traffic (scale-up elasticity, mirror of
+  // the lease-eviction phase): workers 0/1 stream rounds of key 4000
+  // while a FRESH worker id 2 — beyond the configured count, so the
+  // membership table and every key store's per-worker vectors GROW —
+  // joins (kJoin), adopts the round watermark (kRounds), and contributes
+  // every remaining round; a fourth thread hammers Join/Members
+  // idempotently against the same growth. Values are not asserted (the
+  // join boundary quorum-scales rounds by design); completion without a
+  // hang/race is the property.
+  {
+    const uint64_t key = 4000;
+    {
+      bps::Client init;
+      if (init.Connect("127.0.0.1", kPort, 5000, 30000) != 0 ||
+          init.InitKey(key, kElems * 4) != 0) {
+        std::fprintf(stderr, "join phase: init failed\n");
+        failures.fetch_add(1);
+      }
+      // re-admit BOTH base workers BEFORE any concurrent traffic: the
+      // lease phase above deliberately evicted worker 1, and a round
+      // closed over the pre-readmit live set {0} would shift the round
+      // numbering under worker 1's first push (a deterministic stale
+      // reject, not the race under test — the JOIN races, these don't)
+      int64_t sns = 0, rtt = 0;
+      init.Ping(&sns, &rtt, 0);
+      init.Ping(&sns, &rtt, 1);
+    }
+    auto pusher = [&failures, key](int wid) {
+      bps::Client c;
+      if (c.Connect("127.0.0.1", kPort, 5000, 60000) != 0) {
+        std::fprintf(stderr, "join phase: pusher connect failed\n");
+        failures.fetch_add(1);
+        return;
+      }
+      std::vector<float> data(kElems, 1.0f + wid);
+      std::vector<float> out(kElems);
+      for (int r = 1; r <= kRounds; ++r) {
+        if (c.Push(key, data.data(), kElems * 4, 0, wid,
+                   static_cast<uint64_t>(r)) != 0) {
+          std::fprintf(stderr, "join phase: pusher push failed\n");
+          failures.fetch_add(1);
+          return;
+        }
+        uint64_t got = 0;
+        if (c.Pull(key, out.data(), kElems * 4, static_cast<uint64_t>(r),
+                   0, &got, false, nullptr, wid) != 0 ||
+            got != kElems * 4) {
+          std::fprintf(stderr, "join phase: pusher pull failed\n");
+          failures.fetch_add(1);
+          return;
+        }
+      }
+    };
+    auto joiner = [&failures, key] {
+      bps::Client c;
+      if (c.Connect("127.0.0.1", kPort, 5000, 60000) != 0) {
+        std::fprintf(stderr, "join phase: joiner connect failed\n");
+        failures.fetch_add(1);
+        return;
+      }
+      if (c.Join(2) != 0) {
+        std::fprintf(stderr, "join phase: kJoin failed\n");
+        failures.fetch_add(1);
+        return;
+      }
+      std::vector<float> data(kElems, 9.0f);
+      std::vector<float> out(kElems);
+      uint64_t v = 0;
+      for (;;) {
+        // adopt (or re-adopt) the round watermark; a push refused as
+        // stale — its round closed in the publish window before our
+        // first contribution landed — re-syncs and continues, the
+        // worker-side rejoin contract
+        uint8_t buf[24 * 64];
+        uint64_t got = 0;
+        if (c.Rounds(buf, sizeof(buf), &got) != 0) {
+          std::fprintf(stderr, "join phase: kRounds failed\n");
+          failures.fetch_add(1);
+          return;
+        }
+        v = 0;
+        for (uint64_t off = 0; off + 24 <= got; off += 24) {
+          uint64_t k = 0, round = 0;
+          std::memcpy(&k, buf + off, 8);
+          std::memcpy(&round, buf + off + 8, 8);
+          if (k == key) v = round;
+        }
+        bool resync = false;
+        for (uint64_t r = v + 1; r <= kRounds; ++r) {
+          int rc = c.Push(key, data.data(), kElems * 4, 0, /*worker=*/2,
+                          r);
+          if (rc == 1) {  // kErr: stale round — re-adopt and go again
+            resync = true;
+            break;
+          }
+          if (rc != 0) {
+            std::fprintf(stderr, "join phase: joiner push failed\n");
+            failures.fetch_add(1);
+            return;
+          }
+          uint64_t got2 = 0;
+          if (c.Pull(key, out.data(), kElems * 4, r, 0, &got2, false,
+                     nullptr, 2) != 0 ||
+              got2 != kElems * 4) {
+            std::fprintf(stderr, "join phase: joiner pull failed\n");
+            failures.fetch_add(1);
+            return;
+          }
+        }
+        if (!resync) return;
+      }
+    };
+    auto rejoiner = [&failures] {
+      // idempotent re-admissions of the SAME id + membership queries
+      // racing the growth (id 2, not a fresh one: a live-but-silent
+      // extra member would strand every later round by design)
+      bps::Client c;
+      if (c.Connect("127.0.0.1", kPort, 5000, 10000) != 0) return;
+      for (int i = 0; i < 50; ++i) {
+        uint64_t ep = 0;
+        if (c.Join(2, &ep) != 0) {
+          std::fprintf(stderr, "join phase: re-join failed\n");
+          failures.fetch_add(1);
+          return;
+        }
+        uint32_t live = 0, nw = 0;
+        uint8_t bitmap[32] = {0};
+        c.Members(&ep, &live, &nw, bitmap, sizeof(bitmap));
+      }
+    };
+    std::vector<std::thread> jt;
+    jt.emplace_back(pusher, 0);
+    jt.emplace_back(pusher, 1);
+    jt.emplace_back(joiner);
+    jt.emplace_back(rejoiner);
+    for (auto& t : jt) t.join();
+  }
+
   // concurrent Stop vs live traffic: the hardest teardown paths (listener
   // shutdown, conn fd shutdown under send, engine drain) race real pushes
   {

@@ -165,7 +165,8 @@ enum TraceStage : uint8_t {
   kTrSum = 1,
   kTrPullResp = 2,
   kTrRound = 3,
-  kTrMember = 4,  // key = worker id, len = live count, codec = 1 rejoin
+  kTrMember = 4,  // key = worker id, len = live count,
+                  // codec = 0 evict / 1 rejoin / 2 mid-stream join
 };
 const char* kTraceStageName[] = {"PUSH_RECV", "SUM", "PULL_RESP", "ROUND",
                                  "MEMBER"};
@@ -181,12 +182,17 @@ struct TraceEv {
 
 constexpr size_t kMaxTraceEvents = 1u << 21;
 
+// Ceiling on worker ids a kJoin may grow the membership table to —
+// matches the worker-side Members() bitmap buffer (1024 bytes); a
+// malformed frame must not drive an unbounded per-key vector resize.
+constexpr uint16_t kMaxWorkers = 1024;
+
 class Server {
  public:
   int Start(uint16_t port, int num_workers, int engine_threads, bool async,
             int pull_timeout_ms, int server_id, bool schedule,
             int lease_ms, int staleness) {
-    num_workers_ = num_workers;
+    num_workers_.store(num_workers);
     async_ = async;
     pull_timeout_ms_ = pull_timeout_ms;
     server_id_ = server_id;
@@ -625,8 +631,11 @@ class Server {
   }
 
   bool WorkerLive(uint16_t worker) {
-    if (lease_ms_ <= 0 || worker >= member_state_.size()) return true;
+    if (lease_ms_ <= 0) return true;
+    // size read under the lock: a concurrent kJoin GROWS member_state_
+    // (vector reallocation), so an unlocked size() probe is a race
     std::lock_guard<std::mutex> lk(members_mu_);
+    if (worker >= member_state_.size()) return true;
     return member_state_[worker] == kLive;
   }
 
@@ -637,10 +646,11 @@ class Server {
   // round watermarks (kMembers/kRounds) or its stale rounds would leak
   // into post-eviction sums.
   bool Touch(uint16_t worker, bool admit) {
-    if (lease_ms_ <= 0 || worker >= member_state_.size()) return false;
+    if (lease_ms_ <= 0) return false;
     bool rejoined = false;
     {
       std::lock_guard<std::mutex> lk(members_mu_);
+      if (worker >= member_state_.size()) return false;
       last_seen_ms_[worker] = steady_ms();
       if (member_state_[worker] != kLive && admit) {
         member_state_[worker] = kLive;
@@ -688,10 +698,11 @@ class Server {
   // and reconcile. Returns true when every worker is now accounted for
   // (departed or evicted) so the caller may stop the server.
   bool Depart(uint16_t worker) {
-    if (lease_ms_ <= 0 || worker >= member_state_.size()) return false;
+    if (lease_ms_ <= 0) return false;
     bool shrank = false;
     {
       std::lock_guard<std::mutex> lk(members_mu_);
+      if (worker >= member_state_.size()) return false;
       if (member_state_[worker] == kLive) {
         live_workers_.fetch_sub(1);
         epoch_.fetch_add(1);
@@ -716,6 +727,88 @@ class Server {
     return live_workers_.load() <= 0 &&
            (departed > 0 || shutdown_count_.load() > 0);
   }
+
+  // Grow every key store's per-worker vectors (arrival bitmap + replay
+  // watermarks) to the current worker count. Called by Join BEFORE the
+  // admission is published: the first round-completion check that sees
+  // the joiner live must also see its (empty) arrival slot — otherwise a
+  // RoundCompleteLocked bounded by the stale pushed.size() could close a
+  // round "complete" without the joiner ever being expected in it.
+  void GrowStoreSlots() {
+    const size_t n = static_cast<size_t>(num_workers_.load());
+    std::vector<KeyStore*> stores;
+    {
+      std::lock_guard<std::mutex> lk(store_mu_);
+      stores.reserve(store_.size());
+      for (auto& [k, ks] : store_) stores.push_back(ks.get());
+    }
+    for (KeyStore* ks : stores) {
+      std::lock_guard<std::mutex> lk(ks->mu);
+      if (ks->pushed.size() < n) {
+        ks->pushed.resize(n, 0);
+        ks->applied_version.resize(n, 0);
+      }
+    }
+  }
+
+ public:
+  // Mid-stream worker ADMISSION (kJoin; scale-up elasticity). A fresh id
+  // beyond the configured count GROWS the membership table and — before
+  // the admission is published — every key store's per-worker vectors,
+  // so the join lands at a round boundary: rounds open at admission
+  // close over whoever contributed (the eviction-side quorum scaling
+  // generalized upward), and every later round targets the grown live
+  // set. A previously evicted/departed id re-admits exactly like the
+  // kPing rejoin path (epoch bump). The joiner is expected to adopt
+  // round watermarks via kRounds before its first push — under bounded
+  // staleness that watermark IS the served-round frontier, which never
+  // trails the force-close watermark. Returns the post-admission epoch;
+  // -1 = id out of range; -2 = fixed membership (lease disabled) and the
+  // id is not a configured worker.
+  int64_t Join(uint16_t worker) {
+    if (worker >= kMaxWorkers) return -1;
+    if (lease_ms_ <= 0) {
+      // fixed membership has no admission machinery: a configured id is
+      // already a member (idempotent ack), a fresh one cannot be grown
+      return worker < static_cast<uint16_t>(num_workers_.load())
+                 ? static_cast<int64_t>(epoch_.load())
+                 : -2;
+    }
+    {
+      std::lock_guard<std::mutex> lk(members_mu_);
+      if (worker >= member_state_.size()) {
+        // new slots between the old count and the joiner default to
+        // kEvicted: absent-but-admissible, and already accounted for by
+        // the exit gate (evicted counts as accounted)
+        member_state_.resize(worker + 1, kEvicted);
+        last_seen_ms_.resize(worker + 1, steady_ms());
+        // published BEFORE the store sweep below so any KeyStore created
+        // concurrently (kInit racing the join) sizes its vectors for the
+        // grown membership from the start
+        num_workers_.store(static_cast<int>(member_state_.size()));
+      }
+    }
+    GrowStoreSlots();
+    bool admitted = false;
+    {
+      std::lock_guard<std::mutex> lk(members_mu_);
+      last_seen_ms_[worker] = steady_ms();
+      if (member_state_[worker] != kLive) {
+        member_state_[worker] = kLive;
+        live_workers_.fetch_add(1);
+        epoch_.fetch_add(1);
+        PublishMembersLocked();
+        admitted = true;
+      }
+    }
+    if (admitted) {
+      Trace(kTrMember, worker,
+            static_cast<uint32_t>(live_workers_.load()), 2, realtime_ns());
+    }
+    return static_cast<int64_t>(epoch_.load());
+  }
+
+ private:
 
   // Membership shrank: drop the dead workers' deferred (pipelined
   // next-round) pushes, close any round now complete over the live set —
@@ -1430,6 +1523,26 @@ class Server {
                     static_cast<uint32_t>(pay.size()));
           break;
         }
+        case kJoin: {
+          // first-class mid-stream admission (scale-up elasticity): the
+          // tail of the PR 5 lease/epoch machinery — see Join()
+          if (h.reserved == 0) {
+            SendErr(c, h.key, "join needs a worker id");
+            break;
+          }
+          const int64_t ep = Join(static_cast<uint16_t>(h.reserved - 1));
+          if (ep == -1) {
+            SendErr(c, h.key, "join: worker id out of range");
+          } else if (ep == -2) {
+            SendErr(c, h.key,
+                    "join: fixed membership (lease disabled) cannot admit "
+                    "a new worker id");
+          } else {
+            SendFrame(c, kAck, h.key, static_cast<uint64_t>(ep), nullptr,
+                      0);
+          }
+          break;
+        }
         case kShutdown: {
           SendFrame(c, kAck, 0, 0, nullptr, 0);
           int count = ++shutdown_count_;
@@ -1476,7 +1589,9 @@ class Server {
   }
 
   int listen_fd_ = -1;
-  int num_workers_ = 1;
+  // atomic: read lock-free on every conn thread's bounds checks, GROWN
+  // by a mid-stream kJoin admitting a fresh worker id
+  std::atomic<int> num_workers_{1};
   bool async_ = false;
   bool schedule_ = false;
   int pull_timeout_ms_ = 0;
@@ -1594,6 +1709,12 @@ int ServerMembers(uint64_t* epoch, uint32_t* live_count, uint8_t* bitmap,
   Server* s = GetServer();
   if (s == nullptr) return -10;
   return s->MembersInfo(epoch, live_count, bitmap, cap);
+}
+
+int64_t ServerJoin(uint16_t worker) {
+  Server* s = GetServer();
+  if (s == nullptr) return -10;
+  return s->Join(worker);
 }
 
 int ServerTraceDump(const char* path) {

@@ -54,6 +54,14 @@ uint64_t ServerEpoch();
 // or -10 when no server runs in this process.
 int ServerMembers(uint64_t* epoch, uint32_t* live_count, uint8_t* bitmap,
                   uint32_t cap);
+// Mid-stream worker ADMISSION (the IPC analog of kJoin; scale-up
+// elasticity): admit `worker` — a fresh id beyond the configured count
+// (the membership table and every key store's per-worker vectors GROW
+// before the admission is published, so the join lands at a round
+// boundary) or a previously evicted/departed one. Returns the
+// post-admission epoch, -1 for an out-of-range id, -2 under fixed
+// membership (lease disabled) for an unknown id, -10 with no server.
+int64_t ServerJoin(uint16_t worker);
 // Blocks until the server stops (all workers sent kShutdown, or StopServer).
 void WaitServer();
 void StopServer();

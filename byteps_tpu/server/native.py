@@ -78,19 +78,21 @@ def load_lib() -> ctypes.CDLL:
             _build()
             lib = ctypes.CDLL(_SO)
         try:
-            # staleness probe: a prebuilt .so predating the bounded-
-            # staleness API (bps_client_pull3; implies the membership API
+            # staleness probe: a prebuilt .so predating the newest API
+            # generation (bps_client_join — the scale-up elasticity
+            # surface; implies bps_client_pull3 and the membership API
             # too) would otherwise be dlopen'd with a mismatched
             # bps_server_start signature
-            lib.bps_client_pull3
+            lib.bps_client_join
         except AttributeError:
             log.warning(
-                "native library predates bounded-staleness API; rebuilding")
+                "native library predates the join/elasticity API; "
+                "rebuilding")
             os.remove(_SO)
             _build()
             lib = ctypes.CDLL(_SO)
             try:
-                lib.bps_client_pull3
+                lib.bps_client_join
             except AttributeError:
                 # dlopen matched the ALREADY-MAPPED stale object by path
                 # (nothing dlcloses the first handle), so the rebuild
@@ -123,6 +125,8 @@ def load_lib() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32,
         ]
         lib.bps_server_members.restype = ctypes.c_int
+        lib.bps_server_join.argtypes = [ctypes.c_int]
+        lib.bps_server_join.restype = ctypes.c_int64
         lib.bps_local_init.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
         lib.bps_local_init.restype = ctypes.c_int
         lib.bps_local_push.argtypes = [
@@ -217,6 +221,11 @@ def load_lib() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_uint64),
         ]
         lib.bps_client_rounds.restype = ctypes.c_int
+        lib.bps_client_join.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.bps_client_join.restype = ctypes.c_int
         lib.bps_client_last_error.argtypes = [ctypes.c_void_p]
         lib.bps_client_last_error.restype = ctypes.c_char_p
         lib.bps_client_is_dead.argtypes = [ctypes.c_void_p]
@@ -398,6 +407,23 @@ class NativeClient:
             return (int(ep.value), int(live.value),
                     np.frombuffer(bytes(bitmap[:n]), np.uint8).copy())
 
+    def join(self, worker_id: int) -> int:
+        """Mid-stream worker ADMISSION (kJoin; scale-up elasticity):
+        admit ``worker_id`` — a fresh id (the server GROWS its
+        membership table and per-key vectors) or a previously
+        evicted/departed one — at a round boundary. Returns the
+        post-admission membership epoch. The caller must adopt round
+        watermarks (:meth:`rounds`) before its first push."""
+        with self._op_lock:
+            self._require_open()
+            ep = ctypes.c_uint64(0)
+            self._check(
+                self._lib.bps_client_join(self._h, worker_id,
+                                          ctypes.byref(ep)),
+                "join",
+            )
+            return int(ep.value)
+
     def rounds(self) -> "np.ndarray":
         """Per-key round watermarks as an (n, 3) uint64 array of
         (key, round, nbytes) — the rejoin adoption handshake."""
@@ -462,6 +488,10 @@ class NativeClient:
             raise WorkerEvictedError(
                 f"bps {op} rejected: worker evicted (local/IPC path); "
                 "rejoin required")
+        if rc == -8:
+            raise RuntimeError(
+                f"bps {op} rejected: worker id out of range for the "
+                "wire encoding (must be within [0, 65534])")
         if rc == -7:
             raise TimeoutError(
                 f"bps {op} receive timeout (server dead or stalled); "

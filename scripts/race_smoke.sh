@@ -3,9 +3,12 @@
 # (byteps_tpu/server/csrc/race_smoke.cc): rebuilds server+client+IPC with
 # -fsanitize=thread and hammers every concurrency surface — engine pool,
 # per-(key,worker) strands, reconnects, the elastic-membership lease
-# sweep racing live pushes, and Stop vs traffic. Run it after ANY
-# server-side concurrency change (the membership state lives under its
-# own mutex beside the per-key slot mutexes — exactly the kind of
+# sweep racing live pushes, a mid-stream kJoin admitting a FRESH worker
+# id under live traffic (membership table + per-key vector GROWTH racing
+# pushes, round closes, and idempotent re-admissions — the scale-up
+# mirror of the lease-eviction phase), and Stop vs traffic. Run it after
+# ANY server-side concurrency change (the membership state lives under
+# its own mutex beside the per-key slot mutexes — exactly the kind of
 # cross-lock interplay TSAN exists for).
 #
 # Exit codes: 0 = clean, 77 = no TSAN toolchain (callers should skip),

@@ -83,7 +83,10 @@ def test_parse_fault_spec_structured_errors():
         ("push:kill@op=x", "int"),
         ("server1:down@step=1..y", "int"),
         ("all:slow@ms=fast", "int"),
-        ("pull:hang", "worker"),  # hang is a worker-scope-only kind
+        ("pull:hang", "worker"),   # hang is a worker-scope-only kind
+        ("pull:join@step=1", "worker"),  # join is worker-scope-only too
+        ("worker2:join", "step="),       # joins are a schedule: step=
+        ("worker2:join@p=0.5", "step="),  # ...never a probability
     ]:
         with pytest.raises(ValueError) as ei:
             parse_fault_spec(bad)
@@ -113,6 +116,11 @@ def test_fault_spec_round_trip_every_documented_form():
         "worker1:slow@ms=80",
         "worker0:kill@step=8..",
         "worker2:hang@step=3,ms=250",
+        # deterministic mid-stream joins (scale-up elasticity): the
+        # churn bench leg's schedule forms
+        "worker2:join@step=12",
+        "worker0:join@step=3..5",
+        "worker4:join@step=7..",
     ]
     for form in forms:
         rules = parse_fault_spec(form)
