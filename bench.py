@@ -1683,6 +1683,175 @@ def bench_throttled(rates_mbps=(64, 200, 800), reps: int = 3,
     }
 
 
+def bench_whatif(recorded=("raw", 200.0), reps: int = 3,
+                 payload_mb: int = 16) -> dict:
+    """Trace-driven what-if validation (ROADMAP item 3, docs/whatif.md):
+    replay ONE recorded leg and predict the rest of the throttled race.
+
+    One leg — ``recorded`` = (codec, Mbps) — runs live with
+    ``BYTEPS_TRACE_ON`` semantics (in-memory recorder) and is lifted
+    into a calibrated cost model (``sim/extract.py``: per-stage fits,
+    native-measured codec/server rates, pacer arithmetic, round slack).
+    Every OTHER (codec × rate) cell of the throttled sweep is then
+    measured live AND predicted by the discrete-event replay engine
+    (``sim/engine.py``) from that single recorded run. The headline is
+    prediction accuracy = 1 − median relative error over the
+    predicted-vs-measured table (14 configurations spanning codec ×
+    throttle rate); the acceptance contract is <10% median error, and
+    the headline joins the trend gate so a cost-model regression fails
+    ``bench_all.sh`` like any perf regression."""
+    import dataclasses as _dc
+
+    from byteps_tpu.common import config as config_mod
+    from byteps_tpu.common import tracing
+    from byteps_tpu.common.dcn_adapter import DcnCore
+    from byteps_tpu.compression import wire
+    from byteps_tpu.server import start_server_any_port, stop_server
+    from byteps_tpu.sim.engine import SimConfig
+    from byteps_tpu.sim.extract import (
+        cost_model_from_events,
+        predict_step_s,
+    )
+    from byteps_tpu.sim.search import rank_configs
+
+    codecs = {
+        "raw": lambda: None,
+        "fp16": wire.Fp16Wire,
+        "fp8": wire.Fp8Wire,
+        "onebit": lambda: wire.OnebitWire(scaling=True),
+        "topk": lambda: wire.TopkWire(k=0.01, selection="block"),
+    }
+    rates = (64.0, 200.0, 800.0)
+    nelems = payload_mb * (1 << 20) // 4
+    flat = np.random.default_rng(0).standard_normal(nelems).astype(
+        np.float32)
+    base_cfg = config_mod.Config.from_env()
+    port = [24800]
+
+    def run_leg(cname, rate, trace=False):
+        cfg = _dc.replace(base_cfg, num_worker=1, num_server=1,
+                          dcn_throttle_mbps=float(rate),
+                          trace_on=trace, trace_start_step=1,
+                          trace_end_step=1 << 30)
+        config_mod.set_config(cfg)
+        if trace:
+            tracing.reset_tracer()  # pick up the trace_on overlay
+        port[0] = start_server_any_port(port[0] + 1, num_workers=1,
+                                        engine_threads=4,
+                                        async_mode=False)
+        core = None
+        try:
+            core = DcnCore(servers=[("127.0.0.1", port[0])])
+            codec = codecs[cname]()
+            times = []
+            for rep in range(reps + 1):   # rep 0 = warmup (key init)
+                t0 = time.perf_counter()
+                h = core.push_pull_async(flat, name=f"whatif.{cname}",
+                                         codec=codec)
+                DcnCore.assemble(h, timeout=600.0)
+                if rep > 0:
+                    times.append(time.perf_counter() - t0)
+            events = (list(tracing.get_tracer()._events) if trace
+                      else None)
+        finally:
+            if core is not None:
+                core.shutdown()
+            stop_server()
+            config_mod.reset_config()
+            if trace:
+                tracing.reset_tracer()
+        times.sort()
+        return float(np.median(times)), [round(times[0], 4),
+                                         round(times[-1], 4)], events
+
+    rec_codec, rec_rate = recorded
+    rec_med, rec_spread, events = run_leg(rec_codec, rec_rate, trace=True)
+    _log(f"whatif: recorded {rec_codec}@{rec_rate:g}Mbps "
+         f"{rec_med:.3f}s/round, {len(events)} trace events")
+    model = cost_model_from_events(
+        events,
+        config={"codec": rec_codec, "dcn_throttle_mbps": float(rec_rate),
+                "partition_bytes": base_cfg.partition_bytes,
+                "scheduling_credit": base_cfg.scheduling_credit,
+                "min_compress_bytes": base_cfg.min_compress_bytes,
+                "num_worker": 1},
+        measured_step_s=rec_med)
+
+    results = {}
+    errs = []
+    for rate in rates:
+        for cname in codecs:
+            if (cname, float(rate)) == (rec_codec, float(rec_rate)):
+                continue
+            med, spread, _ = run_leg(cname, rate)
+            pred = predict_step_s(model, SimConfig(
+                partition_bytes=base_cfg.partition_bytes,
+                credit=base_cfg.scheduling_credit,
+                codec=cname, throttle_mbps=float(rate), rounds=3))
+            err = (pred - med) / med
+            errs.append(abs(err))
+            results[f"{cname}@{rate:g}"] = {
+                "predicted_s": round(pred, 4),
+                "sec_med": round(med, 4),
+                "sec_spread": spread,
+                "rel_err": round(err, 4),
+            }
+            _log(f"whatif {cname:>7}@{rate:>4g}: pred {pred:.4f}s "
+                 f"meas {med:.4f}s err {err:+.1%}")
+    errs.sort()
+    median_err = errs[len(errs) // 2] if errs else 1.0
+    worst = max(results.items(), key=lambda kv: abs(kv[1]["rel_err"]))
+    within = sum(1 for e in errs if e < 0.10) / max(1, len(errs))
+
+    # the payoff the simulator exists for: SOLVE the config space the
+    # sweep above walked — rank codec × partition × credit at the
+    # recorded rate in milliseconds of arithmetic
+    ranked = rank_configs(
+        model,
+        base=SimConfig(partition_bytes=base_cfg.partition_bytes,
+                       credit=base_cfg.scheduling_credit,
+                       codec=rec_codec, throttle_mbps=float(rec_rate),
+                       rounds=3),
+        codecs=list(codecs),
+        partition_bytes=[1 << 20, 2 << 20, 4096000, 8 << 20],
+        credits=[2, 4, 8])
+    solver_top = [
+        {"codec": c.codec, "partition_bytes": c.partition_bytes,
+         "credit": c.credit, "predicted_s": round(p, 4)}
+        for c, p in ranked[:5]]
+    _log(f"whatif: median err {median_err:.1%} over {len(errs)} legs "
+         f"(worst {worst[0]} {worst[1]['rel_err']:+.1%}); solver best "
+         f"{solver_top[0]}")
+    return {
+        "metric": ("trace-driven what-if prediction: replay ONE "
+                   f"recorded leg ({rec_codec}@{rec_rate:g}Mbps) and "
+                   "predict the full codec x rate throttled sweep "
+                   "(sim/, docs/whatif.md)"),
+        "value": round(1.0 - median_err, 4),
+        "unit": "prediction accuracy (1 - median |rel err|; >=0.9 = "
+                "<10% contract)",
+        "vs_baseline": round(1.0 - median_err, 4),
+        "pass": median_err < 0.10,
+        "median_rel_err": round(median_err, 4),
+        "worst_leg": {"leg": worst[0], **worst[1]},
+        "within_10pct_frac": round(within, 3),
+        "recorded": {"codec": rec_codec, "rate_mbps": float(rec_rate),
+                     "sec_med": round(rec_med, 4),
+                     "sec_spread": rec_spread,
+                     "trace_events": len(events)},
+        "calibration": {
+            "overheads_us": {k: round(v, 1)
+                             for k, v in model.overheads.items()},
+            "round_slack_us": round(model.round_slack_us, 1),
+            "loopback_bps": round(model.loopback_bps),
+        },
+        "solver_top": solver_top,
+        "payload_mb": payload_mb,
+        "reps": reps,
+        "results": results,
+    }
+
+
 def bench_hybrid(workers: int = 4, rate_mbps: float = 200.0,
                  payload_mb: int = 16, reps: int = 3,
                  partition_kbs=(256, 512)) -> dict:
@@ -2590,8 +2759,42 @@ def bench_tuner(payload_mb: int = 8, max_moves: int = 40,
         DcnCore.assemble(h, timeout=600.0)
         return time.perf_counter() - t0
 
+    from byteps_tpu.common import tracing
+    from byteps_tpu.sim.extract import cost_model_from_events
+    from byteps_tpu.sim.search import make_proposer
+
+    def record_model(rounds: int = 4):
+        """Record the DEFAULT config's rounds once (in-memory tracer)
+        and lift them into the simulator's cost model — the sim-proposed
+        leg then tunes from this trace instead of walking neighbors
+        (ROADMAP item 3's payoff at the tuner decision point)."""
+        teardown()
+        cfg = _dc.replace(base_cfg, num_worker=1, num_server=1,
+                          partition_bytes=4 << 20, scheduling_credit=4,
+                          trace_on=True, trace_start_step=1,
+                          trace_end_step=1 << 30)
+        config_mod.set_config(cfg)
+        tracing.reset_tracer()
+        port[0] += 1
+        start_server(port=port[0], num_workers=1, engine_threads=4,
+                     async_mode=False)
+        state["core"] = DcnCore(servers=[("127.0.0.1", port[0])])
+        ts = [round_sec() for _ in range(rounds + 1)][1:]
+        events = list(tracing.get_tracer()._events)
+        teardown()
+        tracing.reset_tracer()
+        model = cost_model_from_events(
+            events,
+            config={"codec": "onebit", "partition_bytes": 4 << 20,
+                    "scheduling_credit": 4, "dcn_throttle_mbps": 0.0,
+                    "min_compress_bytes": base_cfg.min_compress_bytes,
+                    "num_worker": 1},
+            measured_step_s=float(np.median(ts)))
+        return model, rounds + 1
+
     searched = {}
     results = {}
+    sim_live_rounds = 0
     try:
         for label, knobs in (("joint", ("partition", "credit")),
                              ("partition_only", ("partition",)),
@@ -2604,6 +2807,22 @@ def bench_tuner(payload_mb: int = 8, max_moves: int = 40,
                 steps += 1
             teardown()
             searched[label] = (tuner.best, steps, tuner.converged)
+
+        # the simulator-proposed race: same start, same apply/measure
+        # loop, but the candidates come from the what-if replay of ONE
+        # recorded run — live rounds are spent CONFIRMING a simulated
+        # shortlist. Every live round (including the recording) counts.
+        model, sim_live_rounds = record_model()
+        proposer = make_proposer(model, top_n=4)
+        tuner = AutoTuner(setup, interval=2, warmup=1, min_gain=0.05,
+                          proposer=proposer)
+        steps = 0
+        while not tuner.converged and steps < 3 * max_moves:
+            tuner.record_step(round_sec())
+            steps += 1
+        teardown()
+        sim_live_rounds += steps
+        searched["sim_proposed"] = (tuner.best, steps, tuner.converged)
 
         # fair final comparison: the winners often share a config and
         # loopback drift between disjoint blocks swamps their real
@@ -2635,6 +2854,15 @@ def bench_tuner(payload_mb: int = 8, max_moves: int = 40,
     best_single = min(results["partition_only"]["sec_med"],
                       results["credit_only"]["sec_med"])
     ratio = best_single / results["joint"]["sec_med"]
+    # simulator-proposed acceptance (docs/whatif.md): a config within
+    # min_gain of the grid-walk optimum in STRICTLY fewer live rounds
+    # (the recording rounds are charged to the proposer's bill)
+    grid_rounds = searched["joint"][1]
+    sim_ok = (results["sim_proposed"]["sec_med"]
+              <= results["joint"]["sec_med"] * 1.05)
+    _log(f"tune sim_proposed: {sim_live_rounds} live rounds (incl. "
+         f"recording) vs grid joint {grid_rounds}; within min_gain of "
+         f"grid optimum: {sim_ok}")
     return {
         "metric": ("joint (partition, credit) auto-tune vs single-knob "
                    "(1-worker DCN push_pull, onebit wire, loopback)"),
@@ -2642,6 +2870,12 @@ def bench_tuner(payload_mb: int = 8, max_moves: int = 40,
         "unit": "x best-single-knob / tuned-joint (>=1 = joint wins)",
         "vs_baseline": round(ratio, 3),
         "payload_mb": payload_mb,
+        "proposer": {
+            "live_rounds": sim_live_rounds,
+            "grid_live_rounds": grid_rounds,
+            "fewer_evals": sim_live_rounds < grid_rounds,
+            "within_min_gain_of_grid": sim_ok,
+        },
         "results": results,
     }
 
@@ -2670,6 +2904,10 @@ _TREND_SPECS = (
     ("BENCH_serve.json", "prefix_ttft_p50_speedup"),
     ("BENCH_ici.json", "ring_vs_staged_best"),
     ("BENCH_ici.json", "ring_bus_bw_best"),
+    # what-if simulator prediction accuracy (1 − median rel err over the
+    # predicted-vs-measured sweep): a cost-model regression fails the
+    # gate like any perf regression (docs/whatif.md)
+    ("BENCH_whatif.json", "value"),
 )
 
 
@@ -2814,7 +3052,8 @@ def main() -> None:
     ap.add_argument("--mode",
                     choices=["auto", "dcn", "dcn-profile", "throttled",
                              "tune", "chaos", "hybrid", "generate",
-                             "serve", "ici", "profile", "trend"],
+                             "serve", "ici", "profile", "trend",
+                             "whatif"],
                     default="auto")
     ap.add_argument("--refresh", action="store_true",
                     help="trend mode: rebuild BENCH_trend.json's "
@@ -2859,7 +3098,7 @@ def main() -> None:
              "class-count logits are tiny, so there is no chunked-CE path "
              "to toggle (docs/models.md families table)")
     if args.mode in ("dcn", "dcn-profile", "throttled", "tune", "chaos",
-                     "hybrid"):
+                     "hybrid", "whatif"):
         if flags_set:
             _log("bench: WARNING --model/--compressor/--ce ignored in "
                  f"{args.mode} mode")
@@ -2876,6 +3115,21 @@ def main() -> None:
             result = bench_dcn()
         elif args.mode == "tune":
             result = bench_tuner()
+        elif args.mode == "whatif":
+            result = bench_whatif()
+            with open("BENCH_whatif.json", "w") as f:
+                json.dump(result, f, indent=1)
+            _log("bench: wrote BENCH_whatif.json")
+            if not result["pass"]:
+                # the <10% median contract (docs/whatif.md) failed
+                # outright — fail the leg like a crashed bench, so
+                # bench_all.sh marks the artifact stale instead of
+                # letting the trend gate compare against a broken model
+                print(json.dumps(result), flush=True)
+                _log("bench: WHATIF PREDICTION CONTRACT FAILED "
+                     f"(median err {result['median_rel_err']:.1%} "
+                     ">= 10%)")
+                sys.exit(6)
         elif args.mode == "chaos":
             result = bench_chaos()
             with open("BENCH_chaos.json", "w") as f:

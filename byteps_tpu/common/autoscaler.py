@@ -43,7 +43,7 @@ depth plus TTFT-SLO pressure (serve, :func:`serve_sample`).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from byteps_tpu.common.config import get_config
 from byteps_tpu.common.flight_recorder import get_flight_recorder
@@ -61,18 +61,23 @@ __all__ = [
 
 def record_decision(domain: str, action: str, reason: str,
                     target: Optional[int] = None,
-                    live: Optional[int] = None) -> None:
+                    live: Optional[int] = None,
+                    predicted: Optional[Dict[str, Any]] = None) -> None:
     """The ONE event path for every scale decision: counters
     (``autoscaler.decisions`` + ``autoscaler.<domain>.<action>``), a
     chrome-trace FAULT instant, and a flight-recorder event. The serve
     router's lease sweep and the policy loop both land here, so a
     post-mortem's event ring answers "why was this worker/replica
-    admitted/evicted" uniformly."""
+    admitted/evicted" uniformly. ``predicted`` carries the what-if
+    simulator's payoff estimate when an ``estimator`` was consulted —
+    the post-mortem then also answers "what did the decision EXPECT"."""
     reg = get_registry()
     reg.counter("autoscaler.decisions").inc()
     reg.counter(f"autoscaler.{domain}.{action}").inc()
     args = {"domain": domain, "action": action, "reason": reason,
             "target": target, "live": live}
+    if predicted is not None:
+        args["predicted"] = predicted
     get_tracer().instant(f"autoscaler_{action}", "FAULT", args)
     get_flight_recorder().record_event("autoscaler.decision", args)
     log.info("autoscaler[%s]: %s (%s)%s", domain, action, reason,
@@ -95,6 +100,10 @@ class Decision:
     reason: str
     step: int     # the policy step this decision was made at
     live: int     # unit count observed when deciding
+    # the estimator's payoff prediction, when one was consulted:
+    # {"goodput_live", "goodput_target", "target"} — recorded on the
+    # decision event for post-mortems (ROADMAP item 4's remainder)
+    predicted: Optional[Dict[str, float]] = None
 
 
 class ScalingPolicy:
@@ -110,7 +119,19 @@ class ScalingPolicy:
                  sustain: Optional[int] = None,
                  min_units: Optional[int] = None,
                  max_units: Optional[int] = None,
-                 domain: str = "train"):
+                 domain: str = "train",
+                 estimator: Optional[Callable[[int], float]] = None):
+        """``estimator(n_units) -> predicted aggregate goodput`` (the
+        what-if simulator's ``sim.search.goodput_estimator``, or any
+        model): when set, an ADMIT must predict its own payoff before
+        spending capacity — the marginal unit must add at least
+        ``hysteresis`` of an average live unit's current contribution
+        (a per-unit margin: perfect linear scaling always passes), else
+        the decision degrades to a hold that says so and arms the
+        cooldown like the admit it replaced. Every estimator
+        consultation is recorded on the decision
+        (``Decision.predicted``) and rides the shared event path, so
+        post-mortems show expectation beside outcome."""
         cfg = get_config()
         if scale_down_load >= scale_up_load:
             raise ValueError(
@@ -131,6 +152,7 @@ class ScalingPolicy:
         self.max_units = (max_units if max_units is not None
                           else cfg.autoscale_max)
         self.domain = domain
+        self.estimator = estimator
         self._step = 0
         self._last_change = -(10 ** 9)
         self._up_streak = 0
@@ -151,13 +173,27 @@ class ScalingPolicy:
         self._step += 1
         d = self._decide(sample)
         self.trace.append(d)
-        if d.action == "hold":
+        if d.action == "hold" and not (d.predicted is not None
+                                       and "veto" in d.reason):
             # holds are counted but not traced/ring-recorded: one event
             # per policy tick would drown the post-mortem ring
             self._m_hold.inc()
+        elif d.action == "hold":
+            # an estimator VETO is a consequential decision (capacity
+            # was declined on a predicted non-payoff) and must be
+            # explicable post-mortem like the admit it replaced — and it
+            # arms the cooldown + resets the streaks exactly like one,
+            # so a sustained veto state records once per cooldown window
+            # instead of once per tick (which would drown the ring).
+            # record_decision counts autoscaler.<domain>.hold itself.
+            record_decision(self.domain, "hold", d.reason,
+                            live=sample.live, predicted=d.predicted)
+            self._last_change = self._step
+            self._up_streak = self._down_streak = 0
+            self._straggler_streak = 0
         else:
             record_decision(self.domain, d.action, d.reason,
-                            live=sample.live)
+                            live=sample.live, predicted=d.predicted)
             self._last_change = self._step
             self._up_streak = self._down_streak = 0
             self._straggler_streak = 0
@@ -190,11 +226,24 @@ class ScalingPolicy:
                             self._step, s.live)
         if self._up_streak >= self.sustain:
             if s.live < self.max_units:
-                return Decision(
-                    "admit",
-                    f"sustained load headroom ({s.load:.3g} >= "
-                    f"{up_at:.3g} for {self._up_streak} samples)",
-                    self._step, s.live)
+                reason = (f"sustained load headroom ({s.load:.3g} >= "
+                          f"{up_at:.3g} for {self._up_streak} samples)")
+                pred = self._predict(s.live, s.live + 1)
+                if pred is not None and not pred["pays_off"]:
+                    # ROADMAP item 4's remainder: the admit predicts its
+                    # own payoff (simulated goodput at live+1) BEFORE
+                    # spending capacity — a sublinear step (round-close
+                    # barriers, server contention) turns into a hold
+                    return Decision(
+                        "hold",
+                        f"estimator veto: goodput({s.live + 1}) "
+                        f"{pred['goodput_target']:.3g} adds under "
+                        f"{self.hysteresis:.3g}x of an average "
+                        f"worker's share at live {s.live} "
+                        f"({pred['goodput_live']:.3g})",
+                        self._step, s.live, predicted=pred)
+                return Decision("admit", reason, self._step, s.live,
+                                predicted=pred)
             return Decision("hold", "demand but at max_units",
                             self._step, s.live)
         if self._down_streak >= self.sustain:
@@ -203,10 +252,42 @@ class ScalingPolicy:
                     "evict",
                     f"sustained idle ({s.load:.3g} <= {down_at:.3g} "
                     f"for {self._down_streak} samples)",
-                    self._step, s.live)
+                    self._step, s.live,
+                    # recorded, never vetoing: an idle evict SAVES
+                    # capacity — the prediction is for the post-mortem
+                    predicted=self._predict(s.live, s.live - 1))
             return Decision("hold", "idle but at min_units",
                             self._step, s.live)
         return Decision("hold", "in-band", self._step, s.live)
+
+    def _predict(self, live: int, target: int,
+                 ) -> Optional[Dict[str, float]]:
+        """Consult the estimator (None when none attached; a failing
+        estimator is treated as absent — the policy must keep deciding
+        without its advisor). ``pays_off`` applies the policy's
+        hysteresis as the margin an extra unit must clear."""
+        if self.estimator is None:
+            return None
+        try:
+            cur = float(self.estimator(live))
+            tgt = float(self.estimator(target))
+        except Exception as e:  # noqa: BLE001 — advisory, never fatal
+            log.warning("autoscaler estimator failed (%s); deciding "
+                        "without prediction", e)
+            return None
+        # an admit pays off when the MARGINAL unit delivers at least
+        # `hysteresis` of an average live unit's current contribution —
+        # relative to the per-unit gain, NOT the aggregate (a flat
+        # aggregate margin would veto perfect linear scaling the moment
+        # live exceeds 1/hysteresis)
+        per_unit = cur / max(1, live)
+        return {
+            "goodput_live": cur,
+            "goodput_target": tgt,
+            "target": target,
+            "pays_off": ((tgt - cur) > self.hysteresis * per_unit
+                         if target > live else tgt >= 0.0),
+        }
 
 
 # -- domain samplers ----------------------------------------------------------

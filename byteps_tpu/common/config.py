@@ -368,6 +368,14 @@ class Config:
         )
         return c
 
+    def snapshot(self) -> dict:
+        """JSON-safe dict of every resolved knob. Stamped into chrome-
+        trace metadata (``TraceRecorder.dump``) and flight-recorder
+        post-mortems so a recorded run carries the configuration that
+        produced it — the what-if simulator (``byteps_tpu/sim``) replays
+        a run from its artifacts alone, no out-of-band knowledge."""
+        return dataclasses.asdict(self)
+
     @property
     def is_distributed(self) -> bool:
         """Multi-host via the DCN PS tier vs collectives-only.

@@ -210,6 +210,10 @@ class FlightRecorder:
             "steps": steps,
             "fault_events": events,
             "metrics": get_registry().snapshot(),
+            # the run's resolved knobs ride every dump: a post-mortem is
+            # a valid (degraded) what-if simulator input on its own
+            # (sim/extract.cost_model_from_flight_dump)
+            "config": _config_snapshot(),
         }
         if extra:
             pm["extra"] = json_safe(extra)
@@ -254,6 +258,18 @@ class FlightRecorder:
             # must never add a second failure on top of the first
             log.warning("flight-recorder dump failed: %s", e)
             return None
+
+
+def _config_snapshot() -> Dict[str, Any]:
+    """The resolved Config as a JSON-safe dict; never lets a config
+    problem break a post-mortem (telemetry must not add a second
+    failure)."""
+    try:
+        from byteps_tpu.common.config import get_config
+
+        return get_config().snapshot()
+    except Exception:  # noqa: BLE001
+        return {}
 
 
 def _p(snap: Dict[str, Any], name: str, stat: str) -> Optional[float]:

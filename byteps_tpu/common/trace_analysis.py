@@ -53,19 +53,51 @@ def stage_order() -> List[str]:
 
 
 def load_events(path: str) -> List[Dict[str, Any]]:
-    """Read a chrome trace file; accepts {traceEvents: [...]} or a bare list."""
+    """Read a chrome trace file; accepts {traceEvents: [...]}, a bare
+    event list, or a flight-recorder post-mortem dump (degraded input:
+    the per-step ring's stage percentiles are synthesized into one span
+    per stage per step, so the same analysis/extraction passes run —
+    minus per-partition detail)."""
     with open(path) as f:
         doc = json.load(f)
     if isinstance(doc, dict):
-        if "traceEvents" not in doc:
+        if "traceEvents" in doc:
+            events = doc["traceEvents"]
+        elif "steps" in doc and "fault_events" in doc:
+            events = flight_dump_events(doc)
+        else:
             raise ValueError(
-                f"{path}: not a chrome trace (object without 'traceEvents'; "
-                f"keys: {sorted(doc)[:8]})"
+                f"{path}: neither a chrome trace (no 'traceEvents') nor "
+                f"a flight-recorder dump (no 'steps'/'fault_events'); "
+                f"keys: {sorted(doc)[:8]}"
             )
-        events = doc["traceEvents"]
     else:
         events = doc
     return [e for e in events if isinstance(e, dict)]
+
+
+def flight_dump_events(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Synthesize chrome-style complete events from a flight-recorder
+    post-mortem's per-step ring: one span per (step, stage) with the
+    stage's cumulative run p50 as the duration, laid out on the ring's
+    own t_s timeline. Coarse by construction — it answers "which stage
+    moved" and feeds the degraded simulator extraction, not per-partition
+    attribution."""
+    events: List[Dict[str, Any]] = []
+    for s in doc.get("steps", []):
+        ts = float(s.get("t_s", 0.0)) * 1e6
+        for stage, row in (s.get("stages") or {}).items():
+            dur = row.get("run_p50_us")
+            if not dur:
+                continue
+            events.append({
+                "name": f"step{s.get('step')}",
+                "cat": "byteps", "ph": "X",
+                "ts": ts, "dur": float(dur),
+                "pid": 0, "tid": str(stage),
+                "args": {},
+            })
+    return events
 
 
 def _complete_events(events: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
@@ -357,13 +389,58 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="slowest partitions to list (default 5)")
     ap.add_argument("--json", action="store_true",
                     help="emit the full report as JSON instead of text")
+    ap.add_argument("--whatif-export", metavar="OUT.json", default=None,
+                    help="lift this recorded run into the what-if "
+                    "simulator's calibrated cost model (byteps_tpu/sim, "
+                    "docs/whatif.md) and write it as JSON: per-stage "
+                    "service fits from the same lifecycle/stat passes "
+                    "this CLI reports, codec table, round slack. The "
+                    "run's resolved config comes from the trace "
+                    "metadata's 'config' stamp; flight-recorder dumps "
+                    "are accepted as degraded input.")
     ns = ap.parse_args(argv)
+    if ns.whatif_export:
+        return _whatif_export(ns.trace, ns.whatif_export)
     report = analyze(load_events(ns.trace), top=ns.top)
     if ns.json:
         json.dump(report, sys.stdout, indent=1)
         print()
     else:
         print(render(report))
+    return 0
+
+
+def _whatif_export(trace_path: str, out_path: str) -> int:
+    """One command: recorded run -> simulator calibration input.
+    Imported lazily — the plain analysis CLI stays usable on a box
+    without the data plane's dependencies."""
+    from byteps_tpu.sim.extract import (
+        cost_model_from_events,
+        cost_model_from_flight_dump,
+    )
+
+    with open(trace_path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and "steps" in doc and "traceEvents" not in doc:
+        model = cost_model_from_flight_dump(doc)
+        src = "flight-recorder dump (degraded)"
+    else:
+        events = (doc.get("traceEvents", doc)
+                  if isinstance(doc, dict) else doc)
+        events = [e for e in events if isinstance(e, dict)]
+        config = (doc.get("metadata", {}).get("config", {})
+                  if isinstance(doc, dict) else {})
+        # the trace metadata's Config.snapshot() names the wire knobs;
+        # the codec is not a Config field — callers record it in
+        # metadata or rely on the recorded-codec default (raw)
+        model = cost_model_from_events(events, config=config)
+        src = "chrome trace"
+    with open(out_path, "w") as f:
+        json.dump(model.to_dict(), f, indent=1)
+    print(f"wrote calibrated cost model from {src} to {out_path} "
+          f"({len(model.tensors)} tensor(s), "
+          f"{len(model.stage_fits)} stage fits, "
+          f"round slack {model.round_slack_us:.0f}us)")
     return 0
 
 

@@ -240,6 +240,10 @@ class TraceRecorder:
                     "rank": self.rank,
                     "framework": "byteps_tpu",
                     "clock": "epoch_us",
+                    # the run's resolved knobs: a dumped trace is
+                    # replayable by the what-if simulator without
+                    # out-of-band knowledge (sim/extract.py)
+                    "config": get_config().snapshot(),
                     **self.metadata,
                 }),
             }

@@ -12,6 +12,15 @@ revert and switch knobs. Simpler than the reference's Bayesian optimizer
 but converges on the same two-knob space in tens of steps and has no
 dependencies. (On the fused jit path a partition-bytes move triggers one
 retrace per new value; the grid is small so compiles are cached.)
+
+With a ``proposer`` attached (``byteps_tpu.sim.search.make_proposer`` —
+the dPRO-style what-if simulator, docs/whatif.md), the tuner stops
+exploring neighbors blind: after each measurement window it asks the
+proposer for the next candidate (the simulator's predicted-fastest
+configs it has not yet measured) and converges the moment the proposer
+runs dry — live evaluations are spent CONFIRMING a simulated shortlist
+instead of walking the grid. No trace/proposer ⇒ the grid walk above,
+unchanged.
 """
 
 from __future__ import annotations
@@ -60,11 +69,24 @@ class AutoTuner:
         partition_bytes: int = 4 << 20,
         credit: int = 4,
         knobs: Tuple[str, ...] = ("partition", "credit"),
+        proposer: Optional[Callable[
+            [Tuple[int, int], Optional[float], dict],
+            Optional[Tuple[int, int]]]] = None,
     ) -> None:
         """``knobs`` restricts the search space: the fused jit path has no
         credit scheduler (XLA owns overlap), so it tunes ``("partition",)``
         only — every move there costs a retrace, and burning evaluations on
-        a knob with no effect would double convergence time."""
+        a knob with no effect would double convergence time.
+
+        ``proposer(best_cfg, best_time, measured) -> (pb, cr) | None``
+        replaces neighbor exploration with an externally ranked
+        candidate stream (the what-if simulator's shortlist —
+        ``sim.search.make_proposer``): ``measured`` maps every
+        (partition_bytes, credit) already evaluated to its best median,
+        and ``None`` means the stream is exhausted — the tuner then
+        converges on its measured best. Off-grid proposals snap to the
+        grids (the simulator's own grids match, so this is a no-op in
+        practice)."""
         _KNOBS = ("partition", "credit")
         bad = [k for k in knobs if k not in _KNOBS]
         if bad or not knobs:
@@ -92,6 +114,10 @@ class AutoTuner:
         self._knob_i = 0
         self._direction = +1
         self._exhausted = 0     # directions tried without improvement
+        self._proposer = proposer
+        # (partition_bytes, credit) -> best median measured there; what
+        # the proposer consults to skip already-evaluated configs
+        self.measured: dict = {}
         self.converged = False
         self._apply(self._current.partition_bytes, self._current.credit)
 
@@ -110,6 +136,9 @@ class AutoTuner:
 
     # -- hill climbing ------------------------------------------------------
     def _evaluate(self, t: float) -> None:
+        key = (self._current.partition_bytes, self._current.credit)
+        prev = self.measured.get(key)
+        self.measured[key] = t if prev is None else min(prev, t)
         if self._best_time is None or t < self._best_time * (1 - self._min_gain):
             if self._best_time is not None:
                 log.info(
@@ -125,6 +154,9 @@ class AutoTuner:
             self._current = self._best
             self._exhausted += 1
             self._rotate()
+        if self._proposer is not None:
+            self._propose_next()
+            return
         # Find the next candidate, skipping grid-edge dead ends WITHOUT
         # spending a measurement on them: starting at the top of the grid,
         # the +1 direction is exhausted for free and the -1 neighbor still
@@ -142,6 +174,29 @@ class AutoTuner:
             self._exhausted += 1
             self._rotate()
         self._current = nxt
+        self._apply(self._current.partition_bytes, self._current.credit)
+
+    def _propose_next(self) -> None:
+        """Simulator-guided move: ask the proposer for the next
+        unmeasured candidate; an exhausted stream converges on the
+        measured best (falls back to the grid walk only by never having
+        been constructed with a proposer)."""
+        nxt = self._proposer(
+            (self._best.partition_bytes, self._best.credit),
+            self._best_time, dict(self.measured))
+        if nxt is None:
+            self.converged = True
+            self._apply(self._best.partition_bytes, self._best.credit)
+            log.info("tuner converged (proposer exhausted): "
+                     "partition=%dKB credit=%d",
+                     self._best.partition_bytes >> 10, self._best.credit)
+            return
+        pb, cr = nxt
+        pi = min(range(len(PARTITION_GRID)),
+                 key=lambda i: abs(PARTITION_GRID[i] - pb))
+        ci = min(range(len(CREDIT_GRID)),
+                 key=lambda i: abs(CREDIT_GRID[i] - cr))
+        self._current = _Candidate(pi, ci)
         self._apply(self._current.partition_bytes, self._current.credit)
 
     def _rotate(self) -> None:

@@ -55,6 +55,32 @@ int bps_server_trace_dump(const char* path) {
   return bps::ServerTraceDump(path);
 }
 
+// ---- what-if simulator calibration (byteps_tpu/sim/extract.py) ------------
+// Price the server's REAL codec paths — push-side decode_sum and the
+// two-way re-encode — without a running server: the numpy wire codecs
+// are not rate-representative of these loops (bit unpack, scatter-add,
+// top-k reselection), and a what-if over a codec the recorded run never
+// exercised needs the C++ rates its PUSH/PULL spans would carry.
+int64_t bps_codec_decode_sum(uint8_t codec, const char* buf, int64_t len,
+                             float* dst, int64_t n) {
+  if (!bps::validate_payload(codec, buf, static_cast<size_t>(len), n))
+    return -1;
+  bps::decode_sum(codec, buf, static_cast<size_t>(len), dst, n);
+  return 0;
+}
+
+int64_t bps_codec_encode(uint8_t codec, const float* src, int64_t n,
+                         uint32_t topk_k, uint64_t seed, char* out,
+                         int64_t cap) {
+  bps::CodecHint hint;
+  hint.topk_k = topk_k;
+  std::vector<char> buf = bps::encode(codec, src, n, hint, seed);
+  if (static_cast<int64_t>(buf.size()) > cap)
+    return -static_cast<int64_t>(buf.size());
+  std::memcpy(out, buf.data(), buf.size());
+  return static_cast<int64_t>(buf.size());
+}
+
 // ---- in-process (IPC) fast path -------------------------------------------
 int bps_local_init(uint64_t key, uint64_t nbytes) {
   return bps::LocalInit(key, nbytes);

@@ -79,20 +79,20 @@ def load_lib() -> ctypes.CDLL:
             lib = ctypes.CDLL(_SO)
         try:
             # staleness probe: a prebuilt .so predating the newest API
-            # generation (bps_client_join — the scale-up elasticity
-            # surface; implies bps_client_pull3 and the membership API
-            # too) would otherwise be dlopen'd with a mismatched
-            # bps_server_start signature
-            lib.bps_client_join
+            # generation (bps_codec_encode — the what-if simulator's
+            # codec-calibration surface; implies bps_client_join, the
+            # membership API, and bps_client_pull3 too) would otherwise
+            # be dlopen'd with a mismatched bps_server_start signature
+            lib.bps_codec_encode
         except AttributeError:
             log.warning(
-                "native library predates the join/elasticity API; "
+                "native library predates the codec-calibration API; "
                 "rebuilding")
             os.remove(_SO)
             _build()
             lib = ctypes.CDLL(_SO)
             try:
-                lib.bps_client_join
+                lib.bps_codec_encode
             except AttributeError:
                 # dlopen matched the ALREADY-MAPPED stale object by path
                 # (nothing dlcloses the first handle), so the rebuild
@@ -118,6 +118,19 @@ def load_lib() -> ctypes.CDLL:
         lib.bps_float_to_fp8.restype = ctypes.c_uint8
         lib.bps_server_trace_dump.argtypes = [ctypes.c_char_p]
         lib.bps_server_trace_dump.restype = ctypes.c_int
+        # what-if simulator codec calibration (sim/extract.py): the
+        # server's REAL decode_sum / re-encode loops, priced offline
+        lib.bps_codec_decode_sum.argtypes = [
+            ctypes.c_uint8, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64,
+        ]
+        lib.bps_codec_decode_sum.restype = ctypes.c_int64
+        lib.bps_codec_encode.argtypes = [
+            ctypes.c_uint8, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_uint32, ctypes.c_uint64, ctypes.c_void_p,
+            ctypes.c_int64,
+        ]
+        lib.bps_codec_encode.restype = ctypes.c_int64
         lib.bps_server_epoch.argtypes = []
         lib.bps_server_epoch.restype = ctypes.c_uint64
         lib.bps_server_members.argtypes = [

@@ -1,0 +1,542 @@
+"""Discrete-event replay engine: one recorded run, any config.
+
+The real data plane is deterministic where it matters (SURVEY §5.1 is
+why the fork exists; ROADMAP item 3 is why this module does): wire time
+is token-bucket arithmetic (``server/pacer.py``), codec bytes-on-wire
+are closed-form per codec (``compression/wire.py``), and the scheduler's
+issue rules — priority order, credit gates, per-owner pools, the
+rounds window — are explicit state machines (``common/scheduler.py``).
+This engine re-expresses those rules as simulation events over a
+:class:`~byteps_tpu.sim.extract.CostModel` calibrated from one recorded
+run, so a hypothetical :class:`SimConfig` (partition bytes × credits ×
+codec × staleness K × wire tier rate × controller count × owner salt)
+is *replayed*, not curve-fit.
+
+What is REPLAYED (event rules copied from the production code):
+
+* priority-ordered issue per stage, ties by key, skip-blocked-heads
+  (``PipelineScheduler._pump`` / ``_StageQueue.pop_ready``);
+* the credit budget — acquired at the first credited stage, wire-scoped
+  release on PUSH exit (``Stage.releases_credit``), per-owner pools
+  under ``pod_controllers > 1`` with rendezvous-hashed ownership
+  (``common/partition.owner_for_key``, salt included);
+* the per-key rounds window (``BYTEPS_STALENESS``): a task more than K
+  rounds ahead of its key's oldest in-flight round is skipped, not
+  head-blocked;
+* the summation server's round ladder: a round closes when every live
+  worker contributed, a pull for round v is served from the newest
+  closed round ≥ max(1, v−K), and a pull past the bound FORCE-closes
+  straggler-held rounds over whoever contributed — never an empty
+  round (``server/csrc/server.cc`` ServeMin/ForceMin/ForceAdvance);
+* the pacer's deficit token bucket, bit-for-bit (64 KB burst,
+  per-direction, per-NIC): a charge at time t books its bytes and
+  completes at t + max(0, −avail/rate).
+
+What is MODELED (calibrated, not replayed): per-stage service times —
+fixed per-task overhead plus a per-byte slope fit from the recorded
+spans, with per-codec encode/decode throughputs micro-calibrated at
+extract time for codecs the recorded run never exercised
+(docs/whatif.md lists the assumptions).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import random
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from byteps_tpu.common.partition import owner_for_key
+from byteps_tpu.common.stage_orders import (
+    DCN_STAGE_ORDER,
+    HYBRID_STAGE_ORDER,
+)
+
+# stage-name -> service kind; the graph itself comes from the declared
+# stage orders (stage_orders.py), so a pipeline growing a stage shows up
+# here as a KeyError instead of silently mis-simulating
+_STAGE_KINDS = {
+    "REDUCE": "compute", "COPYD2H": "compute", "COPYH2D": "compute",
+    "ALLGATHER": "compute", "COMPRESS": "compress", "PUSH": "push",
+    "PULL": "pull", "DECOMPRESS": "decompress",
+    "PUSHPULL": "compute", "SYNC": "compute",
+}
+# DcnCore's constructor pool sizes (dcn_adapter.py stage list)
+_POOL_SIZES = {"COMPRESS": 2, "PUSH": 4, "PULL": 4, "DECOMPRESS": 2}
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """One hypothetical configuration to replay the recorded run under.
+
+    Mirrors the live knobs: ``BYTEPS_PARTITION_BYTES``,
+    ``BYTEPS_SCHEDULING_CREDIT``, the wire codec,
+    ``BYTEPS_DCN_THROTTLE_MBPS`` (0 = calibrated loopback rate),
+    ``BYTEPS_STALENESS``, ``BYTEPS_POD_CONTROLLERS`` /
+    ``BYTEPS_OWNER_SALT``, and the worker count. ``worker_speed``
+    optionally slows individual workers (a 5× straggler is
+    ``(1, 1, 5)``) for chaos-leg what-ifs. ``pipelined=None`` derives
+    the enqueue policy from K: strict-sync callers enqueue round r+1
+    after r assembles; bounded-staleness callers keep K+1 rounds in
+    flight and the rounds window gates the run-ahead."""
+
+    partition_bytes: int = 4096000
+    credit: int = 4
+    codec: str = "raw"
+    throttle_mbps: float = 0.0
+    staleness: int = 0
+    pod_controllers: int = 1
+    owner_salt: int = 0
+    num_workers: int = 1
+    rounds: int = 3
+    two_way: bool = True
+    pipelined: Optional[bool] = None
+    worker_speed: Tuple[float, ...] = ()
+    seed: int = 0
+    jitter: float = 0.0
+
+    def knobs(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class SimResult:
+    """Prediction for one (CostModel, SimConfig) replay."""
+
+    step_time_s: float            # median per-round time (the headline)
+    round_times_s: List[float]    # per-round completion deltas
+    makespan_s: float             # first enqueue -> last completion
+    tasks: int
+    config: SimConfig
+    stage_busy_s: Dict[str, float]
+    # every stage issue as (t_s, stage, key, round, worker) in issue
+    # order — what the scheduler-agreement tests pin against the real
+    # PipelineScheduler's recorded order
+    issues: List[Tuple[float, str, int, int, int]] = dataclasses.field(
+        default_factory=list)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "step_time_s": round(self.step_time_s, 6),
+            "round_times_s": [round(t, 6) for t in self.round_times_s],
+            "makespan_s": round(self.makespan_s, 6),
+            "tasks": self.tasks,
+            "config": self.config.knobs(),
+        }
+
+
+class _Bucket:
+    """The pacer's deficit token bucket on a virtual clock
+    (``server/pacer.TokenBucket`` arithmetic, sleep -> completion time)."""
+
+    __slots__ = ("rate", "burst", "avail", "last")
+
+    def __init__(self, rate_bytes_per_s: float, burst: float = 64 << 10):
+        self.rate = float(rate_bytes_per_s)
+        self.burst = float(burst)
+        self.avail = self.burst
+        self.last = 0.0
+
+    def charge(self, t: float, nbytes: float) -> float:
+        """Book ``nbytes`` at time ``t``; returns the completion time."""
+        if nbytes <= 0 or self.rate <= 0:
+            return t
+        self.avail = min(self.burst,
+                         self.avail + (t - self.last) * self.rate)
+        self.last = t
+        self.avail -= nbytes
+        return t + (-self.avail / self.rate if self.avail < 0 else 0.0)
+
+
+class _Task:
+    __slots__ = ("worker", "key", "part_idx", "length", "priority",
+                 "round", "owner", "stage_idx", "holds_credit",
+                 "credit_pool", "seq")
+
+    def __init__(self, worker, key, part_idx, length, priority, rnd,
+                 owner, seq):
+        self.worker = worker
+        self.key = key
+        self.part_idx = part_idx
+        self.length = length
+        self.priority = priority
+        self.round = rnd
+        self.owner = owner
+        self.stage_idx = 0
+        self.holds_credit = False
+        self.credit_pool = 0
+        self.seq = seq
+
+    @property
+    def sort_key(self):
+        # PipelineScheduler._StageQueue order: max priority first, ties
+        # by key, FIFO within
+        return (-self.priority, self.key, self.seq)
+
+
+class _KeyStore:
+    """Per-key server round ladder (server.cc KeyStore, timing only)."""
+
+    __slots__ = ("closed", "arrived", "close_t", "parked")
+
+    def __init__(self):
+        self.closed = 0                 # newest closed round (1-based)
+        self.arrived: Dict[int, set] = {}
+        self.close_t = 0.0
+        self.parked: List[tuple] = []   # (serve_min, task, issue_t)
+
+
+class _WorkerState:
+    """One worker's pipeline mirror: queues, busy counts, credit pools,
+    per-key in-flight rounds, per-owner NIC buckets."""
+
+    def __init__(self, cfg: SimConfig, n_stages: int, rate: float):
+        self.queues: List[List[tuple]] = [[] for _ in range(n_stages)]
+        self.busy = [0] * n_stages
+        self.credit_total = max(1, cfg.credit)
+        self.credits = self.credit_total
+        self.owner_credits: Dict[int, int] = {}
+        self.owner_scope = cfg.pod_controllers > 1
+        self.key_rounds: Dict[int, set] = {}
+        self.send = [_Bucket(rate) for _ in range(cfg.pod_controllers)]
+        self.recv = [_Bucket(rate) for _ in range(cfg.pod_controllers)]
+        self.round_remaining: Dict[int, int] = {}
+        self.round_done_t: Dict[int, float] = {}
+        self.round_enqueued = 0
+
+    def credit_available(self, task: _Task) -> bool:
+        if not self.owner_scope:
+            return self.credits > 0
+        return self.owner_credits.get(task.owner, self.credit_total) > 0
+
+    def acquire_credit(self, task: _Task) -> None:
+        task.holds_credit = True
+        if not self.owner_scope:
+            task.credit_pool = 0
+            self.credits -= 1
+            return
+        task.credit_pool = task.owner
+        self.owner_credits[task.owner] = self.owner_credits.get(
+            task.owner, self.credit_total) - 1
+
+    def release_credit(self, task: _Task) -> None:
+        if not task.holds_credit:
+            return
+        task.holds_credit = False
+        if not self.owner_scope:
+            self.credits = min(self.credits + 1, self.credit_total)
+            return
+        pool = task.credit_pool
+        self.owner_credits[pool] = min(
+            self.owner_credits.get(pool, self.credit_total) + 1,
+            self.credit_total)
+
+
+def build_stages(pipeline: Sequence[str]) -> List[Tuple[str, str, int]]:
+    """(name, kind, pool_size) rows for a declared stage order — the
+    dependency graph is the pipeline order itself (each partition walks
+    the stages in sequence; cross-partition edges come from the credit/
+    pool/round gates)."""
+    rows = []
+    for name in pipeline:
+        kind = _STAGE_KINDS[name]
+        rows.append((name, kind, _POOL_SIZES.get(name, 2)))
+    return rows
+
+
+def simulate(model, cfg: SimConfig) -> SimResult:
+    """Replay ``model`` (a :class:`~byteps_tpu.sim.extract.CostModel`)
+    under ``cfg``. Pure and deterministic: same model + same config +
+    same seed -> bit-identical result (pinned in tests/test_sim.py)."""
+    pipeline = (DCN_STAGE_ORDER if model.pipeline == "dcn"
+                else HYBRID_STAGE_ORDER)
+    stages = build_stages(pipeline)
+    n_stages = len(stages)
+    n_workers = max(1, cfg.num_workers)
+    rate = model.wire_rate_bps(cfg.throttle_mbps)
+    rng = random.Random(cfg.seed)
+
+    def jit() -> float:
+        if cfg.jitter <= 0:
+            return 1.0
+        return 1.0 + cfg.jitter * (2.0 * rng.random() - 1.0)
+
+    def speed(w: int) -> float:
+        if w < len(cfg.worker_speed):
+            return max(1e-9, float(cfg.worker_speed[w]))
+        return 1.0
+
+    workers = [_WorkerState(cfg, n_stages, rate) for _ in range(n_workers)]
+    keystores: Dict[int, _KeyStore] = {}
+    stage_busy_s: Dict[str, float] = {s[0]: 0.0 for s in stages}
+
+    # partition layout under the hypothetical partition size
+    parts = model.partition_layout(cfg.partition_bytes)
+    tasks_per_round = len(parts)
+    live = set(range(n_workers))
+    k = max(0, int(cfg.staleness))
+    pipelined = cfg.pipelined if cfg.pipelined is not None else k > 0
+    rounds_window = k if k > 0 else None
+
+    events: List[tuple] = []   # (t, seq, kind, payload)
+    seq_counter = [0]
+
+    def push_event(t: float, kind: str, payload) -> None:
+        seq_counter[0] += 1
+        heapq.heappush(events, (t, seq_counter[0], kind, payload))
+
+    def ks(key: int) -> _KeyStore:
+        st = keystores.get(key)
+        if st is None:
+            st = keystores[key] = _KeyStore()
+        return st
+
+    def enqueue_round(w: int, rnd: int, t: float) -> None:
+        ws = workers[w]
+        ws.round_remaining[rnd] = tasks_per_round
+        ws.round_enqueued = max(ws.round_enqueued, rnd + 1)
+        for (key, part_idx, length, priority) in parts:
+            seq_counter[0] += 1
+            task = _Task(w, key, part_idx, length, priority, rnd,
+                         owner_for_key(key, set(range(cfg.pod_controllers)),
+                                       cfg.owner_salt),
+                         seq_counter[0])
+            if rounds_window is not None:
+                ws.key_rounds.setdefault(key, set()).add(rnd)
+            heapq.heappush(ws.queues[0], (task.sort_key, task))
+
+    def round_ready(ws: _WorkerState, task: _Task) -> bool:
+        if rounds_window is None:
+            return True
+        rounds = ws.key_rounds.get(task.key)
+        if not rounds:
+            return True
+        return task.round - min(rounds) <= rounds_window
+
+    def pop_ready(ws: _WorkerState, si: int, credited: bool):
+        """pop_ready semantics: highest-priority task passing the round
+        window and (for credited stages) the credit gate; blocked heads
+        are skipped, keeping their position."""
+        q = ws.queues[si]
+        skipped = []
+        got = None
+        while q:
+            item = heapq.heappop(q)
+            task = item[1]
+            if round_ready(ws, task) and (
+                    not credited or task.holds_credit
+                    or ws.credit_available(task)):
+                got = task
+                break
+            skipped.append(item)
+        for it in skipped:
+            heapq.heappush(q, it)
+        return got
+
+    # --- server round ladder (ServeMin / ForceMin / ForceAdvance) -----------
+    def serve_min(v: int) -> int:
+        return max(1, v - k) if k > 0 else v
+
+    def force_min(v: int) -> int:
+        return v - k if (k > 0 and v > k) else 0
+
+    def release_parked(st: _KeyStore, t: float) -> None:
+        if not st.parked:
+            return
+        still = []
+        for (smin, task, issue_t) in st.parked:
+            if st.closed >= smin:
+                finish_pull(task, max(t, issue_t))
+            else:
+                still.append((smin, task, issue_t))
+        st.parked = still
+
+    def close_rounds(key: int, st: _KeyStore, upto: int, t: float) -> None:
+        """FORCE-close rounds sequentially up to ``upto`` while
+        contributions exist (never an empty round — ForceAdvanceLocked),
+        then release any parked pulls the advance satisfied."""
+        while st.closed < upto and st.arrived.get(st.closed + 1):
+            st.closed += 1
+            st.arrived.pop(st.closed, None)
+            st.close_t = t
+        release_parked(st, t)
+
+    def on_push_arrived(key: int, worker: int, rnd: int, t: float) -> None:
+        st = ks(key)
+        v = rnd + 1
+        st.arrived.setdefault(v, set()).add(worker)
+        # natural close: every live worker contributed, in round order
+        while (st.arrived.get(st.closed + 1) is not None
+               and live <= st.arrived[st.closed + 1]):
+            st.closed += 1
+            st.arrived.pop(st.closed, None)
+            st.close_t = t
+        # the push that just landed may be the contribution that lets a
+        # parked fast-worker pull force the ladder forward
+        # (ForcePendingLocked)
+        if st.parked:
+            target = max(force_min(p_task.round + 1)
+                         for (_, p_task, _) in st.parked)
+            if target > st.closed:
+                close_rounds(key, st, target, t)
+        release_parked(st, t)
+
+    # --- the server as a resource --------------------------------------------
+    # The engine pool's decode_sum/encode loops are MEMORY-BANDWIDTH
+    # bound: concurrent slots do not add throughput (measured — the
+    # first pull after a push burst waits out the whole decode backlog
+    # at the single-thread rate), so the server books work on ONE
+    # serialized timeline, exactly like a bucket charge.
+    server_free_at = [0.0]
+    encode_memo: Dict[Tuple[int, int], float] = {}
+
+    def server_book(t_ready: float, dur_us: float) -> float:
+        start = max(t_ready, server_free_at[0])
+        end = start + dur_us * 1e-6
+        server_free_at[0] = end
+        return end
+
+    # --- stage service + completion ------------------------------------------
+    def finish_pull(task: _Task, t_served: float) -> None:
+        """Round served: the server re-encodes the aggregate (once per
+        (key, round) — every worker pulls the same snapshot, server.cc
+        caches the re-encode), the response transits the worker's recv
+        bucket, and the PULL stage completes."""
+        ws = workers[task.worker]
+        st = ks(task.key)
+        memo_key = (task.key, st.closed)
+        t_resp = encode_memo.get(memo_key)
+        if t_resp is None:
+            t_resp = server_book(t_served, model.server_pull_us(
+                cfg.codec, task.length, cfg.two_way))
+            encode_memo[memo_key] = t_resp
+        t_resp = max(t_resp, t_served)
+        nbytes = model.pull_wire_bytes(cfg.codec, task.length, cfg.two_way)
+        t_done = ws.recv[task.owner].charge(t_resp, nbytes)
+        t_done += model.stage_overhead_us("PULL") * 1e-6 * jit() \
+            * speed(task.worker)
+        push_event(t_done, "done", task)
+
+    issues: List[Tuple[float, str, int, int, int]] = []
+
+    def issue(si: int, task: _Task, t: float) -> None:
+        name, kind, _pool = stages[si]
+        issues.append((t, name, task.key, task.round, task.worker))
+        ws = workers[task.worker]
+        f = speed(task.worker) * jit()
+        if kind == "push":
+            over = model.stage_overhead_us(name) * 1e-6 * f
+            nbytes = model.wire_bytes(cfg.codec, task.length)
+            # the ack does NOT wait for the sum (server.cc: pipelined
+            # pushes are legal) — the PUSH span ends at wire completion;
+            # the apply books separately on the server resource
+            t_done = ws.send[task.owner].charge(t + over, nbytes)
+            stage_busy_s[name] += t_done - t
+            push_event(t_done, "push_done", task)
+        elif kind == "pull":
+            t_req = t + model.stage_overhead_us("PULL_REQ") * 1e-6 * f
+            st = ks(task.key)
+            v = task.round + 1
+            fm = force_min(v)
+            if fm > st.closed:
+                close_rounds(task.key, st, fm, t_req)
+            if st.closed >= serve_min(v):
+                finish_pull(task, max(t_req, st.close_t))
+            else:
+                st.parked.append((serve_min(v), task, t_req))
+        else:
+            dur = model.compute_us(name, cfg.codec, task.length) * 1e-6 * f
+            stage_busy_s[name] += dur
+            push_event(t + dur, "done", task)
+
+    def pump(t: float) -> None:
+        while True:
+            issued = False
+            for w in range(n_workers):
+                ws = workers[w]
+                for si, (name, kind, pool) in enumerate(stages):
+                    if not ws.queues[si] or ws.busy[si] >= pool:
+                        continue
+                    credited = name in ("COMPRESS", "PUSH")
+                    task = pop_ready(ws, si, credited)
+                    if task is None:
+                        continue
+                    if credited and not task.holds_credit:
+                        ws.acquire_credit(task)
+                    ws.busy[si] += 1
+                    issue(si, task, t)
+                    issued = True
+                    break
+                if issued:
+                    break
+            if not issued:
+                return
+
+    # --- main loop -----------------------------------------------------------
+    for w in range(n_workers):
+        if pipelined:
+            for rnd in range(cfg.rounds):
+                enqueue_round(w, rnd, 0.0)
+        else:
+            enqueue_round(w, 0, 0.0)
+    pump(0.0)
+
+    while events:
+        t, _seq, kind, task = heapq.heappop(events)
+        if kind == "apply":
+            key, wkr, rnd = task
+            on_push_arrived(key, wkr, rnd, t)
+            pump(t)
+            continue
+        ws = workers[task.worker]
+        si = task.stage_idx
+        if kind == "push_done":
+            # apply books on the serialized server resource; the round
+            # bookkeeping fires when the decode_sum actually lands
+            t_apply = server_book(
+                t, model.server_push_us(cfg.codec, task.length))
+            push_event(t_apply, "apply",
+                       (task.key, task.worker, task.round))
+            ws.busy[si] -= 1
+            ws.release_credit(task)   # releases_credit: wire-scoped
+        else:
+            ws.busy[si] -= 1
+        if si + 1 < n_stages:
+            task.stage_idx = si + 1
+            heapq.heappush(ws.queues[si + 1], (task.sort_key, task))
+        else:
+            # finish: retire round, release any held credit
+            ws.release_credit(task)
+            if rounds_window is not None:
+                rounds = ws.key_rounds.get(task.key)
+                if rounds is not None:
+                    rounds.discard(task.round)
+                    if not rounds:
+                        ws.key_rounds.pop(task.key, None)
+            ws.round_remaining[task.round] -= 1
+            if ws.round_remaining[task.round] == 0:
+                ws.round_done_t[task.round] = t
+                if not pipelined and ws.round_enqueued < cfg.rounds:
+                    enqueue_round(task.worker, ws.round_enqueued, t)
+        pump(t)
+
+    # --- results -------------------------------------------------------------
+    done_t = [max(ws.round_done_t.get(r, 0.0) for ws in workers)
+              for r in range(cfg.rounds)]
+    round_times: List[float] = []
+    prev = 0.0
+    for t in done_t:
+        round_times.append(t - prev)
+        prev = t
+    srt = sorted(round_times)
+    mid = len(srt) // 2
+    step = (srt[mid] if len(srt) % 2 else 0.5 * (srt[mid - 1] + srt[mid]))
+    return SimResult(
+        step_time_s=step,
+        round_times_s=round_times,
+        makespan_s=done_t[-1] if done_t else 0.0,
+        tasks=tasks_per_round * cfg.rounds * n_workers,
+        config=cfg,
+        stage_busy_s={k_: round(v, 6) for k_, v in stage_busy_s.items()},
+        issues=issues,
+    )

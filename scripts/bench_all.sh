@@ -47,7 +47,8 @@ run_trend_leg --mode serve               # continuous-batching serve vs sequenti
 run --mode dcn                           # DCN summation tier
 run --mode dcn-profile                   # host component ceilings
 run_trend_leg --mode throttled           # compression race on emulated slow DCN (+BENCH_throttled.json)
-run --mode tune                          # joint (partition, credit) auto-tune
+run_trend_leg --mode whatif              # trace-driven what-if simulator: replay one recorded leg, predict the sweep; floor: prediction accuracy (+BENCH_whatif.json)
+run --mode tune                          # joint (partition, credit) auto-tune incl. the sim-proposed race
 run_trend_leg --mode chaos               # goodput vs fault rate incl. the bounded-staleness slow-worker leg (straggler_ratio) AND the scale-up churn leg: 2→4→3→5 mid-stream join/leave schedule (churn_goodput_tracking) (+BENCH_chaos.json)
 run_trend_leg --mode hybrid              # sharded-wire hierarchical race (+BENCH_hybrid.json)
 run_trend_leg --mode ici                 # compressed ICI tier race: staged vs ring vs native psum (+BENCH_ici.json)
