@@ -971,6 +971,26 @@ def bench_serve(reps: int = 3, n_requests: int = 24,
       (docs/serving.md §prefix cache). Headline
       ``prefix_ttft_p50_speedup`` (trend-gated, >= 2x acceptance bar);
       on/off token streams are asserted identical in-run.
+    * **disaggregated-vs-colocated race** — a mixed long-prompt /
+      short-decode trace at saturation through 1 prefill + 1 decode
+      replica (KV blocks streaming over the ``serve/kv_wire.py``
+      migration wire) vs 2 colocated replicas (docs/serving.md
+      §disaggregation). Colocated, every short request's TTFT waits
+      behind a long prompt's multi-chunk prefill on its replica;
+      disaggregated, shorts prefill in place on the decode replica
+      while longs own the prefill tier. Headline
+      ``disagg_ttft_p99_speedup`` — p99 TTFT of the latency-SLO
+      (short) class, the DistServe-style per-class methodology —
+      trend-gated, >= 1.5x acceptance bar; the long class and overall
+      percentiles ride in ``results.disagg_race``. Token streams are
+      asserted identical across the two topologies in-run.
+    * **migrate-don't-evict race** — a tight pool on one replica +
+      a roomy sibling, migration ON vs OFF: ON, the preemption
+      victim's committed KV blocks move over the wire
+      (``serve.migration.recompute_tokens`` stays 0); OFF, the classic
+      evict recomputes them. Headline ``migrate_recompute_saved`` =
+      1 − recompute_on/recompute_off (trend-gated, ~1.0 = migration
+      eliminates the recompute bill).
 
     Outputs are bit-identical to the sequential leg's tokens by the
     serve tier's exactness contract (pinned in tests/test_serve.py);
@@ -978,9 +998,10 @@ def bench_serve(reps: int = 3, n_requests: int = 24,
     tokens/s == tokens/s/chip. Artifact: BENCH_serve.json (+ the
     ``--mode trend`` gate floors the headline)."""
     on_cpu = jax.devices()[0].platform == "cpu"
+    from byteps_tpu.common.metrics import get_registry
     from byteps_tpu.models import GPTConfig, gpt_init
     from byteps_tpu.models.generate import make_generate_fn
-    from byteps_tpu.serve import Request, Scheduler
+    from byteps_tpu.serve import Request, Router, Scheduler
 
     if quick:
         cfg = GPTConfig.tiny()
@@ -1137,6 +1158,150 @@ def bench_serve(reps: int = 3, n_requests: int = 24,
     pref_p50 = pref_off["ttft_ms_p50"] / pref_on["ttft_ms_p50"]
     pref_p99 = pref_off["ttft_ms_p99"] / pref_on["ttft_ms_p99"]
 
+    # --- disaggregated-vs-colocated race (docs/serving.md §disaggregation) -
+    if quick:
+        long_len, short_len, n_long, n_short, race_new = 20, 4, 2, 6, 4
+    else:
+        long_len = min(224, cfg.max_seq - 48)
+        short_len, n_long, n_short, race_new = 16, 3, 12, 8
+    race_thr = (short_len + long_len) // 2
+    race_trace = []
+    for i in range(n_long):
+        race_trace.append(rng.integers(0, cfg.vocab_size,
+                                       long_len).astype(np.int32))
+        for _ in range(n_short // n_long):
+            race_trace.append(rng.integers(0, cfg.vocab_size,
+                                           short_len).astype(np.int32))
+    while len(race_trace) < n_long + n_short:
+        race_trace.append(rng.integers(0, cfg.vocab_size,
+                                       short_len).astype(np.int32))
+
+    def run_disagg(disagg):
+        """The mixed trace at saturation through 1 prefill + 1 decode
+        replica (migration wire) vs 2 colocated replicas — same chip
+        count, same requests, same submission order."""
+        if disagg:
+            pre = Scheduler(params, cfg, max_batch=max_batch,
+                            prefill_chunk=prefill_chunk, role="prefill",
+                            replica_id=1)
+            dec = Scheduler(params, cfg, max_batch=max_batch,
+                            prefill_chunk=prefill_chunk, role="decode",
+                            replica_id=0)
+            router = Router([dec], prefill_replicas=[pre],
+                            lease_ms=600000, prompt_threshold=race_thr,
+                            migrate_preempt=False)
+        else:
+            router = Router([Scheduler(params, cfg, max_batch=max_batch,
+                                       prefill_chunk=prefill_chunk,
+                                       replica_id=i) for i in range(2)],
+                            lease_ms=600000, migrate_preempt=False)
+        reqs = [Request(rid=i, prompt=p, max_new=race_new)
+                for i, p in enumerate(race_trace)]
+        t0 = time.monotonic()
+        res = router.run(reqs)
+        makespan = time.monotonic() - t0
+        router.close()
+        for sched in router.replicas:
+            assert sched.cache.leaked_blocks() == 0, "KV block leak"
+        return makespan, res
+
+    def race_stats(runs):
+        out = {"sec_med": 0.0, "sec_spread": [0.0, 0.0]}
+        mks = sorted(m for m, _ in runs)
+        out["sec_med"] = round(float(np.median(mks)), 4)
+        out["sec_spread"] = [round(mks[0], 4), round(mks[-1], 4)]
+        for cls, sel in (("short", lambda i: race_trace[i].size
+                          == short_len),
+                         ("long", lambda i: race_trace[i].size
+                          != short_len),
+                         ("all", lambda i: True)):
+            tt = [res[i]["ttft_s"] * 1e3 for _, res in runs
+                  for i in range(len(race_trace)) if sel(i)]
+            out[f"ttft_ms_p50_{cls}"] = round(
+                float(np.percentile(tt, 50)), 2)
+            out[f"ttft_ms_p99_{cls}"] = round(
+                float(np.percentile(tt, 99)), 2)
+        return out
+
+    run_disagg(True)                      # warm both role's programs
+    race_reps = max(1, reps - 1)
+    disagg_runs = [run_disagg(True) for _ in range(race_reps)]
+    colo_runs = [run_disagg(False) for _ in range(race_reps)]
+    # exactness rides along: the two topologies must emit identical
+    # token streams (migration moves bytes, never content)
+    for (_, rd), (_, rc) in zip(disagg_runs, colo_runs):
+        for i in range(len(race_trace)):
+            if not np.array_equal(rd[i]["tokens"], rc[i]["tokens"]):
+                raise AssertionError(
+                    f"disagg/colocated outputs diverged for request {i}")
+    dis = race_stats(disagg_runs)
+    col = race_stats(colo_runs)
+    results["disagg_race"] = {
+        "trace": {"n_long": n_long, "long_tokens": long_len,
+                  "n_short": n_short, "short_tokens": short_len,
+                  "max_new": race_new, "prompt_threshold": race_thr},
+        "disagg": dis, "colocated": col,
+    }
+    disagg_p99 = col["ttft_ms_p99_short"] / dis["ttft_ms_p99_short"]
+
+    # --- migrate-don't-evict race ------------------------------------------
+    if quick:
+        mig_bs, mig_pool, mig_prompt, mig_new, mig_n = 4, 1 + 10, 14, 10, 4
+    else:
+        mig_bs, mig_pool, mig_prompt, mig_new, mig_n = \
+            16, 1 + 9, 48, 32, 4
+    mig_trace = [rng.integers(0, cfg.vocab_size,
+                              mig_prompt).astype(np.int32)
+                 for _ in range(mig_n)]
+
+    def run_migrate(on):
+        """Tight pool on replica A + roomy sibling B: pressure on A
+        either MIGRATES its victim's blocks to B (on) or evicts and
+        recomputes (off). Reads the recompute/migrate counters as
+        registry deltas around the run."""
+        a = Scheduler(params, cfg, max_batch=2, block_size=mig_bs,
+                      prefill_chunk=prefill_chunk, pool_blocks=mig_pool,
+                      replica_id=0)
+        b = Scheduler(params, cfg, max_batch=2, block_size=mig_bs,
+                      prefill_chunk=prefill_chunk, replica_id=1)
+        router = Router([a, b], lease_ms=600000, migrate_preempt=on)
+        reqs = [Request(rid=i, prompt=p, max_new=mig_new)
+                for i, p in enumerate(mig_trace)]
+        c0 = get_registry().snapshot()["counters"]
+        t0 = time.monotonic()
+        res = router.run(reqs)
+        makespan = time.monotonic() - t0
+        router.close()
+        c1 = get_registry().snapshot()["counters"]
+        assert a.cache.leaked_blocks() == 0, "KV block leak"
+        assert b.cache.leaked_blocks() == 0, "KV block leak"
+
+        def delta(k):
+            return int(c1.get(k, 0)) - int(c0.get(k, 0))
+
+        return {
+            "sec": round(makespan, 4),
+            "recompute_tokens": delta("serve.migration.recompute_tokens"),
+            "migrated_requests": delta("serve.migration.out_requests"),
+            "preempted": delta("serve.preempted"),
+        }, res
+
+    run_migrate(True)                                # warm shapes
+    mig_on, mig_on_res = run_migrate(True)
+    mig_off, mig_off_res = run_migrate(False)
+    for i in range(mig_n):
+        if not np.array_equal(mig_on_res[i]["tokens"],
+                              mig_off_res[i]["tokens"]):
+            raise AssertionError(
+                f"migrate on/off outputs diverged for request {i}")
+    if mig_off["recompute_tokens"] <= 0:
+        raise AssertionError(
+            "migrate race created no preemption pressure — the off leg "
+            "recomputed nothing, the comparison is vacuous")
+    mig_saved = 1.0 - (mig_on["recompute_tokens"]
+                       / mig_off["recompute_tokens"])
+    results["migrate_preempt"] = {"on": mig_on, "off": mig_off}
+
     _log(f"serve: {n_requests} requests ({total_new} new tokens) — "
          f"sequential {sequential['tokens_per_s']} tok/s, saturation "
          f"{sat['tokens_per_s']} tok/s ({speedup:.2f}x), TTFT p50/p99 "
@@ -1147,6 +1312,12 @@ def bench_serve(reps: int = 3, n_requests: int = 24,
          f"{pref_off['ttft_ms_p50']} -> {pref_on['ttft_ms_p50']} ms "
          f"({pref_p50:.2f}x), p99 {pref_off['ttft_ms_p99']} -> "
          f"{pref_on['ttft_ms_p99']} ms ({pref_p99:.2f}x)")
+    _log(f"serve disagg: {n_long}x{long_len} long + {n_short}x"
+         f"{short_len} short — short-class TTFT p99 "
+         f"{col['ttft_ms_p99_short']} -> {dis['ttft_ms_p99_short']} ms "
+         f"({disagg_p99:.2f}x); migrate-don't-evict: recompute "
+         f"{mig_off['recompute_tokens']} -> {mig_on['recompute_tokens']} "
+         f"tokens (saved {mig_saved:.2f})")
     return {
         "metric": (f"continuous-batching serve, {n_requests} mixed-length "
                    f"requests (GPT d{cfg.d_model}/L{cfg.n_layers}, prompts "
@@ -1160,6 +1331,8 @@ def bench_serve(reps: int = 3, n_requests: int = 24,
         "prefix_ttft_p99_speedup": round(pref_p99, 3),
         "prefix_trace": {"n_requests": n_pref, "shared_tokens": sys_len,
                          "tail_tokens": tail_len, "max_new": pref_new},
+        "disagg_ttft_p99_speedup": round(disagg_p99, 3),
+        "migrate_recompute_saved": round(mig_saved, 3),
         "tokens_per_s_per_chip": sat["tokens_per_s"],
         "sequential": sequential,
         "results": results,
@@ -2902,6 +3075,12 @@ _TREND_SPECS = (
     ("BENCH_chaos.json", "churn_goodput_tracking"),
     ("BENCH_serve.json", "value"),
     ("BENCH_serve.json", "prefix_ttft_p50_speedup"),
+    # disaggregated prefill/decode: short-class p99 TTFT at saturation,
+    # disagg vs colocated (>= 1.5x acceptance bar), and the
+    # migrate-don't-evict recompute elimination (~1.0 = the evict
+    # path's recompute bill fully avoided) — docs/serving.md
+    ("BENCH_serve.json", "disagg_ttft_p99_speedup"),
+    ("BENCH_serve.json", "migrate_recompute_saved"),
     ("BENCH_ici.json", "ring_vs_staged_best"),
     ("BENCH_ici.json", "ring_bus_bw_best"),
     # what-if simulator prediction accuracy (1 − median rel err over the

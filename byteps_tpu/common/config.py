@@ -263,6 +263,26 @@ class Config:
     # — epoch bump, its in-flight requests re-queue to survivors.
     # Mirrors the PR 5 server-side worker-lease semantics.
     serve_replica_lease_ms: int = 1000
+    # --- disaggregated prefill/decode (docs/serving.md §disaggregation) ----
+    # Emulated per-replica KV-migration NIC rate in megabits/s: finished
+    # prefill blocks stream to the decode target through a token-bucket
+    # pacer at this rate (the PR 1 pacer philosophy — loopback behaves
+    # like the wire tier migration actually crosses). 0 = unthrottled.
+    serve_disagg_mbps: float = 0.0
+    # Admission classification knee: inputs of at least this many tokens
+    # route to the prefill tier (when one is armed); shorter prompts
+    # prefill in place on their decode replica. Shrinks 4x under decode
+    # pool pressure (<= 25% free) — the "prompt length x pool pressure"
+    # rule.
+    serve_disagg_prompt_threshold: int = 64
+    # Migrate-don't-evict: a pool-pressure preemption victim's committed
+    # KV blocks move to a sibling replica over the KV wire instead of
+    # being freed and recomputed (needs >= 2 decode-capable replicas
+    # behind a Router). 0 = classic evict + recompute-on-resume.
+    serve_disagg_migrate: bool = True
+    # KVCOMPRESS->KVPUSH credits per migration wire: how many encoded
+    # blocks may sit between the codec and a throttled wire.
+    serve_disagg_credit: int = 4
 
     # --- tracing (SURVEY §5.1) ---------------------------------------------
     trace_on: bool = False
@@ -356,6 +376,12 @@ class Config:
                                          True),
             serve_replica_lease_ms=_env_int(
                 "BYTEPS_SERVE_REPLICA_LEASE_MS", 1000),
+            serve_disagg_mbps=_env_float("BYTEPS_SERVE_DISAGG_MBPS", 0.0),
+            serve_disagg_prompt_threshold=_env_int(
+                "BYTEPS_SERVE_DISAGG_PROMPT_THRESHOLD", 64),
+            serve_disagg_migrate=_env_bool("BYTEPS_SERVE_DISAGG_MIGRATE",
+                                           True),
+            serve_disagg_credit=_env_int("BYTEPS_SERVE_DISAGG_CREDIT", 4),
             trace_on=_env_bool("BYTEPS_TRACE_ON"),
             trace_dir=_env_str("BYTEPS_TRACE_DIR", "./traces"),
             trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 1),

@@ -13,7 +13,7 @@ Spec grammar (semicolon-separated rules)::
     BYTEPS_FAULT_SPEC = rule (';' rule)*
     rule   = scope ':' kind ['@' cond (',' cond)*]
     scope  = 'push' | 'pull' | 'init' | 'all' | 'server<N>' | 'worker'
-           | 'worker<N>'
+           | 'worker<N>' | 'replica' | 'replica<N>'
              # push/pull/all match DATA-PLANE ops only ('all' = push+pull);
              # 'init' matches key-init attempts only (kill = the init
              # never reached the server; timeout = applied, ack lost);
@@ -29,7 +29,15 @@ Spec grammar (semicolon-separated rules)::
              # to every worker, so 'worker1:slow@ms=80' makes exactly
              # worker 1 a deterministic straggler (every one of its wire
              # attempts pays 80 ms) while its peers run clean — the
-             # bounded-staleness bench's slow-worker leg
+             # bounded-staleness bench's slow-worker leg; 'replica' /
+             # 'replica<N>' are the SERVE-tier twins: they match only
+             # the serve scheduler's per-iteration intercept (op
+             # 'serve'), never wire ops, so one spec string handed to
+             # every component kills/wedges/slows exactly one serve
+             # replica (replica<N> requires the plan's worker_id == N)
+             # — the disaggregation tests' deterministic
+             # decode-target-death and mid-migration-death legs
+             # (docs/serving.md §disaggregation)
     kind   = 'timeout' | 'kill' | 'slow' | 'corrupt' | 'down' | 'hang'
            | 'join'
              # 'join' (worker/worker<N> scopes only, deterministic —
@@ -98,7 +106,7 @@ __all__ = [
 ]
 
 KINDS = ("timeout", "kill", "slow", "corrupt", "down", "hang", "join")
-SCOPES = ("push", "pull", "all", "init", "worker")
+SCOPES = ("push", "pull", "all", "init", "worker", "replica")
 
 
 class InjectedTimeout(TimeoutError):
@@ -130,9 +138,9 @@ class FaultRule:
     window: Optional[Tuple[int, Optional[int]]] = None  # [a, b] op window
     latency_ms: int = 50       # for kind == 'slow' / 'hang'
     server: Optional[int] = None  # parsed from 'server<N>' scopes
-    # parsed from 'worker<N>' scopes: the rule only fires on the plan
-    # whose worker_id is N (the shared spec string selects ONE worker);
-    # None = the bare 'worker' scope, every plan's own worker
+    # parsed from 'worker<N>' / 'replica<N>' scopes: the rule only
+    # fires on the plan whose worker_id is N (the shared spec string
+    # selects ONE worker/replica); None = the bare scope, every plan
     worker: Optional[int] = None
 
     def to_spec(self) -> str:
@@ -147,8 +155,9 @@ class FaultRule:
                          f"op={a}.." + ("" if b is None else str(b)))
         if self.latency_ms != (300000 if self.kind == "hang" else 50):
             conds.append(f"ms={self.latency_ms}")
-        head = (f"worker{self.worker}:{self.kind}"
-                if self.scope == "worker" and self.worker is not None
+        head = (f"{self.scope}{self.worker}:{self.kind}"
+                if self.scope in ("worker", "replica")
+                and self.worker is not None
                 else f"{self.scope}:{self.kind}")
         return head + ("@" + ",".join(conds) if conds else "")
 
@@ -165,6 +174,15 @@ class FaultRule:
             # so they match every wire attempt regardless of target
             # server or op; a worker<N> scope additionally requires the
             # plan to BE worker N (per-worker straggler targeting)
+            if self.worker is not None and worker_id != self.worker:
+                return False
+        elif self.scope == "replica":
+            # replica scopes target ONE serve replica's scheduler loop
+            # (op 'serve', ticked once per Scheduler.step) and nothing
+            # else — a spec string shared with PSWorkers/wires can
+            # never make the data plane pay a replica's death
+            if op != "serve":
+                return False
             if self.worker is not None and worker_id != self.worker:
                 return False
         elif self.scope == "init":
@@ -242,14 +260,30 @@ def parse_fault_spec(spec: str) -> List[FaultRule]:
                         "(expected worker<N>, e.g. worker1)")
                 worker = int(idx)
                 scope = "worker"
+            elif scope.startswith("replica") and scope not in SCOPES:
+                idx = scope[len("replica"):]
+                if not idx.isdigit():
+                    raise ValueError(
+                        f"bad replica index {idx!r} in scope {scope!r} "
+                        "(expected replica<N>, e.g. replica1)")
+                worker = int(idx)
+                scope = "replica"
             elif scope not in SCOPES:
                 raise ValueError(
                     f"unknown fault scope {scope!r} (expected one of "
-                    f"{'|'.join(SCOPES)}, server<N>, or worker<N>)")
-            if kind == "hang" and scope != "worker":
+                    f"{'|'.join(SCOPES)}, server<N>, worker<N>, or "
+                    "replica<N>)")
+            if kind == "hang" and scope not in ("worker", "replica"):
                 raise ValueError(
-                    "'hang' simulates a worker wedging and only takes "
-                    "the 'worker'/'worker<N>' scopes (worker:hang@...)")
+                    "'hang' simulates a worker/replica wedging and only "
+                    "takes the 'worker'/'worker<N>'/'replica'/"
+                    "'replica<N>' scopes (worker:hang@...)")
+            if scope == "replica" and kind not in ("kill", "hang", "slow"):
+                raise ValueError(
+                    "replica scopes take only kill|hang|slow — a serve "
+                    "replica's step has no payload to corrupt or "
+                    "response to lose (wire-leg faults belong to the "
+                    "KVWire's own plan)")
             if kind == "join" and scope != "worker":
                 raise ValueError(
                     "'join' is a mid-stream worker admission and only "

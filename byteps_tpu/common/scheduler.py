@@ -238,6 +238,13 @@ class Handle:
     def failed(self) -> bool:
         return self._error is not None
 
+    def error(self) -> Optional[BaseException]:
+        """The failure that froze this handle (a
+        :class:`PartitionFailure`), or None — the public read for
+        callers that classify failures without wait()'s raise (e.g.
+        the serve router's retry-vs-terminal migration decision)."""
+        return self._error
+
     def wait(self, timeout: Optional[float] = None) -> Dict[int, Any]:
         # BYTEPS_HANDLE_DEADLINE_MS is a hard ceiling on EVERY wait —
         # including timeout=None callers — so no configuration can turn a

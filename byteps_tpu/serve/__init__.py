@@ -23,6 +23,14 @@ fixed-shape cache. This subsystem is the vLLM/Orca-shaped completion:
 * ``router`` — multi-replica routing with lease/epoch replica
   liveness mirroring the PR 5 elastic-membership layer: a dead
   replica's in-flight requests re-queue to survivors.
+* ``kv_wire`` — disaggregated prefill/decode (docs/serving.md
+  §disaggregation): dedicated prefill replicas stream committed KV
+  blocks to their decode target over a KVCOMPRESS→KVPUSH stage
+  pipeline (wire-scoped credits, token-bucket pacer, CRC + stage
+  retry — the gradient tier's wire machinery reused as a KV-migration
+  transport), and the same wire turns pool-pressure preemption into
+  migrate-don't-evict: committed blocks MOVE to a sibling instead of
+  being freed and recomputed.
 
 Greedy outputs are pinned BIT-identical (token-for-token) to
 single-request ``make_generate_fn`` runs — batching and paging are
@@ -34,6 +42,12 @@ from byteps_tpu.common.jax_compat import ensure as _ensure_jax_compat
 
 _ensure_jax_compat()
 
+from byteps_tpu.serve.kv_wire import (  # noqa: E402,F401
+    BlockPayload,
+    KVBlockCodec,
+    KVWire,
+    MigrationTicket,
+)
 from byteps_tpu.serve.paged_cache import (  # noqa: E402,F401
     PagedKVCache,
     PoolState,

@@ -186,6 +186,11 @@ class PagedKVCache:
         # bumped on every commit_prefix insert: lets the scheduler's
         # mid-prefill re-match skip the walk when nothing new committed
         self.index_version = 0
+        # blocks adopted from the migration wire over this pool's
+        # lifetime (disaggregation / migrate-don't-evict): surfaced in
+        # the PoolExhausted breakdown so a pressure post-mortem shows
+        # how much of the occupancy migrated in rather than grew here
+        self.migrated_in_blocks = 0
         _reg = get_registry()
         # per-POOL gauge series (global instance sequence, the PR 6
         # scheduler.s<N>/pacer.p<N> pattern): two replicas' pools must
@@ -300,7 +305,10 @@ class PagedKVCache:
             f"{len(self._free)} free — occupancy: "
             f"{self.pool_blocks - 1} allocatable = {len(live)} live + "
             f"{cached_idle} cached-prefix + {len(self._free)} free"
-            + (f" + {leaked} LEAKED" if leaked else ""))
+            + (f" + {leaked} LEAKED" if leaked else "")
+            + (f"; {self.migrated_in_blocks} block(s) migrated in over "
+               "this pool's lifetime"
+               if self.migrated_in_blocks else ""))
 
     def ensure(self, rid, n_tokens: int) -> None:
         """Grow ``rid``'s table to cover ``n_tokens`` positions with
@@ -567,6 +575,63 @@ class PagedKVCache:
         row = np.zeros(w, np.int32)
         row[:len(t)] = t
         return row
+
+    # -- migration payloads (serve/kv_wire.py) -------------------------------
+    def snapshot_blocks(self, rid, lo: int, hi: int):
+        """Host snapshots of ``rid``'s table blocks ``[lo, hi)`` as
+        ``{block_idx: BlockPayload}`` — ONE device gather per call (not
+        one per block). This is the migration wire's read side: the
+        bytes are copied out verbatim (rows at/past the fill level
+        carry whatever the recycled block held — the receiving gather's
+        zero-mask keeps them out of the math, exactly as it does
+        locally)."""
+        from byteps_tpu.serve.kv_wire import BlockPayload
+
+        if hi <= lo:
+            return {}
+        blocks = self._tables[rid][lo:hi]
+        idx = jnp.asarray(blocks, jnp.int32)
+        st = self.state
+        k = jax.device_get(st.k[:, idx])          # (L, n, bs, h, D)
+        v = jax.device_get(st.v[:, idx])
+        ks = vs = None
+        if st.k_scale is not None:
+            ks = jax.device_get(st.k_scale[:, idx])
+            vs = jax.device_get(st.v_scale[:, idx])
+        return {lo + i: BlockPayload(
+                    k[:, i], v[:, i],
+                    None if ks is None else ks[:, i],
+                    None if vs is None else vs[:, i])
+                for i in range(len(blocks))}
+
+    def write_payloads(self, block_ids, payloads) -> None:
+        """Scatter migrated block contents into physical ``block_ids``
+        (the adoption write side) — one device scatter per pool array
+        regardless of block count. Payload dtypes are the pool's own
+        (the wire codec round-trips bytes, never values), so this write
+        is bit-exact by construction."""
+        if not block_ids:
+            return
+        idx = jnp.asarray(list(block_ids), jnp.int32)
+        k = jnp.asarray(np.stack([np.asarray(p.k) for p in payloads],
+                                 axis=1))
+        v = jnp.asarray(np.stack([np.asarray(p.v) for p in payloads],
+                                 axis=1))
+        st = self.state
+        if st.k_scale is not None:
+            ks = jnp.asarray(np.stack(
+                [np.asarray(p.k_scale) for p in payloads], axis=1))
+            vs = jnp.asarray(np.stack(
+                [np.asarray(p.v_scale) for p in payloads], axis=1))
+            self.state = PoolState(
+                k=st.k.at[:, idx].set(k), v=st.v.at[:, idx].set(v),
+                k_scale=st.k_scale.at[:, idx].set(ks),
+                v_scale=st.v_scale.at[:, idx].set(vs))
+        else:
+            self.state = PoolState(
+                k=st.k.at[:, idx].set(k.astype(st.k.dtype)),
+                v=st.v.at[:, idx].set(v.astype(st.v.dtype)))
+        self.migrated_in_blocks += len(block_ids)
 
     def defrag(self) -> int:
         """Compact live blocks to the lowest physical ids (one device

@@ -53,10 +53,22 @@ speculation (pinned in tests/test_serve.py). Sampled requests draw
 per-request fold_in keys — deterministic per (seed, position) but
 intentionally NOT the solo sampler's batched key sequence.
 
-Replica death is deterministic chaos: a ``worker:kill`` rule in the
-request's :class:`~byteps_tpu.common.faults.FaultPlan` kills the
-replica at an exact step; the router's lease sweep then evicts it —
-the same death-by-silence semantics the PR 5 membership layer pins.
+Replica death is deterministic chaos: a ``worker:kill`` (or
+serve-scoped ``replica<N>:kill``) rule in the request's
+:class:`~byteps_tpu.common.faults.FaultPlan` kills the replica at an
+exact step; the router's lease sweep then evicts it — the same
+death-by-silence semantics the PR 5 membership layer pins.
+
+**Disaggregation** (docs/serving.md §disaggregation) — a Scheduler
+can be a dedicated ``role="prefill"`` or ``role="decode"`` replica:
+prefill replicas run chunked prefill only, stream committed KV blocks
+to their decode target over the ``serve/kv_wire.py`` transport as each
+chunk fills them, and park finished requests for the router to
+migrate; decode replicas adopt migrated requests through the
+refcount/radix path (``submit_migrated``), so prefix sharing survives
+the wire. The same transport gives migrate-don't-evict preemption
+(``extract_for_migration``): a pressured victim's blocks move to a
+sibling instead of being freed and recomputed.
 """
 
 from __future__ import annotations
@@ -64,6 +76,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import itertools
+import threading
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional
@@ -168,7 +181,7 @@ class _Run:
     __slots__ = ("req", "full_input", "emitted", "pending", "cache_len",
                  "prefill_done", "state", "t_submit", "t_origin", "t_admit",
                  "t_first", "t_last", "preemptions", "spec_rounds",
-                 "draft_cache", "tok_s", "idx_seq")
+                 "draft_cache", "tok_s", "idx_seq", "streamed")
 
     def __init__(self, req: Request, resume_tokens: List[int],
                  t_submit: float):
@@ -196,6 +209,10 @@ class _Run:
         # prefix-index version this run last matched against: the
         # mid-prefill re-match is skipped until a new commit bumps it
         self.idx_seq = -1
+        # full blocks already streamed to the decode target (prefill
+        # replicas only): the stream callback sends [streamed, full)
+        # after each chunk, so each block crosses the wire exactly once
+        self.streamed = 0
 
 
 class NoProgressError(RuntimeError):
@@ -220,11 +237,30 @@ class Scheduler:
                  prefix_cache: Optional[bool] = None,
                  fault_plan: Optional[FaultPlan] = None,
                  replica_id: int = 0,
+                 role: str = "both",
                  clock=time.monotonic):
+        """``role`` (disaggregation, docs/serving.md §disaggregation):
+        ``"both"`` — the colocated default, admission through decode on
+        one replica. ``"prefill"`` — a dedicated prefill replica: runs
+        chunked prefill only, streams committed KV blocks to its decode
+        target as they fill (router-installed ``stream_blocks``
+        callback), parks a finished request in the ``handoff`` state
+        (first token already committed — TTFT is stamped HERE) for the
+        router to migrate, and never touches the packed decode step.
+        ``"decode"`` — receives migrated requests (``submit_migrated``)
+        and decodes; it can still prefill (short prompts routed
+        directly, recompute-on-resume fallbacks), but in the pure
+        migration flow it never builds a prefill chunk program. The
+        jit factories are built LAZILY per role so a dedicated replica
+        never compiles — or holds HBM for — the other role's step."""
         c = get_config()
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(f"unknown scheduler role {role!r} "
+                             "(expected both|prefill|decode)")
         self.params = params
         self.cfg = cfg
         self.tp_axis = tp_axis
+        self.role = role
         self.replica_id = replica_id
         self.max_batch = max_batch if max_batch is not None \
             else c.serve_max_batch
@@ -251,13 +287,31 @@ class Scheduler:
         self.cache = PagedKVCache(cfg, block_size=bs, pool_blocks=nb,
                                   max_batch=self.max_batch, h_loc=kv_loc,
                                   quant=quant)
-        self._decode = make_paged_decode_fn(cfg, bs, tp_axis)
+        # the packed decode step is built LAZILY (first decode touch):
+        # a prefill-only replica must never trace/compile it — that is
+        # the dedicated replica's cold-start and HBM win, asserted in
+        # tests/test_serve_disagg.py
+        self._decode_fn = None
         self._pick = _make_pick_fn(cfg.vocab_size)
         self._draft_steps: Dict[int, Any] = {}
         self._plan = fault_plan if fault_plan is not None \
             else plan_from_env(worker_id=replica_id)
         self._dead = False
         self._clock = clock
+        # disaggregation hooks (router-installed; None = colocated):
+        # stream_blocks(sched, run, {block_idx: BlockPayload}) pushes
+        # newly committed prefill blocks onto the migration wire;
+        # migrate_out(sched, run) -> bool moves a preemption victim's
+        # blocks to a sibling instead of evicting (True = extracted)
+        self.stream_blocks = None
+        self.migrate_out = None
+        # wire-delivered block payloads staged until adoption, keyed
+        # (rid -> {block_idx: BlockPayload}); written by KVWire push
+        # threads via ingest_block, drained on this thread at adoption
+        self._staging: Dict[Any, Dict[int, Any]] = {}
+        self._staging_lock = threading.Lock()
+        self._kv_codec = None
+        self._prefill_built = False
         self._waiting: deque = deque()
         self._running: List[_Run] = []
         self._runs: Dict[Any, _Run] = {}
@@ -280,6 +334,16 @@ class Scheduler:
             "prefix_hits": _reg.counter("serve.prefix_hits"),
             "prefix_misses": _reg.counter("serve.prefix_misses"),
             "prefix_saved": _reg.counter("serve.prefix_saved_tokens"),
+            # migration plane (docs/observability.md): requests that
+            # left/arrived over the KV wire, KV tokens that moved
+            # instead of being recomputed, and the recompute bill the
+            # evict path still charges — migrate-vs-recompute reads
+            # straight off these two
+            "migrated_out": _reg.counter("serve.migration.out_requests"),
+            "migrated_in": _reg.counter("serve.migration.in_requests"),
+            "migrated_tokens": _reg.counter("serve.migration.tokens"),
+            "recompute_tokens": _reg.counter(
+                "serve.migration.recompute_tokens"),
             "iterations": _reg.counter("serve.iterations"),
             "ttft_ms": _reg.histogram("serve.ttft_ms"),
             "token_ms": _reg.histogram("serve.token_ms"),
@@ -370,12 +434,209 @@ class Scheduler:
         self._m["queue_depth"].set(0)
         return out
 
+    # -- disaggregation / migration surface (docs/serving.md) ---------------
+    def ingest_block(self, rid, block_idx: int, buf) -> None:
+        """KV-wire delivery (called on KVWire PUSH threads): decode the
+        frame (CRC verified — corruption raises back into the wire's
+        stage retry) and stage the payload until adoption. Idempotent
+        per (rid, block): a retried delivery overwrites the identical
+        payload. Device state is never touched here — adoption scatters
+        on the scheduler's own thread."""
+        payload = self.kv_codec.decode(buf)
+        with self._staging_lock:
+            self._staging.setdefault(rid, {})[int(block_idx)] = payload
+
+    def staged_blocks(self, rid) -> set:
+        with self._staging_lock:
+            return set(self._staging.get(rid, ()))
+
+    def pop_staged(self, rid) -> Dict[int, Any]:
+        with self._staging_lock:
+            return self._staging.pop(rid, {})
+
+    def drop_staged(self, rid) -> None:
+        with self._staging_lock:
+            self._staging.pop(rid, None)
+
+    def _cut_ticket(self, run: _Run, nb: int, payloads):
+        from byteps_tpu.serve.kv_wire import MigrationTicket
+
+        return MigrationTicket(
+            req=run.req, emitted=list(run.emitted), pending=run.pending,
+            cache_len=run.cache_len,
+            full_input=np.concatenate(
+                [np.asarray(run.req.prompt, np.int32),
+                 np.asarray(run.emitted, np.int32)]),
+            n_blocks=nb, payloads=payloads, t_origin=run.t_origin,
+            t_submit=run.t_submit, t_first=run.t_first,
+            tok_s=list(run.tok_s), preemptions=run.preemptions,
+            spec_rounds=run.spec_rounds)
+
+    def pop_handoffs(self):
+        """Prefill replicas: cut a :class:`MigrationTicket` for every
+        request whose prefill (and first token) completed. The ticket
+        carries the blocks NOT yet streamed (the partial tail); the run
+        parks in the ``migrating`` state — blocks pinned — until the
+        router confirms adoption via :meth:`finish_handoff` (so a
+        mid-migration failure can always re-stream from live pages)."""
+        out = []
+        for run in self._running:
+            if run.state != "handoff":
+                continue
+            nb = self.cache.blocks_for(run.cache_len)
+            out.append(self._cut_ticket(
+                run, nb,
+                self.cache.snapshot_blocks(run.req.rid, run.streamed,
+                                           nb)))
+            run.state = "migrating"
+        return out
+
+    def finish_handoff(self, rid) -> None:
+        """Adoption confirmed on the decode target: release the parked
+        run's blocks (shared prefix pages stay resident for the next
+        sharer — the refcount path, as everywhere)."""
+        run = self._runs.pop(rid)
+        self._running.remove(run)
+        self.cache.release(rid)
+
+    def extract_for_migration(self, rid):
+        """Migrate-don't-evict: pull a decoding victim OUT of this
+        replica — snapshot ALL its committed blocks, free them, and
+        return the ticket the router ships to a sibling. Unlike
+        :meth:`_preempt` nothing is recomputed: the tokens move, the
+        pool pressure drops NOW."""
+        run = self._runs.pop(rid)
+        self._running.remove(run)
+        nb = self.cache.blocks_for(run.cache_len)
+        ticket = self._cut_ticket(
+            run, nb, self.cache.snapshot_blocks(rid, 0, nb))
+        self.cache.release(rid)
+        run.state = "migrated"
+        self._m["migrated_out"].inc()
+        get_flight_recorder().record_event(
+            "serve.migrate_out",
+            {"replica": self.replica_id, "rid": str(rid),
+             "blocks": nb, "tokens": run.cache_len})
+        return ticket
+
+    def submit_migrated(self, ticket, payloads) -> bool:
+        """Adopt a migrated request: its KV blocks (delivered over the
+        wire into ``payloads``) enter THIS pool through the refcount/
+        radix path — leading blocks the local index already holds are
+        shared instead of duplicated (prefix sharing survives
+        migration), the rest scatter bit-exact, and the whole context
+        is committed to the index so later sharers (and this request's
+        own preemption resume) hit it. Returns False — allocating
+        nothing — when the pool cannot fit the request even after
+        preemption (the router then falls back to recompute-on-resume
+        via a plain ``submit``)."""
+        req = ticket.req
+        rid = req.rid
+        if rid in self._runs:
+            raise ValueError(f"duplicate request id {rid!r}")
+        missing = [bi for bi in range(ticket.n_blocks)
+                   if bi not in payloads]
+        if missing:
+            raise ValueError(
+                f"migration for {rid!r} is missing block(s) {missing}")
+        run = _Run(req, list(ticket.emitted),
+                   ticket.t_submit or self._clock())
+        ctx = run.full_input           # prompt + emitted == rows [0, len)
+        self.cache.register(rid)
+        hit_blocks: List[int] = []
+        if self._prefix_on:
+            hit_blocks, hit_tokens = self.cache.match_prefix(
+                ctx[:ticket.cache_len], full_blocks_only=True)
+            if hit_blocks:
+                self.cache.adopt_prefix(rid, hit_blocks)
+                self._m["prefix_hits"].inc()
+                self._m["prefix_saved"].inc(hit_tokens)
+        hit_n = len(hit_blocks)
+        while True:
+            try:
+                self.cache.ensure(rid, ticket.cache_len + 1)
+                break
+            except PoolExhausted:
+                victim = None
+                for cand in reversed(self._running):
+                    if cand.state in ("prefill", "decode"):
+                        victim = cand
+                        break
+                if victim is None:
+                    # cannot fit even with the pool drained: roll back
+                    # losslessly; the router recomputes instead
+                    self.cache.release(rid)
+                    return False
+                if (self.migrate_out is not None
+                        and victim.state == "decode"
+                        and victim.req.spec is None
+                        and self.migrate_out(self, victim)):
+                    continue
+                self._preempt(victim)
+        row = self.cache.table_row(rid)
+        self.cache.write_payloads(
+            [int(b) for b in row[hit_n:ticket.n_blocks]],
+            [payloads[bi] for bi in range(hit_n, ticket.n_blocks)])
+        if self._prefix_on:
+            self.cache.commit_prefix(rid, ctx, ticket.cache_len)
+        run.cache_len = ticket.cache_len
+        run.prefill_done = ticket.cache_len
+        run.pending = ticket.pending
+        run.t_origin = ticket.t_origin
+        run.t_first = ticket.t_first
+        run.t_last = ticket.tok_s[-1] if ticket.tok_s else ticket.t_origin
+        run.tok_s = list(ticket.tok_s)
+        run.preemptions = ticket.preemptions
+        run.spec_rounds = ticket.spec_rounds
+        run.state = "decode"
+        if req.spec is not None and req.spec.kind == "draft":
+            # rebuild the per-request draft cache over everything but
+            # the pending token (the draft proposes FROM pending) —
+            # drafts only move speed, never content, so the rebuild
+            # cannot touch exactness
+            self._build_draft_cache(run, tokens=ctx[:-1])
+        self._runs[rid] = run
+        self._running.append(run)
+        self._m["migrated_in"].inc()
+        self._m["migrated_tokens"].inc(ticket.cache_len)
+        get_flight_recorder().record_event(
+            "serve.migrate_in",
+            {"replica": self.replica_id, "rid": str(rid),
+             "blocks": ticket.n_blocks, "shared": hit_n,
+             "tokens": ticket.cache_len})
+        return True
+
     # -- jit caches ---------------------------------------------------------
     def _prefill_fn(self, C: int, with_readout: bool = True):
         # the factory is lru-cached process-wide — every replica shares
         # one jit wrapper per (cfg, block_size, C, readout)
+        self._prefill_built = True
         return make_paged_prefill_fn(self.cfg, self.cache.block_size, C,
                                      self.tp_axis, with_readout)
+
+    def _decode_step(self):
+        """The packed decode step, built on first decode touch. A
+        prefill-only replica must never get here — reaching it would
+        mean the role split leaked decode work onto the prefill tier
+        (and would silently re-grow its cold-start/HBM bill)."""
+        if self._decode_fn is None:
+            if self.role == "prefill":
+                raise RuntimeError(
+                    "prefill-only replica asked for the packed decode "
+                    "step — the router's role split is broken")
+            self._decode_fn = make_paged_decode_fn(
+                self.cfg, self.cache.block_size, self.tp_axis)
+        return self._decode_fn
+
+    @property
+    def kv_codec(self):
+        """This replica's KV-block wire codec (lazy; both ends of a
+        migration must agree — KVBlockCodec.decode validates)."""
+        if self._kv_codec is None:
+            from byteps_tpu.serve.kv_wire import KVBlockCodec
+
+            self._kv_codec = KVBlockCodec.from_pool(self.cache)
+        return self._kv_codec
 
     def _width(self, rid) -> int:
         """Power-of-two bucket of the request's live table: the jitted
@@ -441,12 +702,19 @@ class Scheduler:
         """Evict ``run`` under pool pressure: free its blocks, keep its
         committed tokens, re-queue at the FRONT for recompute-on-resume
         (its next prefill input is prompt + emitted)."""
+        # the recompute bill: every committed KV row thrown away here
+        # must be re-prefilled on resume (the request's own prefix
+        # commits may refund part of it if they survive the pressure
+        # that caused this evict) — the migrate-vs-recompute headline's
+        # "recompute" side (bench.py --mode serve, migrate leg)
+        self._m["recompute_tokens"].inc(run.cache_len)
         self.cache.release(run.req.rid)
         run.state = "queued"
         run.preemptions += 1
         run.pending = None
         run.cache_len = 0
         run.prefill_done = 0
+        run.streamed = 0
         run.draft_cache = None
         run.full_input = np.concatenate(
             [np.asarray(run.req.prompt, np.int32),
@@ -489,6 +757,24 @@ class Scheduler:
                         "KV pool exhausted with no preemptible request — "
                         "pool sizing bug (submit() validates single-"
                         "request fit)")
+                # migrate-don't-evict: a decoding victim's committed
+                # blocks can MOVE to a sibling replica over the KV wire
+                # instead of being freed and recomputed — the router's
+                # hook extracts it (blocks freed here, adopted there).
+                # The victim may be the REQUESTER itself (symmetric
+                # pressure grows every table in lockstep, so the
+                # youngest decoder is usually the one asking): that is
+                # cross-replica load shedding, and the caller's False
+                # return already means "this run is no longer mine".
+                # Mid-prefill and spec victims take the classic evict
+                # path (their partial/draft state doesn't travel).
+                if (self.migrate_out is not None
+                        and victim.state == "decode"
+                        and victim.req.spec is None
+                        and self.migrate_out(self, victim)):
+                    if victim is run:
+                        return False
+                    continue
                 self._preempt(victim)
                 if victim is run:
                     return False
@@ -610,6 +896,9 @@ class Scheduler:
                and self._waiting[0].req.arrival_s <= now):
             run = self._waiting[0]
             L = len(run.full_input)
+            # a prefill-only replica writes exactly L rows (the decode
+            # slot L+1 belongs to the decode target's pool)
+            reserve = L if self.role == "prefill" else L + 1
             hit_blocks: List[int] = []
             hit_tokens = 0
             if self._prefix_on:
@@ -620,7 +909,7 @@ class Scheduler:
                     run.full_input[:L - 1])
                 run.idx_seq = self.cache.index_version
             partial = 1 if hit_tokens % self.cache.block_size else 0
-            need = (self.cache.blocks_for(L + 1) - len(hit_blocks)
+            need = (self.cache.blocks_for(reserve) - len(hit_blocks)
                     + partial)
             if partial and need > (self.cache.free_blocks
                                    + self.cache.reclaimable_blocks(
@@ -634,7 +923,7 @@ class Scheduler:
                 hit_blocks = hit_blocks[:-1]
                 hit_tokens -= hit_tokens % self.cache.block_size
                 partial = 0
-                need = self.cache.blocks_for(L + 1) - len(hit_blocks)
+                need = self.cache.blocks_for(reserve) - len(hit_blocks)
             if need > (self.cache.free_blocks
                        + self.cache.reclaimable_blocks(
                            exclude=hit_blocks)):
@@ -644,7 +933,7 @@ class Scheduler:
             try:
                 if hit_blocks:
                     self.cache.adopt_prefix(run.req.rid, hit_blocks)
-                self.cache.ensure(run.req.rid, L + 1)
+                self.cache.ensure(run.req.rid, reserve)
                 if partial:
                     # the match ends mid-block: CoW the divergence
                     # block so the request owns a private copy carrying
@@ -732,6 +1021,19 @@ class Scheduler:
                 # after this request finishes — cached-but-idle, LRU)
                 self.cache.commit_prefix(run.req.rid, run.full_input,
                                          run.prefill_done)
+            if self.role == "prefill" and self.stream_blocks is not None:
+                # disaggregation: newly FULL blocks stream to the decode
+                # target NOW, so their wire time (codec + pacer on the
+                # KVWire's stage threads) overlaps the next chunk's
+                # compute on this thread — the partial tail travels
+                # with the handoff ticket
+                full = run.prefill_done // self.cache.block_size
+                if full > run.streamed:
+                    self.stream_blocks(
+                        self, run,
+                        self.cache.snapshot_blocks(run.req.rid,
+                                                   run.streamed, full))
+                    run.streamed = full
             progress = True
             if run.prefill_done == len(run.full_input):
                 # device-side last-position slice: only vocab floats
@@ -743,10 +1045,17 @@ class Scheduler:
                     jnp.asarray([run.req.temperature], jnp.float32))
                 run.state = "decode"
                 if (run.req.spec is not None
-                        and run.req.spec.kind == "draft"):
+                        and run.req.spec.kind == "draft"
+                        and self.role != "prefill"):
                     self._build_draft_cache(run)
                 self._commit_token(run, int(np.asarray(picked)[0]),
                                    self._clock())
+                if run.state == "decode" and self.role == "prefill":
+                    # prefill is this replica's whole job: the request
+                    # parks (blocks pinned) until the router migrates
+                    # it — its first token is already committed, so
+                    # TTFT was stamped here, untouched by wire time
+                    run.state = "handoff"
             break                                 # one chunk per iteration
 
         # 3. speculative lane: one round per spec request — they never
@@ -784,7 +1093,7 @@ class Scheduler:
                 tables[i] = self.cache.table_row(run.req.rid, W)
                 seeds[i] = run.req.seed
                 temps[i] = run.req.temperature
-            logits, self.cache.state = self._decode(
+            logits, self.cache.state = self._decode_step()(
                 self.params, self.cache.state, jnp.asarray(toks),
                 jnp.asarray(pos), jnp.asarray(tables))
             picked = np.asarray(self._pick(
@@ -799,15 +1108,19 @@ class Scheduler:
             progress = True
         return progress
 
-    def _build_draft_cache(self, run: _Run) -> None:
+    def _build_draft_cache(self, run: _Run,
+                           tokens: Optional[np.ndarray] = None) -> None:
         """Prefill the per-request dense draft cache over the full
-        committed context (prompt + resumed tokens)."""
+        committed context (prompt + resumed tokens; a migrated-in run
+        passes its context minus the pending token explicitly)."""
         pol = run.req.spec
         kv_d = (pol.draft_params["blocks"][0]["wk"].shape[-1]
                 // pol.draft_cfg.head_dim)
         dc = init_cache(pol.draft_cfg, 1, h_loc=kv_d)
         _, dc = self._draft_step(pol.draft_cfg)(
-            pol.draft_params, jnp.asarray(run.full_input)[None], dc)
+            pol.draft_params,
+            jnp.asarray(run.full_input if tokens is None
+                        else tokens)[None], dc)
         run.draft_cache = dc
 
     def serve(self, requests: List[Request], max_idle_iters: int = 10000):

@@ -358,3 +358,14 @@ def test_bench_serve_quick_sweep():
     assert res["prefix_ttft_p99_speedup"] > 0
     for leg in ("prefix_shared_on", "prefix_shared_off"):
         assert res["results"][leg]["ttft_ms_p50"] > 0, leg
+    # disaggregation legs: race structure present, speedup computed,
+    # migrate-don't-evict eliminated the recompute bill (the real
+    # >= 1.5x / ~1.0 bars are the checked-in artifact's trend floors;
+    # both legs assert bit-exactness in-run)
+    assert res["disagg_ttft_p99_speedup"] > 0
+    assert res["migrate_recompute_saved"] == 1.0
+    race = res["results"]["disagg_race"]
+    for side in ("disagg", "colocated"):
+        assert race[side]["ttft_ms_p99_short"] > 0, side
+    assert res["results"]["migrate_preempt"]["off"]["recompute_tokens"] > 0
+    assert res["results"]["migrate_preempt"]["on"]["migrated_requests"] >= 1
