@@ -991,6 +991,18 @@ def bench_serve(reps: int = 3, n_requests: int = 24,
       evict recomputes them. Headline ``migrate_recompute_saved`` =
       1 − recompute_on/recompute_off (trend-gated, ~1.0 = migration
       eliminates the recompute bill).
+    * **multi-tenant LoRA race** — 32 adapters (4 in ``--quick``) of
+      one base model, mixed ranks, ONE multiplexed replica (paged
+      adapter pool + batched heterogeneous-adapter decode,
+      docs/serving.md §multi-tenant) vs one sequential dedicated pass
+      per adapter. Headline ``multitenant_goodput_speedup`` =
+      aggregate tokens/s ratio (trend-gated, >= 2x acceptance bar);
+      every tenant's multiplexed tokens are asserted bit-identical to
+      its dedicated pass in-run. A noisy-tenant flood leg then pins
+      isolation: tenant 0 floods while siblings submit their baseline
+      load under per-tenant KV quotas + fair queuing; headline
+      ``multitenant_fairness`` = sibling p99 TTFT no-flood/flood ratio
+      (trend-gated, ~1.0 = the flooder hurt only itself).
 
     Outputs are bit-identical to the sequential leg's tokens by the
     serve tier's exactness contract (pinned in tests/test_serve.py);
@@ -1302,6 +1314,139 @@ def bench_serve(reps: int = 3, n_requests: int = 24,
                        / mig_off["recompute_tokens"])
     results["migrate_preempt"] = {"on": mig_on, "off": mig_off}
 
+    # --- multi-tenant LoRA multiplexing race (docs/serving.md
+    # §multi-tenant): N adapters of one base model, mixed traffic, ONE
+    # multiplexed replica (paged adapter pool + batched heterogeneous-
+    # adapter decode) vs N sequential dedicated passes — what N
+    # per-tenant replicas on this chip degrade to: each pass has the
+    # chip to itself but only its own tenant's traffic to batch.
+    from byteps_tpu.models.lora import lora_init
+    from byteps_tpu.serve import AdapterPool
+
+    if quick:
+        n_ad, mt_new, mt_rb, fl_n = 4, 5, 4, 6
+    else:
+        n_ad, mt_new, mt_rb, fl_n = 32, 16, 8, 10
+    apool = AdapterPool(cfg, n_slots=n_ad + 1, rank_bucket=mt_rb,
+                        targets=("wq", "wv"))
+    for j in range(n_ad):
+        # mixed ranks: the rank bucket is what lets them share one
+        # compiled packed step
+        r = (2, max(1, mt_rb // 2), mt_rb)[j % 3]
+        kj = jax.random.PRNGKey(1000 + j)
+        ad = lora_init(kj, cfg, r, ("wq", "wv"))
+        for bi, blk in enumerate(ad["blocks"]):
+            for t in blk:
+                # nonzero b so every adapter genuinely changes outputs
+                blk[t]["b"] = 0.02 * jax.random.normal(
+                    jax.random.fold_in(kj, bi), blk[t]["b"].shape)
+        apool.register(f"a{j}", ad)
+    mt_trace = [(f"a{j}",
+                 rng.integers(0, cfg.vocab_size,
+                              prompt_lens[j % len(prompt_lens)]
+                              ).astype(np.int32))
+                for j in range(n_ad)]
+    mt_total = n_ad * mt_new
+
+    def run_multiplexed():
+        sched = Scheduler(params, cfg, max_batch=max_batch,
+                          prefill_chunk=prefill_chunk,
+                          adapter_pool=apool)
+        t0 = time.monotonic()
+        res = sched.serve([
+            Request(rid=j, prompt=p, max_new=mt_new, tenant=f"t{j}",
+                    adapter=aid)
+            for j, (aid, p) in enumerate(mt_trace)])
+        makespan = time.monotonic() - t0
+        assert sched.cache.leaked_blocks() == 0, "KV block leak"
+        apool.check_refcounts()
+        assert apool.leaked_slots() == 0, "adapter slot leak"
+        return makespan, res
+
+    def run_dedicated():
+        t0 = time.monotonic()
+        res = {}
+        for j, (aid, p) in enumerate(mt_trace):
+            sched = Scheduler(apool.graft(params, aid), cfg,
+                              max_batch=max_batch,
+                              prefill_chunk=prefill_chunk)
+            res.update(sched.serve(
+                [Request(rid=j, prompt=p, max_new=mt_new)]))
+            assert sched.cache.leaked_blocks() == 0, "KV block leak"
+        return time.monotonic() - t0, res
+
+    run_multiplexed()                 # warm the segmented-decode shapes
+    mt_reps = max(1, reps - 1)
+    mux_runs = [run_multiplexed() for _ in range(mt_reps)]
+    ded_runs = [run_dedicated() for _ in range(mt_reps)]
+    # exactness rides along: every tenant's multiplexed greedy tokens
+    # must be bit-identical to its dedicated pass on the grafted params
+    for (_, rm), (_, rd) in zip(mux_runs, ded_runs):
+        for j in range(n_ad):
+            if not np.array_equal(rm[j]["tokens"], rd[j]["tokens"]):
+                raise AssertionError(
+                    f"multiplexed/dedicated outputs diverged for "
+                    f"tenant {j}")
+    mux = leg_stats(mux_runs, n_new=mt_total)
+    ded_mks = sorted(m for m, _ in ded_runs)
+    ded = {
+        "sec_med": round(float(np.median(ded_mks)), 4),
+        "sec_spread": [round(ded_mks[0], 4), round(ded_mks[-1], 4)],
+        "tokens_per_s": round(mt_total / float(np.median(ded_mks)), 1),
+    }
+    mt_speedup = mux["tokens_per_s"] / ded["tokens_per_s"]
+
+    # --- noisy-tenant flood: tenant 0 floods fl_n requests while its
+    # siblings submit 2 each; per-tenant KV quotas + deficit-weighted
+    # fair queuing must keep the SIBLINGS' p99 TTFT at its no-flood
+    # baseline (the flooder queues behind its own quota wall) ---------------
+    fl_sib = min(3, n_ad - 1)
+    fl_prompt = prompt_lens[0]
+    q_blocks = 2 * (-(-(fl_prompt + mt_new + 1) // 16))
+    sib_prompts = {(j, k): rng.integers(0, cfg.vocab_size,
+                                        fl_prompt).astype(np.int32)
+                   for j in range(1 + fl_sib) for k in range(fl_n)}
+
+    def run_flood(n0):
+        sched = Scheduler(params, cfg, max_batch=max_batch,
+                          prefill_chunk=prefill_chunk,
+                          adapter_pool=apool,
+                          tenant_quota_blocks=q_blocks)
+        reqs = []
+        for j in range(1 + fl_sib):
+            for k in range(n0 if j == 0 else 2):
+                reqs.append(Request(rid=f"f{j}.{k}",
+                                    prompt=sib_prompts[(j, k)],
+                                    max_new=mt_new, tenant=f"t{j}",
+                                    adapter=f"a{j}"))
+        res = sched.serve(reqs)
+        assert sched.cache.leaked_blocks() == 0, "KV block leak"
+        apool.check_refcounts()
+        tt = {j: [res[f"f{j}.{k}"]["ttft_s"] * 1e3
+                  for k in range(n0 if j == 0 else 2)]
+              for j in range(1 + fl_sib)}
+        sib = [t for j in range(1, 1 + fl_sib) for t in tt[j]]
+        return {
+            "flooder_ttft_ms_p99": round(
+                float(np.percentile(tt[0], 99)), 2),
+            "sibling_ttft_ms_p99": round(
+                float(np.percentile(sib, 99)), 2),
+        }
+
+    run_flood(2)                                 # warm the quota shapes
+    fl_base = run_flood(2)
+    fl_flood = run_flood(fl_n)
+    mt_fair = (fl_base["sibling_ttft_ms_p99"]
+               / fl_flood["sibling_ttft_ms_p99"])
+    results["multitenant"] = {
+        "trace": {"n_adapters": n_ad, "rank_bucket": mt_rb,
+                  "max_new": mt_new, "targets": ["wq", "wv"]},
+        "multiplexed": mux, "dedicated": ded,
+        "flood": {"baseline": fl_base, "flooded": fl_flood,
+                  "flood_requests": fl_n, "siblings": fl_sib,
+                  "quota_blocks": q_blocks},
+    }
+
     _log(f"serve: {n_requests} requests ({total_new} new tokens) — "
          f"sequential {sequential['tokens_per_s']} tok/s, saturation "
          f"{sat['tokens_per_s']} tok/s ({speedup:.2f}x), TTFT p50/p99 "
@@ -1318,6 +1463,12 @@ def bench_serve(reps: int = 3, n_requests: int = 24,
          f"({disagg_p99:.2f}x); migrate-don't-evict: recompute "
          f"{mig_off['recompute_tokens']} -> {mig_on['recompute_tokens']} "
          f"tokens (saved {mig_saved:.2f})")
+    _log(f"serve multitenant: {n_ad} adapters (rank bucket {mt_rb}) — "
+         f"multiplexed {mux['tokens_per_s']} tok/s vs dedicated "
+         f"{ded['tokens_per_s']} tok/s ({mt_speedup:.2f}x); flood "
+         f"sibling TTFT p99 {fl_base['sibling_ttft_ms_p99']} -> "
+         f"{fl_flood['sibling_ttft_ms_p99']} ms "
+         f"(fairness {mt_fair:.2f})")
     return {
         "metric": (f"continuous-batching serve, {n_requests} mixed-length "
                    f"requests (GPT d{cfg.d_model}/L{cfg.n_layers}, prompts "
@@ -1333,6 +1484,8 @@ def bench_serve(reps: int = 3, n_requests: int = 24,
                          "tail_tokens": tail_len, "max_new": pref_new},
         "disagg_ttft_p99_speedup": round(disagg_p99, 3),
         "migrate_recompute_saved": round(mig_saved, 3),
+        "multitenant_goodput_speedup": round(mt_speedup, 3),
+        "multitenant_fairness": round(mt_fair, 3),
         "tokens_per_s_per_chip": sat["tokens_per_s"],
         "sequential": sequential,
         "results": results,
@@ -3081,6 +3234,13 @@ _TREND_SPECS = (
     # path's recompute bill fully avoided) — docs/serving.md
     ("BENCH_serve.json", "disagg_ttft_p99_speedup"),
     ("BENCH_serve.json", "migrate_recompute_saved"),
+    # multi-tenant LoRA multiplexing: aggregate tokens/s of one
+    # multiplexed replica vs sequential dedicated passes (>= 2x
+    # acceptance bar), and noisy-tenant isolation = sibling p99 TTFT
+    # no-flood/flood ratio (~1.0 = quota + fair queue contain the
+    # flooder) — docs/serving.md §multi-tenant
+    ("BENCH_serve.json", "multitenant_goodput_speedup"),
+    ("BENCH_serve.json", "multitenant_fairness"),
     ("BENCH_ici.json", "ring_vs_staged_best"),
     ("BENCH_ici.json", "ring_bus_bw_best"),
     # what-if simulator prediction accuracy (1 − median rel err over the

@@ -283,6 +283,30 @@ class Config:
     # KVCOMPRESS->KVPUSH credits per migration wire: how many encoded
     # blocks may sit between the codec and a throttled wire.
     serve_disagg_credit: int = 4
+    # --- multi-tenant LoRA multiplexing (docs/serving.md §multi-tenant) ----
+    # Device-resident adapter-pool slots (slot 0 is the reserved
+    # all-zero base-model slot, so N slots serve N-1 concurrently-live
+    # adapters; idle ones LRU-cache in place). 0 = no pool: the
+    # scheduler serves the bare base model and rejects adapter-tagged
+    # requests.
+    serve_adapter_slots: int = 0
+    # Rank bucket every pooled adapter is zero-padded to — mixed-rank
+    # tenants share ONE compiled packed decode step (the padding adds
+    # exactly 0.0 to the delta; docs/serving.md has the exactness
+    # argument). Adapters with rank above the bucket are rejected at
+    # registration.
+    serve_adapter_rank_bucket: int = 8
+    # Per-tenant KV-pool quota in blocks. 0 = off. A tenant's running
+    # requests may hold at most this many blocks: growth past it
+    # preempts the OFFENDER's own youngest run (never a sibling's),
+    # and a single request that could never fit its tenant's quota is
+    # rejected at submit — the noisy tenant hits its own wall.
+    serve_tenant_quota_blocks: int = 0
+    # Deficit-weighted fair queuing at admission: pick the
+    # max-credit tenant's oldest eligible request instead of the
+    # global head of queue. Single-tenant traffic reduces exactly to
+    # the historical FIFO. Off = plain FIFO regardless of tenants.
+    serve_fair_queue: bool = True
 
     # --- tracing (SURVEY §5.1) ---------------------------------------------
     trace_on: bool = False
@@ -382,6 +406,12 @@ class Config:
             serve_disagg_migrate=_env_bool("BYTEPS_SERVE_DISAGG_MIGRATE",
                                            True),
             serve_disagg_credit=_env_int("BYTEPS_SERVE_DISAGG_CREDIT", 4),
+            serve_adapter_slots=_env_int("BYTEPS_SERVE_ADAPTER_SLOTS", 0),
+            serve_adapter_rank_bucket=_env_int(
+                "BYTEPS_SERVE_ADAPTER_RANK_BUCKET", 8),
+            serve_tenant_quota_blocks=_env_int(
+                "BYTEPS_SERVE_TENANT_QUOTA_BLOCKS", 0),
+            serve_fair_queue=_env_bool("BYTEPS_SERVE_FAIR_QUEUE", True),
             trace_on=_env_bool("BYTEPS_TRACE_ON"),
             trace_dir=_env_str("BYTEPS_TRACE_DIR", "./traces"),
             trace_start_step=_env_int("BYTEPS_TRACE_START_STEP", 1),

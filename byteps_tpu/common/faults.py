@@ -13,7 +13,7 @@ Spec grammar (semicolon-separated rules)::
     BYTEPS_FAULT_SPEC = rule (';' rule)*
     rule   = scope ':' kind ['@' cond (',' cond)*]
     scope  = 'push' | 'pull' | 'init' | 'all' | 'server<N>' | 'worker'
-           | 'worker<N>' | 'replica' | 'replica<N>'
+           | 'worker<N>' | 'replica' | 'replica<N>' | 'tenant<T>'
              # push/pull/all match DATA-PLANE ops only ('all' = push+pull);
              # 'init' matches key-init attempts only (kill = the init
              # never reached the server; timeout = applied, ack lost);
@@ -37,7 +37,14 @@ Spec grammar (semicolon-separated rules)::
              # replica (replica<N> requires the plan's worker_id == N)
              # — the disaggregation tests' deterministic
              # decode-target-death and mid-migration-death legs
-             # (docs/serving.md §disaggregation)
+             # (docs/serving.md §disaggregation); 'tenant<T>' is the
+             # multi-tenant twin: it matches only tenant-ATTRIBUTED
+             # serve intercepts (the scheduler's admission attempts
+             # for tenant T, made only when tenant rules exist), kinds
+             # slow|hang only — 'tenant3:slow@ms=40' makes exactly
+             # tenant 3's admissions pay 40 ms while its siblings run
+             # clean, the deterministic noisy-tenant flood leg
+             # (docs/serving.md §multi-tenant)
     kind   = 'timeout' | 'kill' | 'slow' | 'corrupt' | 'down' | 'hang'
            | 'join'
              # 'join' (worker/worker<N> scopes only, deterministic —
@@ -106,7 +113,7 @@ __all__ = [
 ]
 
 KINDS = ("timeout", "kill", "slow", "corrupt", "down", "hang", "join")
-SCOPES = ("push", "pull", "all", "init", "worker", "replica")
+SCOPES = ("push", "pull", "all", "init", "worker", "replica", "tenant")
 
 
 class InjectedTimeout(TimeoutError):
@@ -142,6 +149,13 @@ class FaultRule:
     # fires on the plan whose worker_id is N (the shared spec string
     # selects ONE worker/replica); None = the bare scope, every plan
     worker: Optional[int] = None
+    # parsed from 'tenant<T>' scopes (serve tier, docs/serving.md
+    # §multi-tenant): the rule fires only on tenant-attributed serve
+    # intercepts whose tenant id stringifies to T — never on the
+    # replica-level per-iteration intercept (tenant=None), so a spec
+    # carrying both replica and tenant rules keeps each family's step
+    # windows independent
+    tenant: Optional[str] = None
 
     def to_spec(self) -> str:
         """Render back to the BYTEPS_FAULT_SPEC grammar (round-trip:
@@ -155,14 +169,18 @@ class FaultRule:
                          f"op={a}.." + ("" if b is None else str(b)))
         if self.latency_ms != (300000 if self.kind == "hang" else 50):
             conds.append(f"ms={self.latency_ms}")
-        head = (f"{self.scope}{self.worker}:{self.kind}"
-                if self.scope in ("worker", "replica")
-                and self.worker is not None
-                else f"{self.scope}:{self.kind}")
+        if self.scope == "tenant":
+            head = f"tenant{self.tenant}:{self.kind}"
+        elif (self.scope in ("worker", "replica")
+                and self.worker is not None):
+            head = f"{self.scope}{self.worker}:{self.kind}"
+        else:
+            head = f"{self.scope}:{self.kind}"
         return head + ("@" + ",".join(conds) if conds else "")
 
     def matches(self, op: str, sidx: int, step: int, rng,
-                worker_id: Optional[int] = None) -> bool:
+                worker_id: Optional[int] = None,
+                tenant: Optional[str] = None) -> bool:
         if self.server is not None:
             # server scopes hit EVERY op against that server — data plane,
             # init, and the health monitor's pings (that is what lets a
@@ -180,10 +198,24 @@ class FaultRule:
             # replica scopes target ONE serve replica's scheduler loop
             # (op 'serve', ticked once per Scheduler.step) and nothing
             # else — a spec string shared with PSWorkers/wires can
-            # never make the data plane pay a replica's death
-            if op != "serve":
+            # never make the data plane pay a replica's death; they
+            # also never fire on tenant-ATTRIBUTED intercepts, so
+            # mixing replica and tenant rules in one spec keeps the
+            # replica rules' step-window pins stable
+            if op != "serve" or tenant is not None:
                 return False
             if self.worker is not None and worker_id != self.worker:
+                return False
+        elif self.scope == "tenant":
+            # tenant scopes fire ONLY on tenant-attributed serve
+            # intercepts (the scheduler's admission attempts for that
+            # tenant, and only when the plan carries tenant rules at
+            # all — so tenant-free specs never see extra step ticks)
+            if op != "serve" or tenant is None:
+                return False
+            # the grammar lowercases the whole rule head, so tenant
+            # ids match case-insensitively
+            if tenant.lower() != self.tenant:
                 return False
         elif self.scope == "init":
             if op != "init":
@@ -243,7 +275,16 @@ def parse_fault_spec(spec: str) -> List[FaultRule]:
                     f"{'|'.join(KINDS)})")
             server = None
             worker = None
-            if scope.startswith("server") and scope not in SCOPES:
+            tenant = None
+            if scope.startswith("tenant"):
+                ident = scope[len("tenant"):]
+                if not ident:
+                    raise ValueError(
+                        "tenant scopes need the tenant id inline "
+                        "(expected tenant<T>, e.g. tenant3:slow)")
+                tenant = ident
+                scope = "tenant"
+            elif scope.startswith("server") and scope not in SCOPES:
                 idx = scope[len("server"):]
                 if not idx.isdigit():
                     # 'serverX:down' / 'server:down' must name the
@@ -273,11 +314,19 @@ def parse_fault_spec(spec: str) -> List[FaultRule]:
                     f"unknown fault scope {scope!r} (expected one of "
                     f"{'|'.join(SCOPES)}, server<N>, worker<N>, or "
                     "replica<N>)")
-            if kind == "hang" and scope not in ("worker", "replica"):
+            if kind == "hang" and scope not in ("worker", "replica",
+                                                "tenant"):
                 raise ValueError(
                     "'hang' simulates a worker/replica wedging and only "
                     "takes the 'worker'/'worker<N>'/'replica'/"
-                    "'replica<N>' scopes (worker:hang@...)")
+                    "'replica<N>'/'tenant<T>' scopes (worker:hang@...)")
+            if scope == "tenant" and kind not in ("slow", "hang"):
+                raise ValueError(
+                    "tenant scopes take only slow|hang — a tenant is "
+                    "traffic, not a process: it can be throttled "
+                    "(slow = injected latency on its admission, hang = "
+                    "its admission defers while the window is active) "
+                    "but has no socket to kill or payload to corrupt")
             if scope == "replica" and kind not in ("kill", "hang", "slow"):
                 raise ValueError(
                     "replica scopes take only kill|hang|slow — a serve "
@@ -325,7 +374,8 @@ def parse_fault_spec(spec: str) -> List[FaultRule]:
                 window = (0, None)
             rules.append(FaultRule(scope=scope, kind=kind, p=p,
                                    window=window, latency_ms=latency_ms,
-                                   server=server, worker=worker))
+                                   server=server, worker=worker,
+                                   tenant=tenant))
         except ValueError as e:
             raise ValueError(
                 f"bad BYTEPS_FAULT_SPEC rule {part!r}: {e}") from None
@@ -386,7 +436,15 @@ class FaultPlan:
     def step(self) -> int:
         return self._step
 
-    def intercept(self, op: str, sidx: int) -> Optional[Injection]:
+    def has_tenant_rules(self) -> bool:
+        """True when the spec carries any ``tenant<T>:`` rule — the
+        serve scheduler only makes tenant-attributed intercept calls
+        (which tick the step counter) when this is set, so tenant-free
+        specs keep their historical step-window alignment."""
+        return any(r.scope == "tenant" for r in self.rules)
+
+    def intercept(self, op: str, sidx: int,
+                  tenant: Optional[str] = None) -> Optional[Injection]:
         """Decide the fate of one wire attempt; sleeps for 'slow' rules."""
         sleep_ms = 0
         hit: Optional[Injection] = None
@@ -394,7 +452,8 @@ class FaultPlan:
             self._step += 1
             for r in self.rules:
                 if not r.matches(op, sidx, self._step, self._rng,
-                                 worker_id=self.worker_id):
+                                 worker_id=self.worker_id,
+                                 tenant=tenant):
                     continue
                 if r.kind == "slow":
                     self.injected["slow"] += 1

@@ -133,6 +133,21 @@ def graft_lora(base_params: Dict[str, Any], adapters: Dict[str, Any],
     return out
 
 
+@jax.custom_jvp
+def _fence(xs):
+    """``optimization_barrier`` with a differentiation rule (this jax
+    has none built in): identity forward, tangents pass straight
+    through. The barrier only pins compiler scheduling/fusion — there
+    is nothing to differentiate."""
+    return jax.lax.optimization_barrier(xs)
+
+
+@_fence.defjvp
+def _fence_jvp(primals, tangents):
+    (xs,), (ts,) = primals, tangents
+    return jax.lax.optimization_barrier(xs), ts
+
+
 def lora_delta(x: jnp.ndarray, p: Dict[str, Any], name: str,
                tp_axis: Optional[str] = None) -> jnp.ndarray:
     """``scale * (x @ a) @ b`` for one target, or 0.0 when the block
@@ -146,10 +161,74 @@ def lora_delta(x: jnp.ndarray, p: Dict[str, Any], name: str,
         return jnp.zeros((), x.dtype)
     a = lr[name]["a"].astype(x.dtype)
     b = lr[name]["b"].astype(x.dtype)
+    # barrier-fence the thin dot pair: the rank-r dots are small enough
+    # that XLA folds them into whatever fusion surrounds them, and the
+    # chosen loop shape (hence accumulation order) varies with the
+    # CONSUMER — the same delta can differ by 1 ulp between two
+    # programs. The fences pin an isolated, context-independent island,
+    # which is what lets the serve tier's segmented twin
+    # (ops/segmented_lora.py) reproduce this delta BIT-exactly from its
+    # packed step. Numerically the barrier is identity; AD passes
+    # through.
+    x, a, b = _fence((x, a, b))
     h = x @ a
     if name in _ROW_TARGETS and tp_axis is not None:
         h = jax.lax.psum(h, tp_axis)
-    return h @ b
+    return _fence(h @ b)
+
+
+def lora_rank(adapters: Dict[str, Any]) -> int:
+    """The adapter tree's rank (every target shares one by
+    construction of :func:`lora_init`)."""
+    blk = adapters["blocks"][0]
+    first = next(iter(blk.values()))
+    return int(first["a"].shape[-1])
+
+
+def lora_pool_slabs(adapters: Dict[str, Any], cfg: GPTConfig,
+                    rank_bucket: int, scale: float,
+                    targets: Sequence[str]) -> Dict[str, Any]:
+    """Pool-loadable A/B slabs for ONE adapter — the serve tier's
+    :class:`~byteps_tpu.serve.adapter_pool.AdapterPool` stacks these
+    into its device-resident slot arrays.
+
+    Per target: ``a (n_layers, d_in, rank_bucket)`` and ``b
+    (n_layers, rank_bucket, d_out)`` float32, rank-padded with zeros
+    (a zero A column times a zero B row contributes exactly 0.0 to the
+    delta, so mixed-rank tenants share one compiled packed step without
+    touching the math) and with ``scale`` pre-multiplied into ``b`` —
+    the same ``b * scale`` arithmetic :func:`graft_lora` performs, so
+    the pooled delta is bit-identical to the solo grafted one. The
+    adapter must carry every requested target (a pooled row can't
+    distinguish "no adapter" from "no target"; register base-model
+    tenants with no adapter instead)."""
+    targets = _check_targets(cfg, targets)
+    r = lora_rank(adapters)
+    if r > rank_bucket:
+        raise ValueError(
+            f"adapter rank {r} exceeds the pool's rank bucket "
+            f"{rank_bucket}")
+    out: Dict[str, Any] = {}
+    for t in targets:
+        d_in, d_out = _target_dims(cfg, t)
+        a_l, b_l = [], []
+        for blk in adapters["blocks"]:
+            if t not in blk:
+                raise ValueError(
+                    f"adapter is missing pool target {t!r} — the pool's "
+                    "targets must be a subset of every registered "
+                    "adapter's")
+            ab = blk[t]
+            a = jnp.zeros((d_in, rank_bucket), jnp.float32)
+            a = a.at[:, :r].set(ab["a"].astype(jnp.float32))
+            b = jnp.zeros((rank_bucket, d_out), jnp.float32)
+            # multiply in the adapter's own dtype first (graft_lora's
+            # exact arithmetic), THEN upcast losslessly for storage
+            b = b.at[:r, :].set((ab["b"] * scale).astype(jnp.float32))
+            a_l.append(a)
+            b_l.append(b)
+        out[t] = {"a": jnp.stack(a_l), "b": jnp.stack(b_l)}
+    return out
 
 
 def merge_lora(base_params: Dict[str, Any], adapters: Dict[str, Any],

@@ -23,6 +23,14 @@ fixed-shape cache. This subsystem is the vLLM/Orca-shaped completion:
 * ``router`` — multi-replica routing with lease/epoch replica
   liveness mirroring the PR 5 elastic-membership layer: a dead
   replica's in-flight requests re-queue to survivors.
+* ``adapter_pool`` — multi-tenant LoRA multiplexing (docs/serving.md
+  §multi-tenant): LoRA A/B weights paged into a fixed device-resident
+  slot pool exactly like KV blocks (refcounts, LRU eviction of idle
+  adapters, host registry as the reload source), so ONE replica
+  serves 32+ fine-tuned variants of its base model; the packed decode
+  step gathers each row's adapter by slot index
+  (``ops/segmented_lora.py`` — the S-LoRA/Punica shape) with
+  per-tenant fair queuing and KV quotas in the scheduler.
 * ``kv_wire`` — disaggregated prefill/decode (docs/serving.md
   §disaggregation): dedicated prefill replicas stream committed KV
   blocks to their decode target over a KVCOMPRESS→KVPUSH stage
@@ -42,6 +50,7 @@ from byteps_tpu.common.jax_compat import ensure as _ensure_jax_compat
 
 _ensure_jax_compat()
 
+from byteps_tpu.serve.adapter_pool import AdapterPool  # noqa: E402,F401
 from byteps_tpu.serve.kv_wire import (  # noqa: E402,F401
     BlockPayload,
     KVBlockCodec,

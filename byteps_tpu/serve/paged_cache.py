@@ -77,6 +77,7 @@ from byteps_tpu.models.gpt import (
     rope_rotate,
 )
 from byteps_tpu.ops.flash_attention import attention_lse
+from byteps_tpu.ops.segmented_lora import segmented_lora_delta
 from byteps_tpu.parallel.tp import col_parallel_matmul, row_parallel_matmul
 
 
@@ -701,7 +702,8 @@ def _gather_view(pool_l, scale_l, table, length, dtype, block_size):
 
 @functools.lru_cache(maxsize=64)
 def make_paged_decode_fn(cfg: GPTConfig, block_size: int,
-                         tp_axis: Optional[str] = None):
+                         tp_axis: Optional[str] = None,
+                         lora_sig: Optional[tuple] = None):
     """Build the jitted packed decode step.
 
     ``step(params, pool, toks, pos, tables) -> (logits (R, vocab) f32,
@@ -721,16 +723,59 @@ def make_paged_decode_fn(cfg: GPTConfig, block_size: int,
     logic hasn't been paged yet — detected from the params and
     rejected loudly).
 
-    lru-cached by (cfg, block_size, tp_axis): every Scheduler replica
-    in the process shares ONE jit wrapper, so a fresh replica (bench
-    rep, failover respawn) reuses the compiled steps instead of paying
-    a full retrace."""
+    Multi-tenant variant: ``lora_sig=(targets, rank_bucket,
+    n_adapter_slots)`` makes the step accept two trailing arguments —
+    the :class:`~byteps_tpu.serve.adapter_pool.AdapterPool`'s slab dict
+    and a ``(R,)`` int32 per-row slot vector — and each row adds its
+    OWN adapter's low-rank delta beside every frozen matmul via
+    ``ops/segmented_lora.segmented_lora_delta`` (slot 0 is the pool's
+    reserved zero adapter, so base-model and padded rows stay exact
+    no-ops). The rank bucket and slot count sit in the factory cache
+    key: mixed-rank tenants share ONE compiled step (they're padded to
+    the bucket), while a pool-geometry change gets its own wrapper
+    instead of silently colliding — the retrace-count tests pin this.
+
+    lru-cached by (cfg, block_size, tp_axis, lora_sig): every Scheduler
+    replica in the process shares ONE jit wrapper, so a fresh replica
+    (bench rep, failover respawn) reuses the compiled steps instead of
+    paying a full retrace."""
     resolve_rope(cfg)
     norm_fn, norm_eps = resolve_norm(cfg)
     rope_base = cfg.rope_base if cfg.pos_embedding == "rope" else 0.0
     head_dim, use_bias = cfg.head_dim, cfg.use_bias
+    lora_targets = () if lora_sig is None else tuple(lora_sig[0])
 
-    def _block(x, p, pool, li, blk, off, pos, tables):
+    def _seg(name, xin, slabs, slots, li, row_parallel=False):
+        # one layer's slab slice: (n_slots, d_in, rb) / (n_slots, rb, d_out)
+        sl = slabs[name]
+        return segmented_lora_delta(
+            xin, sl["a"][:, li], sl["b"][:, li], slots,
+            row_parallel=row_parallel, tp_axis=tp_axis)
+
+    def _mlp_seg(x, p, slabs, slots, li):
+        # gpt._mlp with per-row segmented deltas spliced in at the SAME
+        # points (value path, gate path, row projection) so a pooled
+        # tenant's MLP arithmetic is the solo grafted one exactly
+        h = col_parallel_matmul(x, p["w1"].astype(x.dtype),
+                                _bias(p, "b1", x, use_bias))
+        if "w1" in lora_targets:
+            h = h + _seg("w1", x, slabs, slots, li)
+        if "w3" in p:
+            g = col_parallel_matmul(x, p["w3"].astype(x.dtype),
+                                    _bias(p, "b3", x, use_bias))
+            if "w3" in lora_targets:
+                g = g + _seg("w3", x, slabs, slots, li)
+            h = jax.nn.silu(h) * g
+        else:
+            h = jax.nn.gelu(h)
+        out = row_parallel_matmul(h, p["w2"].astype(x.dtype), tp_axis,
+                                  _bias(p, "b2", x, use_bias))
+        if "w2" in lora_targets:
+            out = out + _seg("w2", h, slabs, slots, li, row_parallel=True)
+        return out
+
+    def _block(x, p, pool, li, blk, off, pos, tables,
+               slabs=None, slots=None):
         from byteps_tpu.models.lora import lora_delta
 
         R = x.shape[0]
@@ -745,6 +790,13 @@ def make_paged_decode_fn(cfg: GPTConfig, block_size: int,
             q = q + lora_delta(h, p, "wq")
             k = k + lora_delta(h, p, "wk")
             v = v + lora_delta(h, p, "wv")
+        if slabs is not None:
+            if "wq" in lora_targets:
+                q = q + _seg("wq", h, slabs, slots, li)
+            if "wk" in lora_targets:
+                k = k + _seg("wk", h, slabs, slots, li)
+            if "wv" in lora_targets:
+                v = v + _seg("wv", h, slabs, slots, li)
         h_loc = q.shape[-1] // head_dim
         kv_loc = k.shape[-1] // head_dim
         q = q.reshape(R, 1, h_loc, head_dim)
@@ -784,12 +836,17 @@ def make_paged_decode_fn(cfg: GPTConfig, block_size: int,
                                        _bias(p, "bo", x, use_bias))
         if "lora" in p:
             attn_out = attn_out + lora_delta(o, p, "wo", tp_axis)
+        if slabs is not None and "wo" in lora_targets:
+            attn_out = attn_out + _seg("wo", o, slabs, slots, li,
+                                       row_parallel=True)
         x = x + attn_out
         h2 = norm_fn(x, p["ln2_g"], p.get("ln2_b"), norm_eps)
         if "moe" in p:
             raise NotImplementedError(
                 "the paged decode step serves dense-MLP GPT families "
                 "only — MoE routing hasn't been paged yet")
+        if slabs is not None:
+            return x + _mlp_seg(h2, p, slabs, slots, li), pool
         return x + _mlp(h2, p, tp_axis, use_bias=use_bias), pool
 
     # the pool is DONATED: the caller always rebinds its state to the
@@ -797,7 +854,7 @@ def make_paged_decode_fn(cfg: GPTConfig, block_size: int,
     # (L, NB, bs, h, D) pool every step to honor functional semantics —
     # measured ~45 ms/step of pure memcpy at serving sizes on CPU
     @functools.partial(jax.jit, donate_argnums=(1,))
-    def step(params, pool, toks, pos, tables):
+    def step(params, pool, toks, pos, tables, slabs=None, slots=None):
         tok2 = toks[:, None]                                  # (R, 1)
         if cfg.pos_embedding == "rope":
             x = params["wte"][tok2].astype(cfg.dtype)
@@ -809,7 +866,8 @@ def make_paged_decode_fn(cfg: GPTConfig, block_size: int,
             tables, (pos // block_size)[:, None], axis=1)[:, 0]
         off = pos % block_size
         for li, p in enumerate(params["blocks"]):
-            x, pool = _block(x, p, pool, li, blk, off, pos, tables)
+            x, pool = _block(x, p, pool, li, blk, off, pos, tables,
+                             slabs, slots)
         logits = _readout(params, x, norm_fn, norm_eps)
         return logits[:, 0], pool
 

@@ -229,6 +229,34 @@ class Router:
                    for i in dec)
         return max(1, thr // 4) if frac <= 0.25 else thr
 
+    def _pick_decode_for(self, req: Request, dec: List[int]) -> int:
+        """Least-loaded live decode replica, with ADAPTER AFFINITY for
+        tenanted requests: replicas whose adapter pool already holds
+        the request's adapter RESIDENT win first (admission's acquire
+        is then a residency hit — no host->device slab load on the
+        critical path), then replicas that at least have it registered
+        (reloadable from their host registry); plain least-loaded
+        otherwise. Ties always break by load then index."""
+        # getattr: router duck-types requests/replicas (stub schedulers
+        # in the autoscaling tests predate the adapter surface)
+        adapter = getattr(req, "adapter", None)
+        if adapter is not None:
+            def _pool(i):
+                return getattr(self.replicas[i], "adapter_pool", None)
+            warm = [i for i in dec
+                    if _pool(i) is not None
+                    and _pool(i).resident(adapter)]
+            if warm:
+                return min(warm,
+                           key=lambda i: (self.replicas[i].load, i))
+            able = [i for i in dec
+                    if _pool(i) is not None
+                    and _pool(i).registered(adapter)]
+            if able:
+                return min(able,
+                           key=lambda i: (self.replicas[i].load, i))
+        return min(dec, key=lambda i: (self.replicas[i].load, i))
+
     def submit(self, req: Request,
                resume_tokens: Optional[List[int]] = None) -> int:
         """Route to the least-loaded live replica; returns its index.
@@ -237,12 +265,21 @@ class Router:
         replica (their decode target reserved now, streamed to as
         blocks commit), short ones prefill in place on a decode
         replica. With every prefill replica dead the tier degrades to
-        colocated routing — decode replicas can always prefill."""
+        colocated routing — decode replicas can always prefill.
+        Adapter-tagged requests add pool affinity (see
+        :meth:`_pick_decode_for`); they only classify to a prefill
+        replica that can graft their adapter."""
         dec = self._live_decode()
         if not dec:
             raise NoLiveReplicasError(
                 "no live decode-capable replica to route to")
         pre = self._live_prefill()
+        if pre and getattr(req, "adapter", None) is not None:
+            pre = [i for i in pre
+                   if (getattr(self.replicas[i], "adapter_pool", None)
+                       is not None
+                       and self.replicas[i].adapter_pool.registered(
+                           req.adapter))]
         if pre:
             n_in = (np.asarray(req.prompt).size
                     + len(resume_tokens or ()))
@@ -260,7 +297,7 @@ class Router:
                         self._pick_decode_locked(dec)
                 self._m_dispatch.inc()
                 return target
-        target = min(dec, key=lambda i: (self.replicas[i].load, i))
+        target = self._pick_decode_for(req, dec)
         self.replicas[target].submit(req, resume_tokens=resume_tokens)
         self._m_dispatch.inc()
         return target

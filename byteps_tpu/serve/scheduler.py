@@ -7,8 +7,10 @@ four-phase iteration (``step()``):
 
 1. **Admission** — requests whose arrival time has passed join the
    running set as soon as a decode slot AND enough free KV blocks
-   exist. FIFO in arrival order; preempted requests re-queue at the
-   FRONT (they are the oldest work). With the prefix cache on
+   exist. Per-tenant FIFO in arrival order, deficit-weighted fair
+   queuing ACROSS tenants (``serve_fair_queue``; single-tenant
+   traffic reduces exactly to the historical global FIFO); preempted
+   requests re-queue at the FRONT (they are the oldest work). With the prefix cache on
    (``BYTEPS_SERVE_PREFIX_CACHE``, default), admission first consults
    the pool's radix index: a hit maps the request's leading table
    entries to shared read-only pages (committed by earlier prefills),
@@ -58,6 +60,19 @@ serve-scoped ``replica<N>:kill``) rule in the request's
 :class:`~byteps_tpu.common.faults.FaultPlan` kills the replica at an
 exact step; the router's lease sweep then evicts it — the same
 death-by-silence semantics the PR 5 membership layer pins.
+
+**Multi-tenant LoRA multiplexing** (docs/serving.md §multi-tenant) —
+with an :class:`~byteps_tpu.serve.adapter_pool.AdapterPool` attached,
+one replica serves MANY fine-tuned variants of its base model:
+adapter-tagged requests pin their adapter's pool slot at admission
+(all-or-nothing with the KV blocks), single-request forwards (chunked
+prefill, spec verify) run on the tenant's grafted tree, and the packed
+decode step gathers each row's A/B slabs by slot inside one jitted
+program (the S-LoRA/Punica shape; ``ops/segmented_lora.py``) — every
+tenant's greedy tokens bit-identical to a solo run on its grafted
+params. Per-tenant KV quotas make a flooding tenant preempt ITS OWN
+youngest runs and queue behind its own wall instead of starving
+siblings; ``serve.tenant<T>.*`` metrics carry the per-tenant view.
 
 **Disaggregation** (docs/serving.md §disaggregation) — a Scheduler
 can be a dedicated ``role="prefill"`` or ``role="decode"`` replica:
@@ -173,6 +188,15 @@ class Request:
     eos_id: Optional[int] = None
     spec: Optional[SpecPolicy] = None
     arrival_s: float = 0.0
+    # multi-tenant multiplexing (docs/serving.md §multi-tenant):
+    # ``tenant`` keys fair queuing, KV quotas, and the per-tenant
+    # metric series (None = untenanted legacy traffic, exempt from
+    # quotas); ``adapter`` names a LoRA adapter registered in the
+    # replica's AdapterPool — the request decodes through that
+    # adapter's pool slot, bit-identical to a solo run on its grafted
+    # params (None = the bare base model).
+    tenant: Any = None
+    adapter: Any = None
 
 
 class _Run:
@@ -181,7 +205,8 @@ class _Run:
     __slots__ = ("req", "full_input", "emitted", "pending", "cache_len",
                  "prefill_done", "state", "t_submit", "t_origin", "t_admit",
                  "t_first", "t_last", "preemptions", "spec_rounds",
-                 "draft_cache", "tok_s", "idx_seq", "streamed")
+                 "draft_cache", "tok_s", "idx_seq", "streamed", "tenant",
+                 "slot")
 
     def __init__(self, req: Request, resume_tokens: List[int],
                  t_submit: float):
@@ -213,6 +238,11 @@ class _Run:
         # replicas only): the stream callback sends [streamed, full)
         # after each chunk, so each block crosses the wire exactly once
         self.streamed = 0
+        self.tenant = req.tenant
+        # adapter-pool slot held while admitted (None = base model or
+        # not admitted); acquired at admission, released on finish,
+        # preempt, drain, and migration — mirrors the KV block table
+        self.slot: Optional[int] = None
 
 
 class NoProgressError(RuntimeError):
@@ -238,6 +268,10 @@ class Scheduler:
                  fault_plan: Optional[FaultPlan] = None,
                  replica_id: int = 0,
                  role: str = "both",
+                 adapter_pool=None,
+                 tenant_quota_blocks: Optional[int] = None,
+                 fair_queue: Optional[bool] = None,
+                 tenant_weights: Optional[Dict[Any, float]] = None,
                  clock=time.monotonic):
         """``role`` (disaggregation, docs/serving.md §disaggregation):
         ``"both"`` — the colocated default, admission through decode on
@@ -269,6 +303,28 @@ class Scheduler:
         self.default_spec_len = c.serve_spec_len
         self._prefix_on = prefix_cache if prefix_cache is not None \
             else c.serve_prefix_cache
+        # multi-tenant plane (docs/serving.md §multi-tenant): the
+        # AdapterPool is caller-built and caller-shared (one pool per
+        # replica; the router wires it), quotas/fair-queue default from
+        # config so env knobs reach bench/tests
+        self.adapter_pool = adapter_pool
+        self._quota = tenant_quota_blocks if tenant_quota_blocks \
+            is not None else c.serve_tenant_quota_blocks
+        if self._quota < 0:
+            raise ValueError(
+                f"tenant_quota_blocks must be >= 0; got {self._quota}")
+        self._fair = fair_queue if fair_queue is not None \
+            else c.serve_fair_queue
+        self._weights: Dict[Any, float] = dict(tenant_weights or {})
+        for t, w in self._weights.items():
+            if w <= 0:
+                raise ValueError(
+                    f"tenant weight must be > 0; got {w} for {t!r}")
+        # DWFQ deficit credits, one per tenant with waiting work; the
+        # max over active tenants is renormalized to 0 after every
+        # admission so an idle tenant can't bank credit while away
+        self._credits: Dict[Any, float] = {}
+        self._tm: Dict[Any, Dict[str, Any]] = {}
         quant = quant_cache if quant_cache is not None \
             else c.serve_quant_cache
         bs = block_size if block_size is not None else c.serve_block_size
@@ -389,8 +445,30 @@ class Scheduler:
                 f"request needs {self.cache.blocks_for(total)} KV blocks "
                 f"but the pool holds {self.cache.pool_blocks - 1} — it "
                 "could never be scheduled")
+        if (self._quota and req.tenant is not None
+                and self.cache.blocks_for(total) > self._quota):
+            raise ValueError(
+                f"request needs {self.cache.blocks_for(total)} KV blocks "
+                f"but tenant {req.tenant!r}'s quota is {self._quota} — "
+                "it could never run under the quota")
+        if req.adapter is not None:
+            if self.adapter_pool is None:
+                raise ValueError(
+                    f"request names adapter {req.adapter!r} but this "
+                    "replica has no adapter pool "
+                    "(BYTEPS_SERVE_ADAPTER_SLOTS=0)")
+            if not self.adapter_pool.registered(req.adapter):
+                raise ValueError(
+                    f"adapter {req.adapter!r} is not registered in the "
+                    "pool")
         if req.rid in self._runs:
             raise ValueError(f"duplicate request id {req.rid!r}")
+        if req.adapter is not None:
+            # prefetch-on-admission: warm a FREE slot now (never evicts
+            # a cached sibling) so the admission-time acquire is a
+            # residency hit instead of a host->device load on the
+            # critical path
+            self.adapter_pool.prefetch(req.adapter)
         run = _Run(req, list(resume_tokens or []), self._clock())
         self._runs[req.rid] = run
         if resume_tokens:
@@ -424,6 +502,7 @@ class Scheduler:
         out = []
         for run in list(self._running):
             self.cache.release(run.req.rid)
+            self._release_adapter(run)
             out.append((run.req, list(run.emitted)))
             del self._runs[run.req.rid]
         self._running.clear()
@@ -498,6 +577,7 @@ class Scheduler:
         run = self._runs.pop(rid)
         self._running.remove(run)
         self.cache.release(rid)
+        self._release_adapter(run)
 
     def extract_for_migration(self, rid):
         """Migrate-don't-evict: pull a decoding victim OUT of this
@@ -511,6 +591,7 @@ class Scheduler:
         ticket = self._cut_ticket(
             run, nb, self.cache.snapshot_blocks(rid, 0, nb))
         self.cache.release(rid)
+        self._release_adapter(run)
         run.state = "migrated"
         self._m["migrated_out"].inc()
         get_flight_recorder().record_event(
@@ -534,6 +615,14 @@ class Scheduler:
         rid = req.rid
         if rid in self._runs:
             raise ValueError(f"duplicate request id {rid!r}")
+        if req.adapter is not None and (
+                self.adapter_pool is None
+                or not self.adapter_pool.registered(req.adapter)):
+            raise ValueError(
+                f"migrated request {rid!r} names adapter {req.adapter!r} "
+                "but this replica's pool does not hold it — the router "
+                "must register every adapter on every decode-capable "
+                "replica")
         missing = [bi for bi in range(ticket.n_blocks)
                    if bi not in payloads]
         if missing:
@@ -573,6 +662,15 @@ class Scheduler:
                         and self.migrate_out(self, victim)):
                     continue
                 self._preempt(victim)
+        if req.adapter is not None:
+            try:
+                run.slot = self.adapter_pool.acquire(req.adapter, rid)
+            except PoolExhausted:
+                # every adapter slot is pinned by live requests: roll
+                # back losslessly, the router falls back to recompute
+                # (or a sibling) exactly like the block-fit failure
+                self.cache.release(rid)
+                return False
         row = self.cache.table_row(rid)
         self.cache.write_payloads(
             [int(b) for b in row[hit_n:ticket.n_blocks]],
@@ -624,9 +722,31 @@ class Scheduler:
                 raise RuntimeError(
                     "prefill-only replica asked for the packed decode "
                     "step — the router's role split is broken")
+            lora_sig = None
+            if self.adapter_pool is not None:
+                # (targets, rank bucket, n_slots) joins the factory's
+                # lru key: two replicas with different pool shapes get
+                # different compiled steps instead of silently
+                # retracing each other's per iteration (the compile-
+                # count pin in tests/test_serve_multitenant.py)
+                ap = self.adapter_pool
+                lora_sig = (tuple(ap.targets), ap.rank_bucket,
+                            ap.n_slots)
             self._decode_fn = make_paged_decode_fn(
-                self.cfg, self.cache.block_size, self.tp_axis)
+                self.cfg, self.cache.block_size, self.tp_axis, lora_sig)
         return self._decode_fn
+
+    def _params_for(self, run: _Run):
+        """The parameter tree a single-request forward (chunked
+        prefill, spec verify) runs on: the tenant's grafted tree —
+        built from the pool's canonical padded host slabs and cached
+        per adapter — when the request carries one, else the bare
+        base. Grafting from the SAME rank-bucket-padded slabs the
+        packed decode gathers is what keeps prefill logits, packed
+        decode logits, and the solo baseline bit-identical."""
+        if run.req.adapter is None:
+            return self.params
+        return self.adapter_pool.graft(self.params, run.req.adapter)
 
     @property
     def kv_codec(self):
@@ -657,6 +777,103 @@ class Scheduler:
             self._draft_steps[key] = fn
         return fn
 
+    # -- multi-tenant policy (docs/serving.md §multi-tenant) ----------------
+    def _tenant_m(self, tenant) -> Dict[str, Any]:
+        """Lazy per-tenant metric family (``serve.tenant<T>.*``) —
+        only tenanted requests pay the extra series, so legacy
+        single-model traffic keeps its historical metric surface."""
+        m = self._tm.get(tenant)
+        if m is None:
+            _reg = get_registry()
+            p = f"serve.tenant{tenant}"
+            m = {
+                "admitted": _reg.counter(f"{p}.admitted"),
+                "tokens": _reg.counter(f"{p}.tokens"),
+                "quota_hits": _reg.counter(f"{p}.quota_hits"),
+                "ttft_ms": _reg.histogram(f"{p}.ttft_ms"),
+            }
+            self._tm[tenant] = m
+        return m
+
+    def _tenant_usage(self, tenant) -> int:
+        """KV blocks the tenant's admitted requests hold right now
+        (table lengths — shared prefix pages charge every sharer,
+        which is conservative and keeps the accounting O(running))."""
+        return sum(self.cache.table_len(r.req.rid)
+                   for r in self._running if r.tenant == tenant)
+
+    def _quota_blocked(self, run: _Run) -> bool:
+        """Would admitting ``run`` push its tenant past the KV quota?
+        Untenanted requests are exempt (the quota is tenant isolation,
+        not a pool limit — the pool has its own)."""
+        if not self._quota or run.tenant is None:
+            return False
+        L = len(run.full_input)
+        reserve = L if self.role == "prefill" else L + 1
+        return (self._tenant_usage(run.tenant)
+                + self.cache.blocks_for(reserve) > self._quota)
+
+    def _next_admission(self, now: float, deferred=()) -> Optional[_Run]:
+        """The admission selector. Candidates are each tenant's OLDEST
+        waiting request (per-tenant order is always FIFO) that has
+        arrived, is not quota-blocked, and whose tenant is not
+        fault-deferred — a blocked tenant is skipped WITHOUT
+        head-blocking its siblings. With fair queuing off, or when
+        every candidate is the same (possibly None) tenant, the
+        earliest queue position wins — exactly the historical FIFO.
+        With it on, the max-credit tenant wins (deficit-weighted fair
+        queuing; ties break to the earliest queue position)."""
+        seen = set()
+        cands = []                       # (queue position, run)
+        for pos, run in enumerate(self._waiting):
+            t = run.tenant
+            if t in seen:
+                continue
+            seen.add(t)                  # younger same-tenant work waits
+            if run.req.arrival_s > now:
+                continue
+            if t is not None and str(t) in deferred:
+                continue
+            if self._quota_blocked(run):
+                self._tenant_m(t)["quota_hits"].inc()
+                continue
+            cands.append((pos, run))
+        if not cands:
+            return None
+        if not self._fair:
+            return min(cands)[1]
+        for _, run in cands:
+            self._credits.setdefault(run.tenant, 0.0)
+        return max(cands, key=lambda pr: (self._credits[pr[1].tenant],
+                                          -pr[0]))[1]
+
+    def _charge_admission(self, run: _Run, reserve: int) -> None:
+        """DWFQ accounting for one successful admission: the winner's
+        tenant pays its block reservation over its weight, then the
+        max credit over tenants that still have waiting work (plus the
+        payer) renormalizes to 0 — a tenant idle for an hour returns
+        at credit 0, equal to the current leaders, instead of having
+        banked an hour of unfairness."""
+        if not self._fair:
+            return
+        t = run.tenant
+        w = float(self._weights.get(t, 1.0))
+        self._credits[t] = (self._credits.get(t, 0.0)
+                            - self.cache.blocks_for(reserve) / w)
+        active = {r.tenant for r in self._waiting}
+        active.add(t)
+        mx = max(self._credits.get(a, 0.0) for a in active)
+        self._credits = {a: self._credits.get(a, 0.0) - mx
+                         for a in active}
+
+    def _release_adapter(self, run: _Run) -> None:
+        """Unpin the run's adapter slot (idempotent). The adapter
+        stays RESIDENT at refcount 0 — cached-but-idle, LRU — so the
+        tenant's next request is a residency hit."""
+        if run.slot is not None:
+            self.adapter_pool.release(run.req.adapter, run.req.rid)
+            run.slot = None
+
     # -- internals ----------------------------------------------------------
     def _commit_token(self, run: _Run, tok: int, now: float) -> None:
         """Append one generated token, stamp latencies, finish when the
@@ -664,9 +881,14 @@ class Scheduler:
         run.emitted.append(tok)
         run.pending = tok
         run.tok_s.append(now)
+        if run.tenant is not None:
+            self._tenant_m(run.tenant)["tokens"].inc()
         if run.t_first is None:
             run.t_first = now
             self._m["ttft_ms"].observe((now - run.t_origin) * 1e3)
+            if run.tenant is not None:
+                self._tenant_m(run.tenant)["ttft_ms"].observe(
+                    (now - run.t_origin) * 1e3)
         else:
             self._m["token_ms"].observe((now - run.t_last) * 1e3)
         run.t_last = now
@@ -677,6 +899,7 @@ class Scheduler:
 
     def _finish(self, run: _Run, now: float) -> None:
         self.cache.release(run.req.rid)
+        self._release_adapter(run)
         self._running.remove(run)
         # the run record is done — drop it so a long-lived replica's
         # memory tracks its LIVE load, not its lifetime request count
@@ -709,6 +932,7 @@ class Scheduler:
         # "recompute" side (bench.py --mode serve, migrate leg)
         self._m["recompute_tokens"].inc(run.cache_len)
         self.cache.release(run.req.rid)
+        self._release_adapter(run)
         run.state = "queued"
         run.preemptions += 1
         run.pending = None
@@ -739,6 +963,30 @@ class Scheduler:
         target fresh or admission-CoW'd private blocks, but a shared
         page must NEVER be scattered into, so the invariant is enforced
         here rather than assumed."""
+        # per-tenant KV quota: growth past the tenant's cap preempts
+        # the OFFENDER's own youngest run — never a sibling's — so a
+        # noisy tenant pays its own recompute bill. Terminates: each
+        # preempt frees at least one same-tenant table, and submit()
+        # guarantees a single request fits the quota alone.
+        if self._quota and run.tenant is not None:
+            while True:
+                need = (self.cache.blocks_for(n_tokens)
+                        - self.cache.table_len(run.req.rid))
+                if (need <= 0 or self._tenant_usage(run.tenant) + need
+                        <= self._quota):
+                    break
+                self._tenant_m(run.tenant)["quota_hits"].inc()
+                victim = None
+                for cand in reversed(self._running):
+                    if (cand.tenant == run.tenant and cand is not run
+                            and cand.state in ("prefill", "decode")):
+                        victim = cand
+                        break
+                if victim is None:
+                    victim = run             # its own youngest is itself
+                self._preempt(victim)
+                if victim is run:
+                    return False
         while True:
             try:
                 self.cache.ensure(run.req.rid, n_tokens)
@@ -836,7 +1084,8 @@ class Scheduler:
             d = self._lookup_propose(run, K)
         feed = np.concatenate([[run.pending], d[:K - 1]]).astype(np.int32)
         logits, self.cache.state = self._prefill_fn(K)(
-            self.params, self.cache.state, jnp.asarray(feed)[None],
+            self._params_for(run), self.cache.state,
+            jnp.asarray(feed)[None],
             jnp.int32(pos0),
             jnp.asarray(self.cache.table_row(run.req.rid,
                                              self._width(run.req.rid))))
@@ -889,12 +1138,32 @@ class Scheduler:
         now = self._clock()
         progress = False
 
-        # 1. admission (FIFO in arrival order; head-blocked on blocks so
-        # latecomers can't starve the oldest request)
-        while (self._waiting
-               and len(self._running) < self._admit_cap
-               and self._waiting[0].req.arrival_s <= now):
-            run = self._waiting[0]
+        # tenant-scoped fault rules (tenant<T>:slow|hang): one
+        # attributed intercept per waiting tenant per iteration —
+        # made ONLY when the plan carries tenant rules, so tenant-free
+        # specs keep their historical step-window alignment. A slow
+        # rule sleeps inline inside intercept (the tenant's admission
+        # pays the latency); a hang defers the tenant's admission for
+        # the iteration without sleeping.
+        deferred: set = set()
+        if (self._plan is not None and self._plan.has_tenant_rules()
+                and self._waiting):
+            for t in sorted({str(r.tenant) for r in self._waiting
+                             if r.tenant is not None}):
+                inj = self._plan.intercept("serve", -1, tenant=t)
+                if inj is not None and inj.kind == "hang":
+                    deferred.add(t)
+
+        # 1. admission (per-tenant FIFO in arrival order, DWFQ across
+        # tenants when fair queuing is on — single-tenant traffic is
+        # exactly the historical global FIFO; head-blocked on blocks so
+        # latecomers can't starve the selected request, but a quota- or
+        # fault-blocked tenant is skipped, never head-blocking
+        # siblings)
+        while self._waiting and len(self._running) < self._admit_cap:
+            run = self._next_admission(now, deferred)
+            if run is None:
+                break
             L = len(run.full_input)
             # a prefill-only replica writes exactly L rows (the decode
             # slot L+1 belongs to the decode target's pool)
@@ -928,7 +1197,7 @@ class Scheduler:
                        + self.cache.reclaimable_blocks(
                            exclude=hit_blocks)):
                 break
-            self._waiting.popleft()
+            self._waiting.remove(run)
             self.cache.register(run.req.rid)
             try:
                 if hit_blocks:
@@ -940,10 +1209,18 @@ class Scheduler:
                     # the shared KV below hit_tokens
                     self.cache.ensure_writable(run.req.rid, hit_tokens,
                                                hit_tokens + 1)
+                if run.req.adapter is not None:
+                    # pin the tenant's adapter slot for the run's
+                    # lifetime (all-or-nothing with the KV blocks: a
+                    # PoolExhausted here — every slot pinned by live
+                    # requests — rolls the whole admission back)
+                    run.slot = self.adapter_pool.acquire(
+                        run.req.adapter, run.req.rid)
             except PoolExhausted:
                 # the reclaimable estimate can be beaten by pathological
-                # tree shapes; roll the admission back losslessly and
-                # retry next iteration
+                # tree shapes (and the adapter pool can be pinned out);
+                # roll the admission back losslessly and retry next
+                # iteration
                 self.cache.release(run.req.rid)
                 self._waiting.appendleft(run)
                 break
@@ -960,7 +1237,10 @@ class Scheduler:
             run.state = "prefill"
             run.t_admit = now
             self._running.append(run)
+            self._charge_admission(run, reserve)
             self._m["admitted"].inc()
+            if run.tenant is not None:
+                self._tenant_m(run.tenant)["admitted"].inc()
             self._m["queue_depth"].set(len(self._waiting))
             progress = True
 
@@ -1007,7 +1287,8 @@ class Scheduler:
             # intermediate chunks skip the vocab readout — only the
             # final chunk's last-position logits are ever read
             logits, self.cache.state = self._prefill_fn(C, final)(
-                self.params, self.cache.state, jnp.asarray(toks)[None],
+                self._params_for(run), self.cache.state,
+                jnp.asarray(toks)[None],
                 jnp.int32(run.prefill_done),
                 jnp.asarray(self.cache.table_row(run.req.rid,
                                                  self._width(run.req.rid))))
@@ -1093,9 +1374,24 @@ class Scheduler:
                 tables[i] = self.cache.table_row(run.req.rid, W)
                 seeds[i] = run.req.seed
                 temps[i] = run.req.temperature
-            logits, self.cache.state = self._decode_step()(
-                self.params, self.cache.state, jnp.asarray(toks),
-                jnp.asarray(pos), jnp.asarray(tables))
+            if self.adapter_pool is not None:
+                # heterogeneous-adapter decode: each row gathers its
+                # adapter's A/B slabs by pool slot inside the ONE
+                # jitted step (ops/segmented_lora.py); padded rows and
+                # base-model runs ride slot 0, the reserved all-zero
+                # slot, so batch composition never branches the program
+                slots = np.zeros(R, np.int32)
+                for i, run in enumerate(packed):
+                    if run.slot is not None:
+                        slots[i] = run.slot
+                logits, self.cache.state = self._decode_step()(
+                    self.params, self.cache.state, jnp.asarray(toks),
+                    jnp.asarray(pos), jnp.asarray(tables),
+                    self.adapter_pool.slabs, jnp.asarray(slots))
+            else:
+                logits, self.cache.state = self._decode_step()(
+                    self.params, self.cache.state, jnp.asarray(toks),
+                    jnp.asarray(pos), jnp.asarray(tables))
             picked = np.asarray(self._pick(
                 logits, jnp.asarray(seeds), jnp.asarray(pos + 1),
                 jnp.asarray(temps)))

@@ -43,7 +43,7 @@ run --model t5
 run --model moe                          # Switch-MoE routing overhead vs dense
 run --ce dense                           # flagship w/o fused CE (A/B attribution)
 run --mode generate                      # KV-cache decode vs full recompute (+BENCH_generate.json)
-run_trend_leg --mode serve               # continuous-batching serve vs sequential + shared-prefix TTFT race + disaggregated-vs-colocated race + migrate-don't-evict (+BENCH_serve.json; floors: value, prefix_ttft_p50_speedup, disagg_ttft_p99_speedup, migrate_recompute_saved)
+run_trend_leg --mode serve               # continuous-batching serve vs sequential + shared-prefix TTFT race + disaggregated-vs-colocated race + migrate-don't-evict + multi-tenant LoRA race/flood (+BENCH_serve.json; floors: value, prefix_ttft_p50_speedup, disagg_ttft_p99_speedup, migrate_recompute_saved, multitenant_goodput_speedup, multitenant_fairness)
 run --mode dcn                           # DCN summation tier
 run --mode dcn-profile                   # host component ceilings
 run_trend_leg --mode throttled           # compression race on emulated slow DCN (+BENCH_throttled.json)

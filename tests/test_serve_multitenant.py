@@ -1,0 +1,397 @@
+"""Multi-tenant LoRA multiplexing (byteps_tpu/serve/adapter_pool.py,
+ops/segmented_lora.py, docs/serving.md §multi-tenant).
+
+The acceptance bar mirrors the serve tier's: EXACTNESS plus operational
+pins. Every tenant's tokens out of the packed heterogeneous-adapter
+decode batch must be BIT-identical to a solo ``make_generate_fn`` run
+on that adapter's grafted params; the adapter slot pool must come out
+of any schedule — including a randomized 400-op storm — with clean
+refcounts and zero leaked slots; per-tenant quotas preempt the
+offender's own work, never a sibling's; fair queuing interleaves a
+flooder deterministically; and the ``tenant<T>:`` fault scope
+round-trips the grammar and defers exactly the named tenant."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from byteps_tpu.common.faults import (
+    FaultPlan,
+    parse_fault_spec,
+    rules_to_spec,
+)
+from byteps_tpu.common.metrics import get_registry
+from byteps_tpu.models import GPTConfig, gpt_init
+from byteps_tpu.models.generate import make_generate_fn
+from byteps_tpu.models.lora import lora_init
+from byteps_tpu.serve import AdapterPool, Request, Scheduler
+from byteps_tpu.serve.paged_cache import PoolExhausted, make_paged_decode_fn
+
+CFG = GPTConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt_init(jax.random.PRNGKey(0), CFG)
+
+
+def _mk_adapter(seed, rank, targets=("wq", "wv")):
+    """A LoRA tree whose b is NONZERO — it genuinely changes outputs,
+    so exactness failures can't hide behind a zero delta."""
+    ad = lora_init(jax.random.PRNGKey(seed), CFG, rank, targets)
+    for bi, blk in enumerate(ad["blocks"]):
+        for t in blk:
+            blk[t]["b"] = 0.02 * jax.random.normal(
+                jax.random.fold_in(jax.random.PRNGKey(seed), bi),
+                blk[t]["b"].shape)
+    return ad
+
+
+def _mk_pool(n_slots=4, rank_bucket=4, ranks=(2, 4, 1),
+             scales=(1.0, 1.5, 1.0)):
+    pool = AdapterPool(CFG, n_slots=n_slots, rank_bucket=rank_bucket,
+                       targets=("wq", "wv"))
+    for i, (r, s) in enumerate(zip(ranks, scales)):
+        pool.register(f"a{i}", _mk_adapter(10 + i, r), scale=s)
+    return pool
+
+
+def _solo(params, req):
+    gen = make_generate_fn(CFG, req.max_new)
+    out = gen(params, jnp.asarray(req.prompt)[None],
+              jax.random.PRNGKey(0), 0.0)
+    return np.asarray(out)[0]
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _drive(sched, clock, max_iters=5000):
+    it = 0
+    while not sched.finished:
+        sched.step()
+        clock.t += 0.005
+        it += 1
+        assert it < max_iters, "scheduler failed to drain"
+
+
+def _admission_order(sched):
+    """Record admission order by wrapping the DWFQ charge hook (called
+    exactly once per successful admission)."""
+    order = []
+    orig = sched._charge_admission
+
+    def spy(run, reserve):
+        order.append(run.req.rid)
+        return orig(run, reserve)
+
+    sched._charge_admission = spy
+    return order
+
+
+# ---- adapter pool unit behavior ---------------------------------------------
+def test_pool_slot_lifecycle_and_lru():
+    pool = _mk_pool(n_slots=3, ranks=(2, 4, 1))   # 2 allocatable slots
+    s0 = pool.acquire("a0", "r0")
+    assert s0 != 0 and pool.resident("a0") and pool.live_adapters == 1
+    # second holder pins the SAME slot
+    assert pool.acquire("a0", "r1") == s0
+    pool.release("a0", "r0")
+    assert pool.live_adapters == 1                 # r1 still pins it
+    pool.release("a0", "r1")
+    assert pool.live_adapters == 0 and pool.cached_adapters == 1
+    assert pool.resident("a0")                     # cached-idle stays hot
+    # fill the other slot, then a third adapter LRU-evicts idle a0
+    pool.acquire("a1", "r2")
+    pool.acquire("a2", "r3")
+    assert not pool.resident("a0")
+    pool.check_refcounts()
+    # prefetch never evicts: no free slot, a1/a2 live -> miss
+    assert pool.prefetch("a0") is False
+    pool.release("a1", "r2")
+    pool.release("a2", "r3")
+    assert pool.leaked_slots() == 0
+
+
+def test_pool_exhausted_occupancy_breakdown():
+    pool = _mk_pool(n_slots=3, ranks=(2, 4, 1))   # 2 allocatable slots
+    pool.acquire("a0", "r0")
+    pool.acquire("a1", "r1")
+    with pytest.raises(PoolExhausted) as ei:
+        pool.acquire("a2", "r2")
+    msg = str(ei.value)
+    assert "'a2' needs a slot" in msg and "0 free" in msg
+    assert "2 allocatable = 2 live adapter(s) + 0 cached-idle" in msg
+    # the failed acquire changed nothing (all-or-nothing)
+    pool.check_refcounts()
+    assert pool.live_adapters == 2 and pool.leaked_slots() == 0
+
+
+def test_pool_validation():
+    with pytest.raises(ValueError):
+        AdapterPool(CFG, n_slots=1, rank_bucket=4)
+    pool = _mk_pool()
+    with pytest.raises(ValueError):               # rank > bucket
+        pool.register("big", _mk_adapter(99, 8))
+    with pytest.raises(KeyError):
+        pool.acquire("nope", "r0")
+    pool.acquire("a0", "r0")
+    with pytest.raises(ValueError):               # double pin
+        pool.acquire("a0", "r0")
+    with pytest.raises(ValueError):               # live -> no unregister
+        pool.unregister("a0")
+    with pytest.raises(ValueError):               # live -> no evict
+        pool.evict_idle("a0")
+    pool.release("a0", "r0")
+    with pytest.raises(ValueError):               # unknown holder
+        pool.release("a0", "r0")
+    pool.unregister("a0")
+    assert not pool.registered("a0")
+
+
+def test_pool_randomized_schedule_never_leaks():
+    """400 random acquire/release/prefetch/evict/churn ops against a
+    tight pool; the refcount + slot-partition invariants must hold
+    after EVERY op (the pin that caught real bookkeeping drift)."""
+    rng = np.random.default_rng(7)
+    pool = _mk_pool(n_slots=4, ranks=(2, 4, 1, 3, 2)[:3])
+    for i in range(3, 6):                          # 6 adapters, 3 slots
+        pool.register(f"a{i}", _mk_adapter(20 + i, 1 + i % 4))
+    holders = {f"a{i}": set() for i in range(6)}   # shadow ground truth
+    hseq = 0
+    for step in range(400):
+        aid = f"a{rng.integers(0, 6)}"
+        op = rng.integers(0, 10)
+        if op < 4:                                 # acquire a new holder
+            if not pool.registered(aid):
+                pool.register(aid, _mk_adapter(40 + hseq, 2))
+            h = f"h{hseq}"
+            hseq += 1
+            try:
+                slot = pool.acquire(aid, h)
+                assert 0 < slot < pool.n_slots
+                holders[aid].add(h)
+            except PoolExhausted:
+                assert pool.free_slots == 0 and pool.cached_adapters == 0
+        elif op < 8:                               # release one holder
+            if holders[aid]:
+                pool.release(aid, sorted(holders[aid])[0])
+                holders[aid].remove(sorted(holders[aid])[0])
+        elif op == 8:                              # prefetch (free-only)
+            if pool.registered(aid):
+                pool.prefetch(aid)
+        else:                                      # churn: evict/unregister
+            if pool.registered(aid) and not holders[aid]:
+                if pool.resident(aid):
+                    pool.evict_idle(aid)
+                else:
+                    pool.unregister(aid)
+        pool.check_refcounts()
+        assert pool.leaked_slots() == 0, f"leak at op {step}"
+    assert pool.live_adapters == sum(1 for hs in holders.values() if hs)
+
+
+# ---- decode factory cache keys (satellite: compile-count contract) ----------
+def test_decode_factory_keys_include_lora_sig():
+    """The lru-cached decode factory must key on the pool signature:
+    same (targets, rank bucket, n_slots) -> ONE compiled step shared by
+    every mixed-rank tenant; a different bucket or slot count is a
+    different program."""
+    sig = (("wq", "wv"), 4, 7)
+    before = make_paged_decode_fn.cache_info()
+    f1 = make_paged_decode_fn(CFG, 8, None, sig)
+    assert f1 is make_paged_decode_fn(CFG, 8, None, sig)
+    assert make_paged_decode_fn(CFG, 8, None, (("wq", "wv"), 8, 7)) \
+        is not f1
+    assert make_paged_decode_fn(CFG, 8, None, (("wq", "wv"), 4, 9)) \
+        is not f1
+    after = make_paged_decode_fn.cache_info()
+    assert after.misses - before.misses == 3
+
+
+def test_mixed_rank_tenants_share_one_decode_program(params):
+    """Serving ranks 2/4/1 through one pool adds exactly ONE decode
+    factory entry — the rank bucket is what buys 32+ tenants per
+    compiled step."""
+    pool = _mk_pool(n_slots=5)                     # unique key: n_slots=5
+    before = make_paged_decode_fn.cache_info().misses
+    rng = np.random.default_rng(3)
+    sched = Scheduler(params, CFG, max_batch=4, block_size=8,
+                      pool_blocks=40, prefill_chunk=4, adapter_pool=pool)
+    reqs = [Request(rid=f"r{i}",
+                    prompt=rng.integers(0, CFG.vocab_size,
+                                        5 + 3 * i).astype(np.int32),
+                    max_new=6, tenant=f"t{i}", adapter=f"a{i}")
+            for i in range(3)]
+    sched.serve(list(reqs))
+    assert make_paged_decode_fn.cache_info().misses - before == 1
+
+
+# ---- end-to-end exactness ---------------------------------------------------
+def test_multitenant_bit_exact_vs_solo(params):
+    """4 tenants — mixed ranks (2/4/1), a scaled adapter, and a
+    base-model tenant — packed into ONE continuous batch: every
+    tenant's tokens must be bit-identical to a solo greedy run on its
+    grafted params, with zero leaked KV blocks OR adapter slots."""
+    pool = _mk_pool()
+    rng = np.random.default_rng(7)
+    adapters = ["a0", "a1", "a2", None]
+    reqs = []
+    for i, aid in enumerate(adapters):
+        prompt = rng.integers(0, CFG.vocab_size,
+                              [5, 9, 12, 7][i]).astype(np.int32)
+        reqs.append(Request(rid=f"r{i}", prompt=prompt,
+                            max_new=[8, 6, 9, 7][i],
+                            tenant=f"t{i}", adapter=aid))
+    sched = Scheduler(params, CFG, max_batch=4, block_size=8,
+                      pool_blocks=40, prefill_chunk=4, adapter_pool=pool)
+    results = sched.serve(list(reqs))
+    for req, aid in zip(reqs, adapters):
+        golden = params if aid is None else pool.graft(params, aid)
+        np.testing.assert_array_equal(
+            results[req.rid]["tokens"], _solo(golden, req),
+            err_msg=f"tenant {req.tenant} (adapter {aid}) diverged")
+    assert sched.cache.leaked_blocks() == 0
+    pool.check_refcounts()
+    assert pool.leaked_slots() == 0
+    # adapters end cached-idle (hot for the tenant's next request)
+    assert pool.live_adapters == 0 and pool.cached_adapters == 3
+    snap = get_registry().snapshot()["counters"]
+    for i in range(4):
+        assert snap[f"serve.tenantt{i}.admitted"] >= 1
+        assert snap[f"serve.tenantt{i}.tokens"] >= reqs[i].max_new
+
+
+# ---- per-tenant policy: fair queue + quota ----------------------------------
+def test_fair_queue_interleaves_flooder(params):
+    """Tenant a floods 4 requests before tenant b's 2 arrive; DWFQ
+    admission (admit cap 1) must interleave a1 b1 a2 b2 a3 a4 instead
+    of the FIFO a1 a2 a3 a4 b1 b2."""
+    rng = np.random.default_rng(5)
+    clock = _FakeClock()
+    sched = Scheduler(params, CFG, max_batch=1, block_size=8,
+                      pool_blocks=40, prefill_chunk=16, clock=clock)
+    order = _admission_order(sched)
+    rids = [("a", 4), ("b", 2)]
+    for t, n in rids:
+        for k in range(n):
+            sched.submit(Request(
+                rid=f"{t}{k}",
+                prompt=rng.integers(0, CFG.vocab_size, 6).astype(np.int32),
+                max_new=4, tenant=t))
+    _drive(sched, clock)
+    assert order == ["a0", "b0", "a1", "b1", "a2", "a3"]
+    assert sched.cache.leaked_blocks() == 0
+
+
+def test_fair_queue_off_is_fifo(params):
+    rng = np.random.default_rng(5)
+    clock = _FakeClock()
+    sched = Scheduler(params, CFG, max_batch=1, block_size=8,
+                      pool_blocks=40, prefill_chunk=16, clock=clock,
+                      fair_queue=False)
+    order = _admission_order(sched)
+    for t, n in [("a", 3), ("b", 2)]:
+        for k in range(n):
+            sched.submit(Request(
+                rid=f"{t}{k}",
+                prompt=rng.integers(0, CFG.vocab_size, 6).astype(np.int32),
+                max_new=4, tenant=t))
+    _drive(sched, clock)
+    assert order == ["a0", "a1", "a2", "b0", "b1"]
+
+
+def test_quota_preempts_offender_not_sibling(params):
+    """Tenant A runs two requests whose KV growth crosses A's quota
+    mid-decode: the quota preempts A's OWN youngest (recompute on
+    re-admission keeps it exact), while tenant B — under the same roomy
+    pool — never notices."""
+    rng = np.random.default_rng(9)
+    clock = _FakeClock()
+    snap0 = get_registry().snapshot()["counters"]
+    sched = Scheduler(params, CFG, max_batch=4, block_size=4,
+                      pool_blocks=24, prefill_chunk=16, clock=clock,
+                      tenant_quota_blocks=4)
+    reqs = []
+    for rid, t in [("A0", "A"), ("A1", "A"), ("B0", "B")]:
+        reqs.append(Request(
+            rid=rid,
+            prompt=rng.integers(0, CFG.vocab_size, 5).astype(np.int32),
+            max_new=6, tenant=t))
+        sched.submit(reqs[-1])
+    _drive(sched, clock)
+    for req in reqs:                               # exact through preempt
+        np.testing.assert_array_equal(sched.results[req.rid]["tokens"],
+                                      _solo(params, req))
+    snap = get_registry().snapshot()["counters"]
+    assert snap["serve.tenantA.quota_hits"] > snap0.get(
+        "serve.tenantA.quota_hits", 0)
+    assert snap.get("serve.tenantB.quota_hits", 0) == snap0.get(
+        "serve.tenantB.quota_hits", 0)
+    assert snap["serve.preempted"] > snap0.get("serve.preempted", 0)
+    assert sched.cache.leaked_blocks() == 0
+
+
+def test_quota_rejects_unrunnable_request(params):
+    sched = Scheduler(params, CFG, max_batch=2, block_size=4,
+                      pool_blocks=24, tenant_quota_blocks=2)
+    with pytest.raises(ValueError, match="quota"):
+        sched.submit(Request(rid="x", prompt=np.arange(5, dtype=np.int32),
+                             max_new=8, tenant="A"))
+    # untenanted requests are exempt (quota = isolation, not pool cap)
+    sched.submit(Request(rid="y", prompt=np.arange(5, dtype=np.int32),
+                         max_new=8))
+
+
+# ---- tenant fault scope -----------------------------------------------------
+def test_tenant_fault_grammar_roundtrip():
+    spec = "tenantt0:hang@op=1..4;tenantt1:slow@p=0.5,ms=40"
+    rules = parse_fault_spec(spec)
+    assert rules_to_spec(rules) == spec
+    assert [r.tenant for r in rules] == ["t0", "t1"]
+    rng = np.random.default_rng(0)
+    import random
+    r = rules[0]
+    # matches ONLY tenant-attributed serve intercepts, case-insensitive
+    assert r.matches("serve", -1, 2, random.Random(0), tenant="T0")
+    assert not r.matches("serve", -1, 2, random.Random(0), tenant="t1")
+    assert not r.matches("serve", -1, 2, random.Random(0))
+    assert not r.matches("push", -1, 2, random.Random(0), tenant="t0")
+    del rng
+    with pytest.raises(ValueError):                # kinds are slow|hang
+        parse_fault_spec("tenantt0:kill")
+    with pytest.raises(ValueError):                # id required
+        parse_fault_spec("tenant:hang")
+
+
+def test_tenant_hang_defers_only_named_tenant(params):
+    """tenantt0:hang defers t0's admission while the window is open —
+    t1, queued BEHIND t0, admits first; t0 still completes exactly
+    after the window closes."""
+    rng = np.random.default_rng(11)
+    clock = _FakeClock()
+    plan = FaultPlan(parse_fault_spec("tenantt0:hang@op=1..4"), seed=0)
+    sched = Scheduler(params, CFG, max_batch=2, block_size=8,
+                      pool_blocks=40, prefill_chunk=16, clock=clock,
+                      fault_plan=plan)
+    order = _admission_order(sched)
+    reqs = []
+    for i, t in enumerate(["t0", "t1"]):
+        reqs.append(Request(
+            rid=t, prompt=rng.integers(0, CFG.vocab_size,
+                                       6 + i).astype(np.int32),
+            max_new=5, tenant=t))
+        sched.submit(reqs[-1])
+    _drive(sched, clock)
+    assert order[0] == "t1" and "t0" in order
+    assert plan.injected["hang"] >= 1
+    for req in reqs:
+        np.testing.assert_array_equal(sched.results[req.rid]["tokens"],
+                                      _solo(params, req))
