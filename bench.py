@@ -1662,6 +1662,155 @@ def bench_ici(reps: int = 3) -> dict:
     }
 
 
+def bench_multislice(reps: int = 3, steps: int = 4) -> dict:
+    """Multi-slice FSDP race: {1, 2, 4} emulated slices × {raw, onebit,
+    topk} DCN gradient codecs on an 8-device mesh, one gpt-tiny train
+    step each, plus the ZeRO-3 leg on the 4-slice mesh.
+
+    Emulated slices share one host, so the inter-slice hop runs at
+    loopback speed — the DCN tax is MODELED on top of the measured step:
+    the hierarchical gradient path moves each dp-worker's segment
+    (ceil(P/n_dp) grads) through an allreduce-shaped exchange over
+    slice_ (2(s-1)/s × the segment's WIRE bytes, per the codec's exact
+    ``wire_bytes`` accounting), and that payload is priced at
+    BYTEPS_DCN_THROTTLE_MBPS (default 200 — the throttled-race knee).
+    Same philosophy as --mode throttled: loopback must be made to
+    behave like the wire the feature exists for.
+
+    Headlines (both trend-gated, higher is better):
+
+    - ``multislice_scaling_eff`` — modeled weak-scaling efficiency at 4
+      slices with the best compressed codec: T(1 slice) / T(4 slices,
+      codec). An emulated slice count changes no compute (same 8
+      devices, same global batch), so anything below 1.0 is purely the
+      modeled DCN tax — compression's job is to push it back toward 1.
+    - ``zero3_batch_headroom`` — per-device param+optimizer HBM of the
+      replicated 4-slice step over the ZeRO-3 step on the SAME mesh:
+      the multiplier on memory freed for activations/batch.
+    """
+    import optax
+
+    from byteps_tpu.compression import wire
+    from byteps_tpu.models.gpt import GPTConfig, gpt_init
+    from byteps_tpu.models.train import make_gpt_train_step
+    from byteps_tpu.parallel.mesh import MeshAxes
+    from byteps_tpu.parallel.partitioner import Partitioner
+
+    rate_mbps = float(os.environ.get("BYTEPS_DCN_THROTTLE_MBPS", 0)) or 200.0
+    n = len(jax.devices())
+    cfg = GPTConfig.tiny()
+    B, S = 8, 32
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32)
+    tgts = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32)
+    init = gpt_init(jax.random.PRNGKey(0), cfg)
+    n_params = sum(l.size for l in jax.tree.leaves(init))
+
+    codecs = {
+        "raw": (None, None),
+        "onebit": ({"compressor": "onebit", "ef": True},
+                   wire.OnebitWire(scaling=True)),
+        "topk": ({"compressor": "topk", "k": 0.01, "ef": True},
+                 wire.TopkWire(k=0.01, selection="block")),
+    }
+
+    def per_dev_bytes(tree):
+        return sum(sh.data.nbytes for l in jax.tree.leaves(tree)
+                   for sh in l.addressable_shards) / n
+
+    def run_leg(axes, comp, zero_3=False):
+        part = Partitioner.create(axes)
+        step, params, opt_state, bs = make_gpt_train_step(
+            cfg, part.mesh, optax.adam(1e-3),
+            compression_params=comp, zero_3=zero_3,
+            init_params=jax.tree.map(jnp.array, init))
+        state_bytes = per_dev_bytes((params, opt_state))
+        t, g = jax.device_put(toks, bs), jax.device_put(tgts, bs)
+        loss, params, opt_state = step(params, opt_state, t, g)  # compile
+        jax.block_until_ready(loss)
+        samples = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss, params, opt_state = step(params, opt_state, t, g)
+            jax.block_until_ready(loss)
+            samples.append((time.perf_counter() - t0) / steps)
+        samples.sort()
+        return (samples[len(samples) // 2], [samples[0], samples[-1]],
+                float(loss), state_bytes)
+
+    slices = tuple(s for s in (1, 2, 4) if n % s == 0 and n // s >= 2)
+    results = {}
+    t_base = None
+    for s in slices:
+        axes = MeshAxes(dp=n // s, slice_=s)
+        srow = {}
+        for cname, (comp, wc) in codecs.items():
+            med, spread, loss, _ = run_leg(axes, comp)
+            seg = -(-n_params // (n // s))
+            wire_b = wc.wire_bytes(seg) if wc is not None else seg * 4
+            dcn_sec = (2 * (s - 1) / s) * wire_b * 8 / (rate_mbps * 1e6)
+            modeled = med + dcn_sec
+            if s == 1 and cname == "raw":
+                t_base = round(modeled, 4)
+            srow[cname] = {
+                "sec_med": round(med, 4), "sec_spread":
+                    [round(spread[0], 4), round(spread[1], 4)],
+                "dcn_wire_bytes": int(wire_b),
+                "modeled_dcn_sec": round(dcn_sec, 4),
+                "modeled_step_sec": round(modeled, 4),
+                "scaling_eff": None,  # filled once t_base is known
+                "loss": round(loss, 4),
+            }
+            _log(f"multislice s={s} {cname:>6}: step {med*1e3:7.2f}ms + "
+                 f"DCN {dcn_sec*1e3:7.2f}ms @ {rate_mbps:g} Mbps "
+                 f"(wire {wire_b/1e6:.3f} MB)")
+        results[str(s)] = srow
+    for srow in results.values():
+        for r in srow.values():
+            r["scaling_eff"] = round(t_base / r["modeled_step_sec"], 4)
+
+    s_max = slices[-1]
+    best_name, best_eff = max(
+        ((c, results[str(s_max)][c]["scaling_eff"])
+         for c in codecs if c != "raw"), key=lambda kv: kv[1])
+
+    # ZeRO-3 leg on the max-slice mesh: same data, state sharded 1/s
+    axes = MeshAxes(dp=n // s_max, slice_=s_max)
+    _, _, _, rep_bytes = run_leg(axes, None)
+    z_med, z_spread, z_loss, z_bytes = run_leg(axes, None, zero_3=True)
+    headroom = rep_bytes / z_bytes
+    _log(f"multislice zero3 s={s_max}: step {z_med*1e3:.2f}ms, "
+         f"state {z_bytes/1e6:.2f} MB/dev vs replicated "
+         f"{rep_bytes/1e6:.2f} MB/dev — headroom {headroom:.2f}x")
+    results["zero3"] = {
+        "slices": s_max,
+        "sec_med": round(z_med, 4),
+        "sec_spread": [round(z_spread[0], 4), round(z_spread[1], 4)],
+        "loss": round(z_loss, 4),
+        "state_bytes_per_dev": int(z_bytes),
+        "replicated_state_bytes_per_dev": int(rep_bytes),
+    }
+    return {
+        "metric": ("emulated multi-slice FSDP: hierarchical compressed "
+                   "DCN gradient exchange (modeled wire tax at "
+                   f"{rate_mbps:g} Mbps) + ZeRO-3 state sharding"),
+        "value": best_eff,
+        "unit": (f"x weak-scaling eff @ {s_max} slices ({best_name}; "
+                 "raw = "
+                 f"{results[str(s_max)]['raw']['scaling_eff']})"),
+        "vs_baseline": round(
+            best_eff / results[str(s_max)]["raw"]["scaling_eff"], 4),
+        "multislice_scaling_eff": best_eff,
+        "zero3_batch_headroom": round(headroom, 4),
+        "rate_mbps": rate_mbps,
+        "devices": n,
+        "device_kind": jax.devices()[0].device_kind,
+        "n_params": int(n_params),
+        "results": results,
+    }
+
+
 def bench_dcn(reps: int = 3) -> dict:
     """DCN summation-tier goodput on localhost: 2 workers + 1 native
     server, 4 MB partitions (the reference partition size), up to 4
@@ -3243,6 +3392,12 @@ _TREND_SPECS = (
     ("BENCH_serve.json", "multitenant_fairness"),
     ("BENCH_ici.json", "ring_vs_staged_best"),
     ("BENCH_ici.json", "ring_bus_bw_best"),
+    # multi-slice FSDP (bench_multislice): modeled weak-scaling
+    # efficiency at max emulated slices with the best compressed DCN
+    # codec, and the ZeRO-3 per-device param+opt HBM multiplier vs the
+    # replicated step on the same mesh — docs/performance.md
+    ("BENCH_multislice.json", "multislice_scaling_eff"),
+    ("BENCH_multislice.json", "zero3_batch_headroom"),
     # what-if simulator prediction accuracy (1 − median rel err over the
     # predicted-vs-measured sweep): a cost-model regression fails the
     # gate like any perf regression (docs/whatif.md)
@@ -3391,8 +3546,8 @@ def main() -> None:
     ap.add_argument("--mode",
                     choices=["auto", "dcn", "dcn-profile", "throttled",
                              "tune", "chaos", "hybrid", "generate",
-                             "serve", "ici", "profile", "trend",
-                             "whatif"],
+                             "serve", "ici", "multislice", "profile",
+                             "trend", "whatif"],
                     default="auto")
     ap.add_argument("--refresh", action="store_true",
                     help="trend mode: rebuild BENCH_trend.json's "
@@ -3511,6 +3666,33 @@ def main() -> None:
         with open("BENCH_ici.json", "w") as f:
             json.dump(result, f, indent=1)
         _log("bench: wrote BENCH_ici.json")
+    elif args.mode == "multislice":
+        if flags_set:
+            _log("bench: WARNING --model/--compressor/--ce ignored in "
+                 "multislice mode")
+        n = _devices_or_die(
+            float(os.environ.get("BYTEPS_BENCH_DEVICE_TIMEOUT", "600")))
+        if n < 8 and not os.environ.get("BYTEPS_BENCH_MS_NO_REEXEC"):
+            # the slice race needs {1,2,4} × dp>=2 from one device set;
+            # fake it with virtual CPU devices exactly like --mode ici
+            import subprocess
+
+            _log(f"bench: {n} device(s) < 8 — re-exec on an 8-device "
+                 "virtual CPU mesh")
+            env = dict(os.environ)
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                                + " --xla_force_host_platform_device_count=8"
+                                ).strip()
+            env["JAX_PLATFORMS"] = "cpu"
+            env["BYTEPS_BENCH_MS_NO_REEXEC"] = "1"
+            sys.exit(subprocess.call(
+                [sys.executable, os.path.abspath(__file__), "--mode",
+                 "multislice"], env=env))
+        _log(f"bench: {n} device(s): {jax.devices()[0].device_kind}")
+        result = bench_multislice()
+        with open("BENCH_multislice.json", "w") as f:
+            json.dump(result, f, indent=1)
+        _log("bench: wrote BENCH_multislice.json")
     elif args.mode == "trend":
         if args.refresh:
             result = trend_refresh()

@@ -132,6 +132,19 @@ class Config:
     # Salt of the partition→owner rendezvous hash (reshuffles placement
     # without renaming tensors; must agree across a pod's controllers).
     owner_salt: int = 0
+    # Multi-slice mesh: > 1 adds a leading slice_ axis of this size to
+    # make_mesh/factor_devices (real TPU pods via
+    # create_hybrid_device_mesh, anywhere else emulated slice
+    # boundaries). The Partitioner routes "batch" over (slice_, dp) and
+    # the gradient path becomes hierarchical: per-slice ICI
+    # reduce-scatter, (optionally compressed) DCN exchange over slice_,
+    # ICI all-gather. See docs/architecture.md §partitioner.
+    num_slices: int = 1
+    # ZeRO-3 FSDP (parallel/zero3.py): params + optimizer moments live
+    # as flat segments sharded over slice_ (or dp), all-gathered
+    # just-in-time per layer. Launchers translate this into
+    # make_gpt_train_step(zero_3=True).
+    zero3: bool = False
 
     # --- robustness / chaos (docs/robustness.md) ---------------------------
     # Deterministic fault injection at the PSWorker wire boundary
@@ -367,6 +380,8 @@ class Config:
             hybrid_sharded=_env_bool("BYTEPS_HYBRID_SHARDED", True),
             pod_controllers=_env_int("BYTEPS_POD_CONTROLLERS", 1),
             owner_salt=_env_int("BYTEPS_OWNER_SALT", 0),
+            num_slices=max(1, _env_int("BYTEPS_NUM_SLICES", 1)),
+            zero3=_env_bool("BYTEPS_ZERO3"),
             fault_spec=_env_str("BYTEPS_FAULT_SPEC", ""),
             fault_seed=_env_int("BYTEPS_FAULT_SEED", 0),
             retry_limit=_env_int("BYTEPS_RETRY_LIMIT", 8),

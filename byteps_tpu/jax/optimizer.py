@@ -337,6 +337,8 @@ def DistributedOptimizer(
     per_device_numel: Optional[int] = None,
     state_leading: tuple = (),
     zero: bool = False,
+    dcn_axis: Optional[str] = None,
+    num_dcn: Optional[int] = None,
 ) -> optax.GradientTransformation:
     """Wrap an optax transformation with BytePS gradient aggregation.
 
@@ -374,12 +376,35 @@ def DistributedOptimizer(
     ``P(pp_axis, ..., dp_axis)`` and every device sees exactly its own
     flat residual (``update`` ravels whatever block arrives).
 
+    ``dcn_axis`` turns on the HIERARCHICAL multi-slice path (the BytePS
+    thesis applied to an ICI×DCN topology): each slice reduce-scatters
+    its gradients RAW over the fast intra-slice ``axis`` (every dp rank
+    owns one flat segment), the owned segment is exchanged across slices
+    over ``dcn_axis`` — compressed with EF when ``compression_params``
+    is set, so the codec pays down only the slow inter-slice wire — and
+    the result all_gathers back over ``axis``. EF/momentum residuals are
+    per-(slice, dp-rank) SEGMENT state: buffers come out sized
+    ``ceil(total/n_dp)`` per device, sharded ``P(..., (dcn_axis, axis))``
+    via ``dp_state_specs(dcn_axis=)``. Incompatible with ``zero`` (the
+    ZeRO-1 segment flow owns the scatter already). On a slice-only mesh
+    pass the DCN axis as ``axis`` instead — the legacy single-axis path
+    then compresses straight over DCN.
+
     Reference: ``DistributedOptimizer(optimizer, named_parameters,
     compression, ...)`` in byteps/torch — same contract, functional form.
     """
     cfg = get_config()
     axis_name = axis or cfg.dp_axis
     spec = from_params(compression_params)
+    if zero and dcn_axis is not None:
+        raise ValueError(
+            "zero=True and dcn_axis are mutually exclusive — ZeRO-1's "
+            "segment flow already owns the reduce-scatter; shard over "
+            "one axis or use the ZeRO-3 factory for multi-slice FSDP")
+    n_dcn = (num_dcn if num_dcn is not None else 1) if dcn_axis else 1
+
+    def _seg_of(total: int, n: int) -> int:
+        return -(-total // n)
 
     def init_fn(params):
         # count elements from shapes — params may be tp-sharded global
@@ -399,9 +424,14 @@ def DistributedOptimizer(
         # reference worker): globally state_leading + (n * total,), sharded
         # over (those axes..., dp) so each device's shard_map block is its
         # own (total,) buffer. Shard with `dp_state_specs()`; see that
-        # helper's docstring.
+        # helper's docstring. Under dcn_axis each worker's residual covers
+        # only its OWNED dp segment (the only data it compresses), so the
+        # global buffer is (n_dcn * n_dp * seg,) over (dcn, dp).
         n = num_devices if num_devices is not None else len(jax.devices())
-        shape = tuple(state_leading) + (n * total,)
+        if dcn_axis is not None:
+            shape = tuple(state_leading) + (n_dcn * n * _seg_of(total, n),)
+        else:
+            shape = tuple(state_leading) + (n * total,)
         ef = (
             jnp.zeros(shape, jnp.float32)
             if (spec.enabled and spec.ef)
@@ -486,6 +516,50 @@ def DistributedOptimizer(
             inner=new_inner, count=state.count + 1, ef=new_ef, momentum=mom
         )
 
+    def _hier_update(grads, state, params, n, rng, ef_shape, mom_shape,
+                     chunk_elems):
+        """Multi-slice step: raw ICI reduce-scatter over dp → compressed
+        (EF'd, chunked) exchange of the owned segment across dcn_axis →
+        raw ICI all_gather — only segment-sized compressed payloads ever
+        cross the DCN wire, and each does so exactly once."""
+        flat, sizes = _flatten_concat(grads)
+        total = flat.shape[0]
+        seg = _seg_of(total, n)
+        if n > 1:
+            padded = jnp.pad(flat, (0, n * seg - total))
+            my_seg = jax.lax.psum_scatter(
+                padded, axis_name, scatter_dimension=0, tiled=True)
+        else:
+            my_seg = flat
+        mom = state.momentum
+        if mom is not None:
+            my_seg, mom = momentum_step(my_seg, mom, spec.mu)
+        agg_seg, new_ef, nchunks = _aggregate_flat(
+            my_seg, dcn_axis, n_dcn, False, spec, rng, state.ef,
+            chunk_elems, spec.two_way,
+        )
+        if n > 1:
+            full = jax.lax.all_gather(
+                agg_seg, axis_name, axis=0, tiled=True)[:total]
+        else:
+            full = agg_seg[:total]
+        if average:
+            full = full / (n * n_dcn)
+        updates_grads = _unconcat_unflatten(full, grads, sizes)
+        if cfg.trace_on and _host_callbacks_supported():
+            jax.debug.callback(
+                _fused_trace_callback, state.count,
+                total_elems=total, chunks=nchunks,
+            )
+        updates, new_inner = tx.update(updates_grads, state.inner, params)
+        if new_ef is not None:
+            new_ef = new_ef.reshape(ef_shape)
+        if mom is not None:
+            mom = mom.reshape(mom_shape)
+        return updates, DistributedOptState(
+            inner=new_inner, count=state.count + 1, ef=new_ef, momentum=mom
+        )
+
     def update_fn(grads, state: DistributedOptState, params=None):
         n = num_devices if num_devices is not None else jax.lax.axis_size(axis_name)
         # spec.seed (reference compression_params 'seed') co-determines the
@@ -508,11 +582,12 @@ def DistributedOptimizer(
             momentum=(state.momentum.ravel()
                       if state.momentum is not None else None),
         )
+        expected = _seg_of(total, n) if dcn_axis is not None else total
         for buf, kind in ((state.ef, "EF"), (state.momentum, "momentum")):
-            if buf is not None and buf.shape[0] != total:
+            if buf is not None and buf.shape[0] != expected:
                 raise ValueError(
                     f"{kind} state has {buf.shape[0]} elements per device but "
-                    f"this device's gradients have {total}. Most likely "
+                    f"this device expects {expected}. Most likely "
                     "DistributedOptimizer was built without num_devices= on a "
                     "mesh whose dp axis does not span all jax.devices() — "
                     "pass num_devices=mesh.shape['dp'] (and per_device_numel= "
@@ -522,6 +597,16 @@ def DistributedOptimizer(
         if zero:
             return _zero_update(grads, state, params, n, rng,
                                 ef_shape, mom_shape)
+
+        if dcn_axis is not None and spec.enabled:
+            pb = partition_bytes or cfg.partition_bytes
+            return _hier_update(grads, state, params, n, rng,
+                                ef_shape, mom_shape, max(1, pb // 4))
+
+        # raw multi-slice: one psum over the combined (dcn, dp) tuple axis
+        # — VMA-compatible, XLA lowers it hierarchically on hybrid meshes
+        agg_axis = (dcn_axis, axis_name) if dcn_axis is not None else axis_name
+        agg_n = n * n_dcn
 
         mom = state.momentum
         if spec.enabled and mom is not None:
@@ -535,13 +620,13 @@ def DistributedOptimizer(
 
         if spec.enabled and state.ef is not None:
             agg, new_ef = push_pull_inside(
-                grads_in, axis_name, n, average, spec, rng,
+                grads_in, agg_axis, agg_n, average, spec, rng,
                 ef_residual=state.ef, partition_bytes=partition_bytes,
                 two_way=spec.two_way,
             )
         else:
             agg = push_pull_inside(
-                grads_in, axis_name, n, average, spec, rng,
+                grads_in, agg_axis, agg_n, average, spec, rng,
                 partition_bytes=partition_bytes, two_way=spec.two_way,
             )
             new_ef = state.ef
@@ -642,7 +727,8 @@ def _fused_trace_callback(count, total_elems: int, chunks: int) -> None:
 
 
 def dp_state_specs(axis: Optional[str] = None,
-                   leading_axes: tuple = ()) -> DistributedOptState:
+                   leading_axes: tuple = (),
+                   dcn_axis: Optional[str] = None) -> DistributedOptState:
     """PartitionSpec prefix-tree for a ``DistributedOptState``.
 
     Use as the shard_map in/out spec for the optimizer state: the inner
@@ -657,10 +743,15 @@ def dp_state_specs(axis: Optional[str] = None,
 
     ``leading_axes`` names the extra state axes of a pp/ep-composed
     optimizer built with ``state_leading`` (buffer spec becomes
-    ``P(*leading_axes, dp)``).
+    ``P(*leading_axes, dp)``). ``dcn_axis`` names the slice axis of a
+    hierarchical (``DistributedOptimizer(dcn_axis=...)``) optimizer —
+    the segment buffers then shard over the combined ``(dcn, dp)`` axes.
     """
     from jax.sharding import PartitionSpec as P
 
     axis = axis or get_config().dp_axis
-    buf = P(*leading_axes, axis)
+    if dcn_axis is not None:
+        buf = P(*leading_axes, (dcn_axis, axis))
+    else:
+        buf = P(*leading_axes, axis)
     return DistributedOptState(inner=P(), count=P(), ef=buf, momentum=buf)

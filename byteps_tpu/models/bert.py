@@ -80,15 +80,23 @@ def bert_init(rng: jnp.ndarray, cfg: BertConfig) -> Dict[str, Any]:
     }
 
 
-def bert_param_specs(cfg: BertConfig, tp_axis: Optional[str]) -> Dict[str, Any]:
+def bert_logical_specs(cfg: BertConfig) -> Dict[str, Any]:
+    from byteps_tpu.models.gpt import block_logical_specs
     return {
-        "wte": P(), "wpe": P(), "wtype": P(),
-        "emb_ln_g": P(), "emb_ln_b": P(),
-        "blocks": [block_specs(tp_axis) for _ in range(cfg.n_layers)],
-        "mlm_w": P(), "mlm_b": P(),
-        "mlm_ln_g": P(), "mlm_ln_b": P(),
-        "mlm_bias": P(),
+        "wte": ("vocab", "embed"), "wpe": (None, "embed"),
+        "wtype": (None, "embed"),
+        "emb_ln_g": ("embed",), "emb_ln_b": ("embed",),
+        "blocks": [block_logical_specs() for _ in range(cfg.n_layers)],
+        "mlm_w": ("embed", "embed"), "mlm_b": ("embed",),
+        "mlm_ln_g": ("embed",), "mlm_ln_b": ("embed",),
+        "mlm_bias": ("vocab",),
     }
+
+
+def bert_param_specs(cfg: BertConfig, tp_axis: Optional[str]) -> Dict[str, Any]:
+    from byteps_tpu.parallel.partitioner import resolve_specs, rules_from_axes
+    return resolve_specs(bert_logical_specs(cfg),
+                         rules_from_axes(tp_axis=tp_axis))
 
 
 def bert_hidden(params, tokens: jnp.ndarray, cfg: BertConfig,

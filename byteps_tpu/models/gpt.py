@@ -129,6 +129,22 @@ def gpt_init(rng: jnp.ndarray, cfg: GPTConfig) -> Dict[str, Any]:
     return params
 
 
+def gpt_logical_specs(cfg: GPTConfig) -> Dict[str, Any]:
+    """Logical-axis tree matching :func:`gpt_init`'s structure: one tuple
+    of logical names per array dim. The Partitioner's per-family rule
+    table decides what (if anything) each name shards over."""
+    return {
+        "wte": ("vocab", "embed"), "lnf_g": ("embed",),
+        **({"wpe": (None, "embed")} if cfg.pos_embedding == "learned"
+           else {}),
+        **({"lnf_b": ("embed",)} if cfg.norm == "layernorm" else {}),
+        **({} if cfg.tied_readout else {"lm_head": ("embed", "vocab")}),
+        "blocks": [block_logical_specs(cfg.mlp, use_bias=cfg.use_bias,
+                                       norm=cfg.norm)
+                   for _ in range(cfg.n_layers)],
+    }
+
+
 def gpt_param_specs(cfg: GPTConfig, tp_axis: Optional[str]) -> Dict[str, Any]:
     """PartitionSpec tree matching :func:`gpt_init`'s structure.
 
@@ -136,16 +152,12 @@ def gpt_param_specs(cfg: GPTConfig, tp_axis: Optional[str]) -> Dict[str, Any]:
     matching row-parallel weights (wo, w2) split their input dim; biases of
     column-parallel layers are sharded, everything else replicated (dp/sp
     replication is implicit — those axes never appear in param specs).
+    Thin wrapper: the structure lives in :func:`gpt_logical_specs`, the
+    tp policy in the partitioner rules.
     """
-    return {
-        "wte": P(), "lnf_g": P(),
-        **({"wpe": P()} if cfg.pos_embedding == "learned" else {}),
-        **({"lnf_b": P()} if cfg.norm == "layernorm" else {}),
-        **({} if cfg.tied_readout else {"lm_head": P()}),
-        "blocks": [block_specs(tp_axis, cfg.mlp, use_bias=cfg.use_bias,
-                               norm=cfg.norm)
-                   for _ in range(cfg.n_layers)],
-    }
+    from byteps_tpu.parallel.partitioner import resolve_specs, rules_from_axes
+    return resolve_specs(gpt_logical_specs(cfg),
+                         rules_from_axes(tp_axis=tp_axis))
 
 
 def resolve_rope(cfg: GPTConfig) -> float:
@@ -372,26 +384,38 @@ def block_init(rng, d: int, ff: int, hd: int, n_layers: int,
     return p
 
 
+def block_logical_specs(mlp: str = "gelu", use_bias: bool = True,
+                        norm: str = "layernorm") -> Dict[str, Any]:
+    """Logical-axis dict for one transformer block: qkv/w1 are
+    column-parallel (output dim = heads/kv/mlp), wo/w2 row-parallel
+    (input dim likewise), biases follow their weight's output dim."""
+    s = {
+        "ln1_g": ("embed",),
+        "wq": ("embed", "heads"), "wk": ("embed", "kv"),
+        "wv": ("embed", "kv"),
+        "wo": ("heads", "embed"), "ln2_g": ("embed",),
+        "w1": ("embed", "mlp"), "w2": ("mlp", "embed"),
+        **({"w3": ("embed", "mlp")} if mlp == "swiglu" else {}),
+    }
+    if norm == "layernorm":
+        s["ln1_b"] = ("embed",)
+        s["ln2_b"] = ("embed",)
+    if use_bias:
+        s.update({
+            "bq": ("heads",), "bk": ("kv",), "bv": ("kv",),
+            "bo": ("embed",),
+            "b1": ("mlp",), "b2": ("embed",),
+            **({"b3": ("mlp",)} if mlp == "swiglu" else {}),
+        })
+    return s
+
+
 def block_specs(tp_axis, mlp: str = "gelu", use_bias: bool = True,
                 norm: str = "layernorm"):
     """PartitionSpec dict for one transformer block (see gpt_param_specs)."""
-    t = tp_axis
-    s = {
-        "ln1_g": P(), "wq": P(None, t), "wk": P(None, t), "wv": P(None, t),
-        "wo": P(t, None), "ln2_g": P(),
-        "w1": P(None, t), "w2": P(t, None),
-        **({"w3": P(None, t)} if mlp == "swiglu" else {}),
-    }
-    if norm == "layernorm":
-        s["ln1_b"] = P()
-        s["ln2_b"] = P()
-    if use_bias:
-        s.update({
-            "bq": P(t), "bk": P(t), "bv": P(t), "bo": P(),
-            "b1": P(t), "b2": P(),
-            **({"b3": P(t)} if mlp == "swiglu" else {}),
-        })
-    return s
+    from byteps_tpu.parallel.partitioner import resolve_specs, rules_from_axes
+    return resolve_specs(block_logical_specs(mlp, use_bias, norm),
+                         rules_from_axes(tp_axis=tp_axis))
 
 
 def _embed(params, tokens: jnp.ndarray, cfg: GPTConfig,

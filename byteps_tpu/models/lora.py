@@ -102,16 +102,17 @@ def lora_param_specs(cfg: GPTConfig, tp_axis: Optional[str], rank: int,
     targets shard ``b``'s output dim over tp (no extra collective);
     row-parallel targets shard ``a``'s input dim (the (B,S,r)
     intermediate is psum'd in the forward)."""
+    from byteps_tpu.parallel.partitioner import resolve_specs, rules_from_axes
     targets = _check_targets(cfg, targets)
-    t_ax = tp_axis
 
-    def spec(t):
+    def logical(t):
         if t in _COL_TARGETS:
-            return {"a": P(), "b": P(None, t_ax)}
-        return {"a": P(t_ax, None), "b": P()}
+            return {"a": ("embed", None), "b": (None, "heads")}
+        return {"a": ("heads", None), "b": (None, "embed")}
 
-    return {"blocks": [{t: spec(t) for t in targets}
+    tree = {"blocks": [{t: logical(t) for t in targets}
                        for _ in range(cfg.n_layers)]}
+    return resolve_specs(tree, rules_from_axes(tp_axis=tp_axis))
 
 
 def graft_lora(base_params: Dict[str, Any], adapters: Dict[str, Any],

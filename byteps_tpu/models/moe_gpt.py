@@ -77,29 +77,46 @@ def moe_gpt_init(rng, cfg: MoEGPTConfig) -> Dict[str, Any]:
     }
 
 
+def moe_block_logical_specs(use_bias: bool = True, norm: str = "layernorm",
+                            mlp: str = "gelu"):
+    # derive from the dense family's logical tree exactly like
+    # moe_block_init derives from block_init, so new attention params
+    # cannot diverge
+    from byteps_tpu.models.gpt import block_logical_specs
+    from byteps_tpu.parallel.moe import moe_logical_specs
+    s = block_logical_specs(mlp=mlp, use_bias=use_bias, norm=norm)
+    for k in ("w1", "b1", "w2", "b2", "w3", "b3"):
+        s.pop(k, None)
+    s["moe"] = moe_logical_specs(mlp=mlp)
+    return s
+
+
 def moe_block_specs(ep_axis: Optional[str], tp_axis: Optional[str] = None,
                     use_bias: bool = True, norm: str = "layernorm",
                     mlp: str = "gelu"):
-    # derive from the dense family's specs exactly like moe_block_init
-    # derives from block_init, so new attention params cannot diverge
-    s = block_specs(tp_axis, mlp=mlp, use_bias=use_bias, norm=norm)
-    for k in ("w1", "b1", "w2", "b2", "w3", "b3"):
-        s.pop(k, None)
-    s["moe"] = moe_specs(ep_axis, tp_axis, mlp=mlp)
-    return s
+    from byteps_tpu.parallel.partitioner import resolve_specs, rules_from_axes
+    return resolve_specs(
+        moe_block_logical_specs(use_bias=use_bias, norm=norm, mlp=mlp),
+        rules_from_axes(tp_axis=tp_axis, ep_axis=ep_axis))
+
+
+def moe_gpt_logical_specs(cfg: MoEGPTConfig) -> Dict[str, Any]:
+    return {
+        "wte": ("vocab", "embed"), "lnf_g": ("embed",),
+        **({"wpe": (None, "embed")} if cfg.pos_embedding == "learned"
+           else {}),
+        **({"lnf_b": ("embed",)} if cfg.norm == "layernorm" else {}),
+        "blocks": [moe_block_logical_specs(use_bias=cfg.use_bias,
+                                           norm=cfg.norm, mlp=cfg.mlp)
+                   for _ in range(cfg.n_layers)],
+    }
 
 
 def moe_gpt_param_specs(cfg: MoEGPTConfig, ep_axis: Optional[str],
                         tp_axis: Optional[str] = None) -> Dict[str, Any]:
-    return {
-        "wte": P(), "lnf_g": P(),
-        **({"wpe": P()} if cfg.pos_embedding == "learned" else {}),
-        **({"lnf_b": P()} if cfg.norm == "layernorm" else {}),
-        "blocks": [moe_block_specs(ep_axis, tp_axis,
-                                   use_bias=cfg.use_bias, norm=cfg.norm,
-                                   mlp=cfg.mlp)
-                   for _ in range(cfg.n_layers)],
-    }
+    from byteps_tpu.parallel.partitioner import resolve_specs, rules_from_axes
+    return resolve_specs(moe_gpt_logical_specs(cfg),
+                         rules_from_axes(tp_axis=tp_axis, ep_axis=ep_axis))
 
 
 def moe_transformer_block(x, p, cfg: MoEGPTConfig,

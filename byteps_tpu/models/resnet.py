@@ -17,7 +17,6 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
 
 
 @dataclasses.dataclass(frozen=True)
@@ -114,9 +113,16 @@ def resnet_init(rng: jnp.ndarray, cfg: ResNetConfig):
     return params, state
 
 
+def resnet_logical_specs(cfg: ResNetConfig, params) -> Any:
+    """All dims replicated (dp-only family): every leaf is an empty
+    logical tuple, which resolves to ``P()``."""
+    return jax.tree.map(lambda _: (), params)
+
+
 def resnet_param_specs(cfg: ResNetConfig, params) -> Any:
     """All replicated (dp-only family)."""
-    return jax.tree.map(lambda _: P(), params)
+    from byteps_tpu.parallel.partitioner import resolve_specs
+    return resolve_specs(resnet_logical_specs(cfg, params), {})
 
 
 def _conv(x, w, stride=1):

@@ -96,15 +96,20 @@ def _cross_init(rng, d: int, hd: int, n_layers: int) -> Dict[str, Any]:
     }
 
 
-def _cross_specs(tp_axis) -> Dict[str, Any]:
-    t = tp_axis
+def _cross_logical_specs() -> Dict[str, Any]:
     return {
-        "lnx_g": P(), "lnx_b": P(),
-        "xwq": P(None, t), "xbq": P(t),
-        "xwk": P(None, t), "xbk": P(t),
-        "xwv": P(None, t), "xbv": P(t),
-        "xwo": P(t, None), "xbo": P(),
+        "lnx_g": ("embed",), "lnx_b": ("embed",),
+        "xwq": ("embed", "heads"), "xbq": ("heads",),
+        "xwk": ("embed", "kv"), "xbk": ("kv",),
+        "xwv": ("embed", "kv"), "xbv": ("kv",),
+        "xwo": ("heads", "embed"), "xbo": ("embed",),
     }
+
+
+def _cross_specs(tp_axis) -> Dict[str, Any]:
+    from byteps_tpu.parallel.partitioner import resolve_specs, rules_from_axes
+    return resolve_specs(_cross_logical_specs(),
+                         rules_from_axes(tp_axis=tp_axis))
 
 
 def cross_attention(x, mem, p, head_dim: int, tp_axis, sp_axis=None):
@@ -178,19 +183,28 @@ def t5_init(rng: jnp.ndarray, cfg: T5Config) -> Dict[str, Any]:
     }
 
 
-def t5_param_specs(cfg: T5Config, tp_axis: Optional[str]) -> Dict[str, Any]:
+def t5_logical_specs(cfg: T5Config) -> Dict[str, Any]:
+    from byteps_tpu.models.gpt import block_logical_specs
     dec = []
     for _ in range(cfg.n_dec_layers):
-        s = block_specs(tp_axis)
-        s.update(_cross_specs(tp_axis))
+        s = block_logical_specs()
+        s.update(_cross_logical_specs())
         dec.append(s)
     return {
-        "wte": P(), "wpe_src": P(), "wpe_tgt": P(),
-        "enc_blocks": [block_specs(tp_axis) for _ in range(cfg.n_enc_layers)],
+        "wte": ("vocab", "embed"), "wpe_src": (None, "embed"),
+        "wpe_tgt": (None, "embed"),
+        "enc_blocks": [block_logical_specs()
+                       for _ in range(cfg.n_enc_layers)],
         "dec_blocks": dec,
-        "enc_ln_g": P(), "enc_ln_b": P(),
-        "lnf_g": P(), "lnf_b": P(),
+        "enc_ln_g": ("embed",), "enc_ln_b": ("embed",),
+        "lnf_g": ("embed",), "lnf_b": ("embed",),
     }
+
+
+def t5_param_specs(cfg: T5Config, tp_axis: Optional[str]) -> Dict[str, Any]:
+    from byteps_tpu.parallel.partitioner import resolve_specs, rules_from_axes
+    return resolve_specs(t5_logical_specs(cfg),
+                         rules_from_axes(tp_axis=tp_axis))
 
 
 def _sp_positions(S_loc: int, sp_axis: Optional[str]) -> jnp.ndarray:

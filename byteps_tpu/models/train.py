@@ -34,38 +34,17 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from byteps_tpu.jax.optimizer import DistributedOptimizer, dp_state_specs
-from byteps_tpu.models.bert import (
-    BertConfig,
-    bert_init,
-    bert_mlm_loss,
-    bert_param_specs,
-)
+from byteps_tpu.models.bert import BertConfig, bert_init, bert_mlm_loss
 from byteps_tpu.models.gpt import (
     GPTConfig,
-    block_specs,
     gpt_init,
     gpt_loss,
-    gpt_param_specs,
     gpt_pp_loss,
 )
-from byteps_tpu.models.resnet import (
-    ResNetConfig,
-    resnet_init,
-    resnet_loss,
-    resnet_param_specs,
-)
-from byteps_tpu.models.t5 import (
-    T5Config,
-    t5_init,
-    t5_loss,
-    t5_param_specs,
-)
-from byteps_tpu.models.vit import (
-    ViTConfig,
-    vit_init,
-    vit_loss,
-    vit_param_specs,
-)
+from byteps_tpu.models.resnet import ResNetConfig, resnet_init, resnet_loss
+from byteps_tpu.models.t5 import T5Config, t5_init, t5_loss
+from byteps_tpu.models.vit import ViTConfig, vit_init, vit_loss
+from byteps_tpu.parallel.partitioner import Partitioner, stacked_logical_specs
 from byteps_tpu.parallel.sharding import opt_state_specs
 
 
@@ -146,7 +125,7 @@ def _novma_collective_fix(grads, pspecs, mesh, rep_axes, extra_sum_axes=()):
     return grads
 
 
-def _dist_state_setup(mesh, params, pspecs, dp, zero_1):
+def _dist_state_setup(mesh, params, pspecs, dp, zero_1, slc=None):
     """The per-factory distributed-state bookkeeping: which mesh axes give
     each device its own worker state, the per-device grads numel, and the
     kwargs both _make_tx and _shard_params_state need."""
@@ -155,6 +134,11 @@ def _dist_state_setup(mesh, params, pspecs, dp, zero_1):
             "zero_1=True requires a dp mesh axis — ZeRO-1 shards the "
             "optimizer state over dp and there is nothing to shard over "
             "on this mesh")
+    if zero_1 and slc is not None:
+        raise ValueError(
+            "zero_1=True does not compose with a slice_ mesh axis — the "
+            "ZeRO-1 segment flow owns the dp reduce-scatter; use "
+            "zero_3=True for multi-slice FSDP instead")
     state_axes = _state_axes(mesh, pspecs, dp)
     pd_numel = _per_device_numel(params, pspecs, mesh)
     tx_kw = dict(
@@ -274,48 +258,65 @@ def _manual_axis_sums(grads, pspecs, axes):
 
 
 def _make_tx(mesh, base_tx, compression_params, partition_bytes, dp,
-             per_device_numel=None, state_leading=(), zero=False):
-    """Wrap base_tx with dp aggregation (or pass through on a dp-less mesh).
+             per_device_numel=None, state_leading=(), zero=False,
+             dcn=None):
+    """Wrap base_tx with data-parallel aggregation (or pass through on a
+    mesh with no data axes).
+
+    ``dcn`` names the slice_ axis of a hybrid ICI×DCN mesh: aggregation
+    then runs hierarchically (raw intra-slice reduce-scatter over ``dp``,
+    compressed inter-slice exchange over ``dcn``, intra-slice all_gather
+    — DistributedOptimizer's ``dcn_axis`` path). On a slice-only mesh
+    (no dp axis) the DCN axis becomes THE worker axis and the legacy
+    single-axis path compresses straight over the inter-slice wire.
 
     Separated from the params/state sharding so the auto-tuner can rebuild
     the transformation at a new partition size without re-initializing
     optimizer state (partition size affects chunking only, never state
     shapes)."""
-    if dp is None:
+    if dp is None and dcn is None:
         return base_tx
+    if dp is None:
+        dp, dcn = dcn, None
+    kw = {}
+    if dcn is not None:
+        kw = dict(dcn_axis=dcn, num_dcn=mesh.shape[dcn])
     return DistributedOptimizer(
         base_tx, compression_params=compression_params, axis=dp,
         num_devices=mesh.shape[dp], partition_bytes=partition_bytes,
         per_device_numel=per_device_numel, state_leading=state_leading,
-        zero=zero,
+        zero=zero, **kw,
     )
 
 
 def _shard_params_state(mesh, tx, params, pspecs, dp, state_axes=(),
-                        zero_numel=None):
+                        zero_numel=None, slc=None):
     """device_put params, init + shard the optimizer state.
 
     ``zero_numel`` (ZeRO-1 mode, = per-device grads numel) switches the
     inner-state sharding rule: the inner transform's state lives on flat
     vectors shaped ``state_leading + (n_dp * ceil(numel/n_dp),)``, sharded
     ``P(*state_axes, dp)`` so each worker holds only its segment's
-    moments."""
+    moments. ``slc`` (hybrid mesh) shards the hierarchical optimizer's
+    segment buffers over the combined ``(slice_, dp)`` axes."""
     params = jax.device_put(
         params, jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs)
     )
     opt_state = tx.init(params)
     ospecs = opt_state_specs(opt_state, params, pspecs)
-    if dp is not None:
+    agg_dp, agg_dcn = (dp, slc) if dp is not None else (slc, None)
+    if agg_dp is not None:
         # EF / momentum flats are per-worker state: one buffer per (pp/ep
         # stage combination, dp worker)
-        buf_specs = dp_state_specs(axis=dp, leading_axes=state_axes)
+        buf_specs = dp_state_specs(axis=agg_dp, leading_axes=state_axes,
+                                   dcn_axis=agg_dcn)
         buf = buf_specs.ef
         ospecs = ospecs._replace(
             ef=buf if opt_state.ef is not None else None,
             momentum=buf if opt_state.momentum is not None else None,
         )
         if zero_numel is not None:
-            n = mesh.shape[dp]
+            n = mesh.shape[agg_dp]
             proto_shape = tuple(mesh.shape[a] for a in state_axes) + (
                 n * (-(-zero_numel // n)),
             )
@@ -412,15 +413,17 @@ def _spec_axes(spec) -> set:
     return axes
 
 
-def _make_resymmetrize(pspecs, dp):
+def _make_resymmetrize(pspecs, dp, slc=None):
     """Collapse conservative VMA variance on grad leaves (numerical identity
     — AD's auto-psums already made replicated grads bit-identical across
     sp/tp; only the inferred *type* is too wide on some paths)."""
+    keep = {a for a in (dp, slc) if a is not None}
 
     def resym(g, spec):
         allowed = _spec_axes(spec)
         vma = set(getattr(jax.typeof(g), "vma", ()) or ())
-        excess = tuple(sorted(a for a in vma if a not in allowed and a != dp))
+        excess = tuple(sorted(a for a in vma
+                              if a not in allowed and a not in keep))
         return jax.lax.pmean(g, excess) if excess else g
 
     def apply(grads):
@@ -432,7 +435,7 @@ def _make_resymmetrize(pspecs, dp):
 
 def _build_pp_jit(mesh, pspecs, ospecs, batch_spec, loss_fn, tx, dp, pp,
                   ep=None, ep_size=1, mean_axes=(), use_vma=True,
-                  rep_axes=()):
+                  rep_axes=(), slc=None):
     """The grad-assembly skeleton both pipeline factories share: per-device
     masked loss -> psum of each leaf's stage-partial grads over the axes it
     is NOT sharded on (pp always; ep and tp/sp too under check_vma=False,
@@ -441,10 +444,10 @@ def _build_pp_jit(mesh, pspecs, ospecs, batch_spec, loss_fn, tx, dp, pp,
     ``_novma_collective_fix``), resym, dp aggregation via ``tx``, and
     VMA-collapsed loss reporting. ``use_vma=False`` is the compressed /
     ZeRO mode (their collectives defeat VMA's replication analysis)."""
-    resym = _make_resymmetrize(pspecs, dp)
+    resym = _make_resymmetrize(pspecs, dp, slc)
 
     def per_device_step(params, opt_state, tokens, targets):
-        grad_params = _pcast_dp(params, dp, mesh, use_vma)
+        grad_params = _pcast_dp(params, dp, mesh, use_vma, slc)
         # loss_fn returns the last-stage-masked loss: grading through an
         # already-replicated psum double-counts (psum transpose)
         loss, grads = jax.value_and_grad(loss_fn)(
@@ -479,12 +482,14 @@ def _build_pp_jit(mesh, pspecs, ospecs, batch_spec, loss_fn, tx, dp, pp,
     return jax.jit(sharded, donate_argnums=(0, 1))
 
 
-def _pcast_dp(params, dp, mesh, use_vma):
-    """Mark params dp-varying so AD yields per-replica LOCAL grads
-    (dp aggregation must stay in DistributedOptimizer, the framework's
-    hot path)."""
-    if dp is not None and mesh.shape[dp] > 1 and use_vma:
-        return jax.tree.map(lambda x: jax.lax.pcast(x, (dp,), to="varying"),
+def _pcast_dp(params, dp, mesh, use_vma, slc=None):
+    """Mark params varying over the data axes (dp and, on hybrid meshes,
+    slice_) so AD yields per-replica LOCAL grads (aggregation must stay
+    in DistributedOptimizer, the framework's hot path)."""
+    axes = tuple(a for a in (slc, dp)
+                 if a is not None and mesh.shape[a] > 1)
+    if axes and use_vma:
+        return jax.tree.map(lambda x: jax.lax.pcast(x, axes, to="varying"),
                             params)
     return params
 
@@ -497,6 +502,7 @@ def make_gpt_train_step(
     partition_bytes: Optional[int] = None,
     remat: bool = False,
     zero_1: bool = False,
+    zero_3: bool = False,
     accum_steps: int = 1,
     seq_layout: str = "contiguous",
     init_params: Optional[Dict[str, Any]] = None,
@@ -533,22 +539,42 @@ def make_gpt_train_step(
     — see gpt_loss); ``False`` is the dense escape hatch the fused path
     is pinned against. All three accepted by every logits-bearing
     factory in this module.
+
+    ``zero_3=True`` delegates to the ZeRO-3 FSDP factory
+    (:func:`byteps_tpu.parallel.zero3.make_gpt_zero3_train_step`): params
+    live as flat segments sharded over the slice_/dp axis, all-gathered
+    just-in-time per layer inside a remat'd block — per-chip param AND
+    optimizer memory drop ~n_shard×. Its returned ``params`` is the
+    segment dict, not the gpt tree (gather with ``zero3_gather_params``).
     """
-    dp, tp, sp = _axis(mesh, "dp"), _axis(mesh, "tp"), _axis(mesh, "sp")
+    if zero_3:
+        if zero_1:
+            raise ValueError("zero_1 and zero_3 are mutually exclusive")
+        from byteps_tpu.parallel.zero3 import make_gpt_zero3_train_step
+        return make_gpt_zero3_train_step(
+            cfg, mesh, base_tx,
+            compression_params=compression_params,
+            partition_bytes=partition_bytes, remat=remat,
+            seq_layout=seq_layout, init_params=init_params,
+            chunked_ce=chunked_ce)
+    part = Partitioner.for_config(cfg, mesh)
+    dp, tp, sp, slc = part.dp, part.tp, part.sp, part.slice_
     _check_seq_layout(seq_layout, sp)
     use_vma = compression_params is None and not zero_1
-    pspecs = gpt_param_specs(cfg, tp)
+    pspecs = part.param_specs(cfg)
     params = _resolve_init_params(init_params, cfg, pspecs)
     state_axes, tx_kw, zero_numel = _dist_state_setup(
-        mesh, params, pspecs, dp, zero_1)
+        mesh, params, pspecs, dp, zero_1, slc=slc)
     params, opt_state, ospecs = _shard_params_state(
         mesh,
         _make_tx(mesh, base_tx, compression_params, partition_bytes, dp,
-                 **tx_kw),
+                 dcn=slc, **tx_kw),
         params, pspecs, dp, state_axes=state_axes, zero_numel=zero_numel,
+        slc=slc,
     )
-    batch_spec = P(dp, sp)
-    resym = _make_resymmetrize(pspecs, dp)
+    batch_spec = part.batch_spec()
+    mean_axes = tuple(a for a in (slc, dp) if a is not None)
+    resym = _make_resymmetrize(pspecs, dp, slc)
 
     # Grad loss is dp-LOCAL (dp_axis=None): each dp replica is one reference
     # worker computing the grad of its own local mean loss; averaging across
@@ -560,12 +586,13 @@ def make_gpt_train_step(
     )
 
     def build_jit(pb):
-        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, **tx_kw)
+        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, dcn=slc,
+                      **tx_kw)
 
         vag = _accumulating_value_and_grad(loss_fn, accum_steps)
 
         def per_device_step(params, opt_state, tokens, targets):
-            grad_params = _pcast_dp(params, dp, mesh, use_vma)
+            grad_params = _pcast_dp(params, dp, mesh, use_vma, slc)
             loss, grads = vag(grad_params, tokens, targets)
             if use_vma:
                 grads = resym(grads)
@@ -573,8 +600,8 @@ def make_gpt_train_step(
                 grads = _novma_collective_fix(grads, pspecs, mesh, (tp, sp))
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            if dp is not None:
-                loss = jax.lax.pmean(loss, dp)  # report the global mean loss
+            if mean_axes:
+                loss = jax.lax.pmean(loss, mean_axes)  # global mean loss
             return _collapse_vma(loss), params, opt_state
 
         sharded = jax.shard_map(
@@ -589,7 +616,8 @@ def make_gpt_train_step(
         return jax.jit(sharded, donate_argnums=(0, 1))
 
     return (
-        _finalize_step(build_jit, partition_bytes, dp, tunable=not zero_1),
+        _finalize_step(build_jit, partition_bytes, dp or slc,
+                       tunable=not zero_1),
         params, opt_state, NamedSharding(mesh, batch_spec),
     )
 
@@ -638,12 +666,13 @@ def make_gpt_lora_train_step(
     from byteps_tpu.models.lora import (
         graft_lora, lora_init, lora_param_specs)
 
-    dp, tp, sp = _axis(mesh, "dp"), _axis(mesh, "tp"), _axis(mesh, "sp")
+    part = Partitioner.for_config(cfg, mesh)
+    dp, tp, sp, slc = part.dp, part.tp, part.sp, part.slice_
     _check_seq_layout(seq_layout, sp)
     use_vma = compression_params is None
     scale = alpha / rank
 
-    base_specs = gpt_param_specs(cfg, tp)
+    base_specs = part.param_specs(cfg)
     base = _resolve_init_params(base_params, cfg, base_specs)
     base = jax.device_put(
         base, jax.tree.map(lambda s: NamedSharding(mesh, s), base_specs,
@@ -665,15 +694,16 @@ def make_gpt_lora_train_step(
     # (per-device grads are tp-local shards) — same bookkeeping as the
     # dense factory
     state_axes, tx_kw, _ = _dist_state_setup(mesh, adapters, aspecs, dp,
-                                             False)
+                                             False, slc=slc)
     adapters, opt_state, ospecs = _shard_params_state(
         mesh,
         _make_tx(mesh, base_tx, compression_params, partition_bytes, dp,
-                 **tx_kw),
-        adapters, aspecs, dp, state_axes=state_axes,
+                 dcn=slc, **tx_kw),
+        adapters, aspecs, dp, state_axes=state_axes, slc=slc,
     )
-    batch_spec = P(dp, sp)
-    resym = _make_resymmetrize(aspecs, dp)
+    batch_spec = part.batch_spec()
+    mean_axes = tuple(a for a in (slc, dp) if a is not None)
+    resym = _make_resymmetrize(aspecs, dp, slc)
 
     def loss_fn(adapters, base, tokens, targets_):
         grafted = graft_lora(base, adapters, scale)
@@ -682,7 +712,8 @@ def make_gpt_lora_train_step(
                         seq_layout=seq_layout, chunked_ce=chunked_ce)
 
     def build_jit(pb):
-        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, **tx_kw)
+        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, dcn=slc,
+                      **tx_kw)
 
         def per_device_step(adapters, opt_state, base, tokens, targets_):
             # base rides the closure: the accumulator microbatches every
@@ -690,7 +721,7 @@ def make_gpt_lora_train_step(
             vag = _accumulating_value_and_grad(
                 lambda a, tok, tgt: loss_fn(a, base, tok, tgt),
                 accum_steps)
-            grad_adapters = _pcast_dp(adapters, dp, mesh, use_vma)
+            grad_adapters = _pcast_dp(adapters, dp, mesh, use_vma, slc)
             loss, grads = vag(grad_adapters, tokens, targets_)
             if use_vma:
                 grads = resym(grads)
@@ -698,8 +729,8 @@ def make_gpt_lora_train_step(
                 grads = _novma_collective_fix(grads, aspecs, mesh, (tp, sp))
             updates, opt_state = tx.update(grads, opt_state, adapters)
             adapters = optax.apply_updates(adapters, updates)
-            if dp is not None:
-                loss = jax.lax.pmean(loss, dp)
+            if mean_axes:
+                loss = jax.lax.pmean(loss, mean_axes)
             return _collapse_vma(loss), adapters, opt_state
 
         sharded = jax.shard_map(
@@ -712,7 +743,7 @@ def make_gpt_lora_train_step(
         return jax.jit(sharded, donate_argnums=(0, 1))
 
     return (
-        _finalize_step(build_jit, partition_bytes, dp),
+        _finalize_step(build_jit, partition_bytes, dp or slc),
         adapters, opt_state, base, NamedSharding(mesh, batch_spec),
     )
 
@@ -759,10 +790,12 @@ def make_gpt_pp_train_step(
     Returns ``(step, params, opt_state, batch_sharding)`` like
     :func:`make_gpt_train_step`; ``params["blocks"]`` is the stacked slab.
     """
-    from byteps_tpu.parallel.pipeline import stack_blocks, stacked_specs
+    from byteps_tpu.models.gpt import block_logical_specs
+    from byteps_tpu.parallel.pipeline import stack_blocks
 
-    dp, pp = _axis(mesh, "dp"), _axis(mesh, "pp")
-    tp, sp = _axis(mesh, "tp"), _axis(mesh, "sp")
+    part = Partitioner.for_config(cfg, mesh)
+    dp, pp = part.dp, part.pp
+    tp, sp, slc = part.tp, part.sp, part.slice_
     if pp is None:
         raise ValueError("mesh has no pp axis — use make_gpt_train_step")
     _check_seq_layout(seq_layout, sp)
@@ -772,24 +805,25 @@ def make_gpt_pp_train_step(
         raise ValueError(
             f"n_layers={cfg.n_layers} not divisible by pp={nstages}"
         )
-    raw = _resolve_init_params(init_params, cfg, gpt_param_specs(cfg, tp))
+    raw = _resolve_init_params(init_params, cfg, part.param_specs(cfg))
     # pp-replicated leaves follow the config's tree (wpe only under
     # learned positions, lnf_b only under layernorm, lm_head only
     # untied); the blocks become the stacked stage slab
     params = {k: v for k, v in raw.items() if k != "blocks"}
     params["blocks"] = stack_blocks(raw["blocks"])
     pspecs = {k: P() for k in params if k != "blocks"}
-    pspecs["blocks"] = stacked_specs(
-        block_specs(tp, cfg.mlp, use_bias=cfg.use_bias, norm=cfg.norm), pp)
+    pspecs["blocks"] = part.resolve(stacked_logical_specs(
+        block_logical_specs(cfg.mlp, use_bias=cfg.use_bias, norm=cfg.norm)))
     state_axes, tx_kw, zero_numel = _dist_state_setup(
-        mesh, params, pspecs, dp, zero_1)
+        mesh, params, pspecs, dp, zero_1, slc=slc)
     params, opt_state, ospecs = _shard_params_state(
         mesh,
         _make_tx(mesh, base_tx, compression_params, partition_bytes, dp,
-                 **tx_kw),
+                 dcn=slc, **tx_kw),
         params, pspecs, dp, state_axes=state_axes, zero_numel=zero_numel,
+        slc=slc,
     )
-    batch_spec = P(dp, sp)
+    batch_spec = part.batch_spec()
     loss_fn = functools.partial(
         gpt_pp_loss, cfg=cfg, pp_axis=pp, n_micro=n_micro, tp_axis=tp,
         sp_axis=sp, remat=remat,
@@ -798,15 +832,17 @@ def make_gpt_pp_train_step(
     )
 
     def build_jit(pb):
-        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, **tx_kw)
+        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, dcn=slc,
+                      **tx_kw)
         return _build_pp_jit(
             mesh, pspecs, ospecs, batch_spec, loss_fn, tx, dp, pp,
-            mean_axes=(dp,) if dp is not None else (), use_vma=use_vma,
-            rep_axes=(tp, sp),
+            mean_axes=tuple(a for a in (slc, dp) if a is not None),
+            use_vma=use_vma, rep_axes=(tp, sp), slc=slc,
         )
 
     return (
-        _finalize_step(build_jit, partition_bytes, dp, tunable=not zero_1),
+        _finalize_step(build_jit, partition_bytes, dp or slc,
+                       tunable=not zero_1),
         params, opt_state, NamedSharding(mesh, batch_spec),
     )
 
@@ -847,15 +883,12 @@ def make_gpt_moe_train_step(
 
     Returns ``(step, params, opt_state, batch_sharding)``.
     """
-    from byteps_tpu.models.moe_gpt import (
-        moe_gpt_init,
-        moe_gpt_loss,
-        moe_gpt_param_specs,
-    )
+    from byteps_tpu.models.moe_gpt import moe_gpt_init, moe_gpt_loss
 
-    dp, ep = _axis(mesh, "dp"), _axis(mesh, "ep")
-    tp, sp = _axis(mesh, "tp"), _axis(mesh, "sp")
-    if _axis(mesh, "pp") is not None:
+    part = Partitioner.for_config(cfg, mesh)
+    dp, ep = part.dp, part.ep
+    tp, sp, slc = part.tp, part.sp, part.slice_
+    if part.pp is not None:
         raise ValueError(
             "mesh has a pp axis — use make_gpt_moe_pp_train_step for "
             "pipelined MoE"
@@ -867,28 +900,30 @@ def make_gpt_moe_train_step(
         raise ValueError(
             f"n_experts={cfg.n_experts} not divisible by ep={ep_size}"
         )
-    pspecs = moe_gpt_param_specs(cfg, ep, tp)
+    pspecs = part.param_specs(cfg)
     params = moe_gpt_init(jax.random.PRNGKey(0), cfg)
     state_axes, tx_kw, zero_numel = _dist_state_setup(
-        mesh, params, pspecs, dp, zero_1)
+        mesh, params, pspecs, dp, zero_1, slc=slc)
     params, opt_state, ospecs = _shard_params_state(
         mesh,
         _make_tx(mesh, base_tx, compression_params, partition_bytes, dp,
-                 **tx_kw),
+                 dcn=slc, **tx_kw),
         params, pspecs, dp, state_axes=state_axes, zero_numel=zero_numel,
+        slc=slc,
     )
-    batch_spec = P((dp, ep) if dp and ep else (dp or ep), sp)
-    resym = _make_resymmetrize(pspecs, dp)
+    batch_spec = part.batch_spec()
+    resym = _make_resymmetrize(pspecs, dp, slc)
     loss_fn = functools.partial(moe_gpt_loss, cfg=cfg, ep_axis=ep,
                                 tp_axis=tp, sp_axis=sp, remat=remat,
                                 seq_layout=seq_layout,
                                 chunked_ce=chunked_ce)
 
     def build_jit(pb):
-        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, **tx_kw)
+        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, dcn=slc,
+                      **tx_kw)
 
         def per_device_step(params, opt_state, tokens, targets):
-            grad_params = _pcast_dp(params, dp, mesh, use_vma)
+            grad_params = _pcast_dp(params, dp, mesh, use_vma, slc)
             loss, grads = jax.value_and_grad(loss_fn)(
                 grad_params, tokens, targets
             )
@@ -907,7 +942,7 @@ def make_gpt_moe_train_step(
             grads = resym(grads)  # collapse conservative VMA widening
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            axes = tuple(a for a in (dp, ep) if a is not None)
+            axes = tuple(a for a in (slc, dp, ep) if a is not None)
             if axes:
                 loss = jax.lax.pmean(loss, axes)
             loss = _collapse_vma(loss)
@@ -923,7 +958,8 @@ def make_gpt_moe_train_step(
         return jax.jit(sharded, donate_argnums=(0, 1))
 
     return (
-        _finalize_step(build_jit, partition_bytes, dp, tunable=not zero_1),
+        _finalize_step(build_jit, partition_bytes, dp or slc,
+                       tunable=not zero_1),
         params, opt_state, NamedSharding(mesh, batch_spec),
     )
 
@@ -958,14 +994,15 @@ def make_gpt_moe_pp_train_step(
     ``params["blocks"]`` is the stacked MoE-block slab.
     """
     from byteps_tpu.models.moe_gpt import (
-        moe_block_specs,
+        moe_block_logical_specs,
         moe_gpt_init,
         moe_gpt_pp_loss,
     )
-    from byteps_tpu.parallel.pipeline import stack_blocks, stacked_specs
+    from byteps_tpu.parallel.pipeline import stack_blocks
 
-    dp, pp = _axis(mesh, "dp"), _axis(mesh, "pp")
-    ep, tp, sp = _axis(mesh, "ep"), _axis(mesh, "tp"), _axis(mesh, "sp")
+    part = Partitioner.for_config(cfg, mesh)
+    dp, pp = part.dp, part.pp
+    ep, tp, sp, slc = part.ep, part.tp, part.sp, part.slice_
     if pp is None:
         raise ValueError("mesh has no pp axis — use make_gpt_moe_train_step")
     _check_seq_layout(seq_layout, sp)
@@ -984,18 +1021,19 @@ def make_gpt_moe_pp_train_step(
     params = {k: v for k, v in raw.items() if k != "blocks"}
     params["blocks"] = stack_blocks(raw["blocks"])
     pspecs = {k: P() for k in params if k != "blocks"}
-    pspecs["blocks"] = stacked_specs(
-        moe_block_specs(ep, tp, use_bias=cfg.use_bias, norm=cfg.norm,
-                        mlp=cfg.mlp), pp)
+    pspecs["blocks"] = part.resolve(stacked_logical_specs(
+        moe_block_logical_specs(use_bias=cfg.use_bias, norm=cfg.norm,
+                                mlp=cfg.mlp)))
     state_axes, tx_kw, zero_numel = _dist_state_setup(
-        mesh, params, pspecs, dp, zero_1)
+        mesh, params, pspecs, dp, zero_1, slc=slc)
     params, opt_state, ospecs = _shard_params_state(
         mesh,
         _make_tx(mesh, base_tx, compression_params, partition_bytes, dp,
-                 **tx_kw),
+                 dcn=slc, **tx_kw),
         params, pspecs, dp, state_axes=state_axes, zero_numel=zero_numel,
+        slc=slc,
     )
-    batch_spec = P((dp, ep) if dp and ep else (dp or ep), sp)
+    batch_spec = part.batch_spec()
     loss_fn = functools.partial(
         moe_gpt_pp_loss, cfg=cfg, pp_axis=pp, n_micro=n_micro,
         ep_axis=ep, tp_axis=tp, sp_axis=sp, remat=remat,
@@ -1004,16 +1042,18 @@ def make_gpt_moe_pp_train_step(
     )
 
     def build_jit(pb):
-        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, **tx_kw)
+        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, dcn=slc,
+                      **tx_kw)
         return _build_pp_jit(
             mesh, pspecs, ospecs, batch_spec, loss_fn, tx, dp, pp,
             ep=ep, ep_size=ep_size if ep is not None else 1,
-            mean_axes=tuple(a for a in (dp, ep) if a is not None),
-            use_vma=use_vma, rep_axes=(tp, sp),
+            mean_axes=tuple(a for a in (slc, dp, ep) if a is not None),
+            use_vma=use_vma, rep_axes=(tp, sp), slc=slc,
         )
 
     return (
-        _finalize_step(build_jit, partition_bytes, dp, tunable=not zero_1),
+        _finalize_step(build_jit, partition_bytes, dp or slc,
+                       tunable=not zero_1),
         params, opt_state, NamedSharding(mesh, batch_spec),
     )
 
@@ -1032,27 +1072,31 @@ def make_bert_train_step(
     """``step(params, opt_state, tokens, targets, mask)`` — MLM pretraining
     step (BASELINE config 3 shape), same sharding story as GPT (zero_1 /
     accum_steps / chunked_ce semantics included)."""
-    dp, tp, sp = _axis(mesh, "dp"), _axis(mesh, "tp"), _axis(mesh, "sp")
+    part = Partitioner.for_config(cfg, mesh)
+    dp, tp, sp, slc = part.dp, part.tp, part.sp, part.slice_
     use_vma = compression_params is None and not zero_1
-    pspecs = bert_param_specs(cfg, tp)
+    pspecs = part.param_specs(cfg)
     params = bert_init(jax.random.PRNGKey(0), cfg)
     state_axes, tx_kw, zero_numel = _dist_state_setup(
-        mesh, params, pspecs, dp, zero_1)
+        mesh, params, pspecs, dp, zero_1, slc=slc)
     params, opt_state, ospecs = _shard_params_state(
         mesh,
         _make_tx(mesh, base_tx, compression_params, partition_bytes, dp,
-                 **tx_kw),
+                 dcn=slc, **tx_kw),
         params, pspecs, dp, state_axes=state_axes, zero_numel=zero_numel,
+        slc=slc,
     )
-    batch_spec = P(dp, sp)
-    resym = _make_resymmetrize(pspecs, dp)
+    batch_spec = part.batch_spec()
+    mean_axes = tuple(a for a in (slc, dp) if a is not None)
+    resym = _make_resymmetrize(pspecs, dp, slc)
     loss_fn = functools.partial(
         bert_mlm_loss, cfg=cfg, dp_axis=None, tp_axis=tp, sp_axis=sp,
         remat=remat, chunked_ce=chunked_ce,
     )
 
     def build_jit(pb):
-        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, **tx_kw)
+        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, dcn=slc,
+                      **tx_kw)
         # masked-mean loss: weight each microbatch by its mask count so
         # the accumulated gradient equals the full-batch masked mean; the
         # count must be the sp-GLOBAL one (the loss normalizes by it after
@@ -1066,7 +1110,7 @@ def make_bert_train_step(
                                            weight_fn=_mask_count)
 
         def per_device_step(params, opt_state, tokens, targets, mask):
-            grad_params = _pcast_dp(params, dp, mesh, use_vma)
+            grad_params = _pcast_dp(params, dp, mesh, use_vma, slc)
             loss, grads = vag(grad_params, tokens, targets, mask)
             if use_vma:
                 grads = resym(grads)
@@ -1074,8 +1118,8 @@ def make_bert_train_step(
                 grads = _novma_collective_fix(grads, pspecs, mesh, (tp, sp))
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            if dp is not None:
-                loss = jax.lax.pmean(loss, dp)
+            if mean_axes:
+                loss = jax.lax.pmean(loss, mean_axes)
             return _collapse_vma(loss), params, opt_state
 
         sharded = jax.shard_map(
@@ -1088,7 +1132,8 @@ def make_bert_train_step(
         return jax.jit(sharded, donate_argnums=(0, 1))
 
     return (
-        _finalize_step(build_jit, partition_bytes, dp, tunable=not zero_1),
+        _finalize_step(build_jit, partition_bytes, dp or slc,
+                       tunable=not zero_1),
         params, opt_state, NamedSharding(mesh, batch_spec),
     )
 
@@ -1111,32 +1156,35 @@ def make_t5_train_step(
     sequence-shard: non-causal encoder ring, causal decoder ring, and a
     rectangular cross-attention ring over the sp-sharded encoder memory
     (src and tgt lengths must each divide by the sp size)."""
-    dp, tp = _axis(mesh, "dp"), _axis(mesh, "tp")
-    sp = _axis(mesh, "sp")
+    part = Partitioner.for_config(cfg, mesh)
+    dp, tp, sp, slc = part.dp, part.tp, part.sp, part.slice_
     use_vma = compression_params is None and not zero_1
-    pspecs = t5_param_specs(cfg, tp)
+    pspecs = part.param_specs(cfg)
     params = t5_init(jax.random.PRNGKey(0), cfg)
     state_axes, tx_kw, zero_numel = _dist_state_setup(
-        mesh, params, pspecs, dp, zero_1)
+        mesh, params, pspecs, dp, zero_1, slc=slc)
     params, opt_state, ospecs = _shard_params_state(
         mesh,
         _make_tx(mesh, base_tx, compression_params, partition_bytes, dp,
-                 **tx_kw),
+                 dcn=slc, **tx_kw),
         params, pspecs, dp, state_axes=state_axes, zero_numel=zero_numel,
+        slc=slc,
     )
-    batch_spec = P(dp, sp)
-    resym = _make_resymmetrize(pspecs, dp)
+    batch_spec = part.batch_spec()
+    mean_axes = tuple(a for a in (slc, dp) if a is not None)
+    resym = _make_resymmetrize(pspecs, dp, slc)
     loss_fn = functools.partial(
         t5_loss, cfg=cfg, dp_axis=None, tp_axis=tp, sp_axis=sp, remat=remat,
         chunked_ce=chunked_ce,
     )
 
     def build_jit(pb):
-        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, **tx_kw)
+        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, dcn=slc,
+                      **tx_kw)
         vag = _accumulating_value_and_grad(loss_fn, accum_steps)
 
         def per_device_step(params, opt_state, src, tgt_in, tgt_out):
-            grad_params = _pcast_dp(params, dp, mesh, use_vma)
+            grad_params = _pcast_dp(params, dp, mesh, use_vma, slc)
             loss, grads = vag(grad_params, src, tgt_in, tgt_out)
             if use_vma:
                 grads = resym(grads)
@@ -1144,8 +1192,8 @@ def make_t5_train_step(
                 grads = _novma_collective_fix(grads, pspecs, mesh, (tp, sp))
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            if dp is not None:
-                loss = jax.lax.pmean(loss, dp)
+            if mean_axes:
+                loss = jax.lax.pmean(loss, mean_axes)
             return _collapse_vma(loss), params, opt_state
 
         sharded = jax.shard_map(
@@ -1158,7 +1206,8 @@ def make_t5_train_step(
         return jax.jit(sharded, donate_argnums=(0, 1))
 
     return (
-        _finalize_step(build_jit, partition_bytes, dp, tunable=not zero_1),
+        _finalize_step(build_jit, partition_bytes, dp or slc,
+                       tunable=not zero_1),
         params, opt_state, NamedSharding(mesh, batch_spec),
     )
 
@@ -1177,30 +1226,34 @@ def make_vit_train_step(
     opt_state)`` — ViT classification over a (dp, tp) mesh; blocks and
     their tp sharding are shared with GPT/BERT, the batch axis with
     ResNet (sp intentionally unsupported — models/vit.py rationale)."""
-    dp, tp = _axis(mesh, "dp"), _axis(mesh, "tp")
+    part = Partitioner.for_config(cfg, mesh)
+    dp, tp, slc = part.dp, part.tp, part.slice_
     use_vma = compression_params is None and not zero_1
-    pspecs = vit_param_specs(cfg, tp)
+    pspecs = part.param_specs(cfg)
     params = vit_init(jax.random.PRNGKey(0), cfg)
     state_axes, tx_kw, zero_numel = _dist_state_setup(
-        mesh, params, pspecs, dp, zero_1)
+        mesh, params, pspecs, dp, zero_1, slc=slc)
     params, opt_state, ospecs = _shard_params_state(
         mesh,
         _make_tx(mesh, base_tx, compression_params, partition_bytes, dp,
-                 **tx_kw),
+                 dcn=slc, **tx_kw),
         params, pspecs, dp, state_axes=state_axes, zero_numel=zero_numel,
+        slc=slc,
     )
-    batch_spec = P(dp)
-    resym = _make_resymmetrize(pspecs, dp)
+    batch_spec = part.batch_spec()
+    mean_axes = tuple(a for a in (slc, dp) if a is not None)
+    resym = _make_resymmetrize(pspecs, dp, slc)
     loss_fn = functools.partial(
         vit_loss, cfg=cfg, dp_axis=None, tp_axis=tp, remat=remat,
     )
 
     def build_jit(pb):
-        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, **tx_kw)
+        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, dcn=slc,
+                      **tx_kw)
         vag = _accumulating_value_and_grad(loss_fn, accum_steps)
 
         def per_device_step(params, opt_state, images, labels):
-            grad_params = _pcast_dp(params, dp, mesh, use_vma)
+            grad_params = _pcast_dp(params, dp, mesh, use_vma, slc)
             loss, grads = vag(grad_params, images, labels)
             if use_vma:
                 grads = resym(grads)
@@ -1208,8 +1261,8 @@ def make_vit_train_step(
                 grads = _novma_collective_fix(grads, pspecs, mesh, (tp,))
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            if dp is not None:
-                loss = jax.lax.pmean(loss, dp)
+            if mean_axes:
+                loss = jax.lax.pmean(loss, mean_axes)
             return _collapse_vma(loss), params, opt_state
 
         sharded = jax.shard_map(
@@ -1222,7 +1275,8 @@ def make_vit_train_step(
         return jax.jit(sharded, donate_argnums=(0, 1))
 
     return (
-        _finalize_step(build_jit, partition_bytes, dp, tunable=not zero_1),
+        _finalize_step(build_jit, partition_bytes, dp or slc,
+                       tunable=not zero_1),
         params, opt_state, NamedSharding(mesh, batch_spec),
     )
 
@@ -1240,34 +1294,40 @@ def make_resnet_train_step(
     (BASELINE config 2 shape); BN stats are dp-synced (SyncBN) so the
     replicated bn_state stays identical everywhere.
     """
-    dp = _axis(mesh, "dp")
+    part = Partitioner.for_config(cfg, mesh)
+    dp, slc = part.dp, part.slice_
     use_vma = compression_params is None and not zero_1
     params, bn_state = resnet_init(jax.random.PRNGKey(0), cfg)
-    pspecs = resnet_param_specs(cfg, params)
+    pspecs = part.param_specs(cfg, params)
     state_axes, tx_kw, zero_numel = _dist_state_setup(
-        mesh, params, pspecs, dp, zero_1)
+        mesh, params, pspecs, dp, zero_1, slc=slc)
     params, opt_state, ospecs = _shard_params_state(
         mesh,
         _make_tx(mesh, base_tx, compression_params, partition_bytes, dp,
-                 **tx_kw),
+                 dcn=slc, **tx_kw),
         params, pspecs, dp, state_axes=state_axes, zero_numel=zero_numel,
+        slc=slc,
     )
     sspecs = jax.tree.map(lambda _: P(), bn_state)
     bn_state = jax.device_put(
         bn_state, jax.tree.map(lambda s: NamedSharding(mesh, s), sspecs)
     )
-    batch_spec = P(dp)
-    resym = _make_resymmetrize(pspecs, dp)
+    batch_spec = part.batch_spec()
+    mean_axes = tuple(a for a in (slc, dp) if a is not None)
+    # SyncBN statistics sync over every data axis (slice_ and dp)
+    bn_axes = mean_axes if mean_axes else None
+    resym = _make_resymmetrize(pspecs, dp, slc)
 
     def loss_fn(params, bn_state, images, labels):
         return resnet_loss(params, bn_state, images, labels, cfg,
-                           dp_axis=dp, train=True)
+                           dp_axis=bn_axes, train=True)
 
     def build_jit(pb):
-        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, **tx_kw)
+        tx = _make_tx(mesh, base_tx, compression_params, pb, dp, dcn=slc,
+                      **tx_kw)
 
         def per_device_step(params, opt_state, bn_state, images, labels):
-            grad_params = _pcast_dp(params, dp, mesh, use_vma)
+            grad_params = _pcast_dp(params, dp, mesh, use_vma, slc)
             (loss, new_bn), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 grad_params, bn_state, images, labels
             )
@@ -1278,8 +1338,8 @@ def make_resnet_train_step(
                 new_bn = jax.tree.map(_collapse_vma, new_bn)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            if dp is not None:
-                loss = jax.lax.pmean(loss, dp)
+            if mean_axes:
+                loss = jax.lax.pmean(loss, mean_axes)
             return loss, params, opt_state, new_bn
 
         sharded = jax.shard_map(
@@ -1292,7 +1352,8 @@ def make_resnet_train_step(
         return jax.jit(sharded, donate_argnums=(0, 1, 2))
 
     return (
-        _finalize_step(build_jit, partition_bytes, dp, tunable=not zero_1),
+        _finalize_step(build_jit, partition_bytes, dp or slc,
+                       tunable=not zero_1),
         params, opt_state, bn_state, NamedSharding(mesh, batch_spec),
     )
 
@@ -1325,15 +1386,18 @@ def make_eval_step(cfg: GPTConfig, mesh: Mesh, seq_layout: str = "contiguous",
     factories; no optimizer, no grads, safe to call on training params at
     any step.
     """
-    dp, tp, sp = _axis(mesh, "dp"), _axis(mesh, "tp"), _axis(mesh, "sp")
+    part = Partitioner.for_config(cfg, mesh)
+    dp, tp, sp, slc = part.dp, part.tp, part.sp, part.slice_
     _check_seq_layout(seq_layout, sp)
-    batch_spec = P(dp, sp)
-    pspecs = gpt_param_specs(cfg, tp)
+    batch_spec = part.batch_spec()
+    pspecs = part.param_specs(cfg)
 
     def per_device(params, tokens, targets):
         loss = gpt_loss(params, tokens, targets, cfg, dp_axis=dp,
                         tp_axis=tp, sp_axis=sp, seq_layout=seq_layout,
                         chunked_ce=chunked_ce)
+        if slc is not None:
+            loss = jax.lax.pmean(loss, slc)
         return _collapse_vma(loss)
 
     sharded = jax.shard_map(

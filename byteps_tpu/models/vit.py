@@ -30,7 +30,6 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
 
 from byteps_tpu.models.gpt import (
     _layernorm,
@@ -104,14 +103,21 @@ def vit_init(rng: jnp.ndarray, cfg: ViTConfig) -> Dict[str, Any]:
     }
 
 
-def vit_param_specs(cfg: ViTConfig, tp_axis: Optional[str]) -> Dict[str, Any]:
+def vit_logical_specs(cfg: ViTConfig) -> Dict[str, Any]:
+    from byteps_tpu.models.gpt import block_logical_specs
     return {
-        "w_patch": P(), "b_patch": P(),
-        "wpe": P(),
-        "blocks": [block_specs(tp_axis) for _ in range(cfg.n_layers)],
-        "ln_f_g": P(), "ln_f_b": P(),
-        "w_head": P(), "b_head": P(),
+        "w_patch": (None, "embed"), "b_patch": ("embed",),
+        "wpe": (None, "embed"),
+        "blocks": [block_logical_specs() for _ in range(cfg.n_layers)],
+        "ln_f_g": ("embed",), "ln_f_b": ("embed",),
+        "w_head": ("embed", None), "b_head": (None,),
     }
+
+
+def vit_param_specs(cfg: ViTConfig, tp_axis: Optional[str]) -> Dict[str, Any]:
+    from byteps_tpu.parallel.partitioner import resolve_specs, rules_from_axes
+    return resolve_specs(vit_logical_specs(cfg),
+                         rules_from_axes(tp_axis=tp_axis))
 
 
 def patchify(images: jnp.ndarray, patch: int) -> jnp.ndarray:

@@ -16,6 +16,12 @@ from byteps_tpu.common.jax_compat import ensure as _ensure_jax_compat
 _ensure_jax_compat()
 
 from byteps_tpu.parallel.mesh import MeshAxes, make_mesh, factor_devices
+from byteps_tpu.parallel.partitioner import (FAMILY_RULES, LOGICAL_AXES,
+                                             Partitioner, resolve_spec,
+                                             resolve_specs, rules_from_axes,
+                                             stacked_logical_specs)
+from byteps_tpu.parallel.zero3 import (make_gpt_zero3_train_step,
+                                       zero3_gather_params)
 from byteps_tpu.parallel.moe import (moe_ffn, moe_init, moe_specs,
                                      top1_dispatch, topk_dispatch)
 from byteps_tpu.parallel.pipeline import (
@@ -42,6 +48,15 @@ __all__ = [
     "MeshAxes",
     "make_mesh",
     "factor_devices",
+    "Partitioner",
+    "LOGICAL_AXES",
+    "FAMILY_RULES",
+    "resolve_spec",
+    "resolve_specs",
+    "rules_from_axes",
+    "stacked_logical_specs",
+    "make_gpt_zero3_train_step",
+    "zero3_gather_params",
     "moe_ffn",
     "moe_init",
     "moe_specs",

@@ -197,18 +197,23 @@ def moe_init(rng, d: int, ff: int, n_experts: int, std: float = 0.02,
     return p
 
 
+def moe_logical_specs(mlp: str = "gelu"):
+    """Logical-axis dict for :func:`moe_init` output: experts over the
+    expert axis, each expert's ff dim Megatron col/row over mlp."""
+    return {
+        "wg": (None, None),
+        "w1": ("expert", "embed", "mlp"), "b1": ("expert", "mlp"),
+        "w2": ("expert", "mlp", "embed"), "b2": ("expert",),
+        **({"w3": ("expert", "embed", "mlp"), "b3": ("expert", "mlp")}
+           if mlp == "swiglu" else {}),
+    }
+
+
 def moe_specs(ep_axis: Optional[str], tp_axis: Optional[str] = None,
               mlp: str = "gelu"):
     """PartitionSpec dict for :func:`moe_init` output: experts over ep,
     and (optionally) Megatron col/row sharding of each expert's ff dim
     over tp."""
-    from jax.sharding import PartitionSpec as P
-
-    e, t = ep_axis, tp_axis
-    return {
-        "wg": P(),
-        "w1": P(e, None, t), "b1": P(e, t),
-        "w2": P(e, t, None), "b2": P(e),
-        **({"w3": P(e, None, t), "b3": P(e, t)} if mlp == "swiglu"
-           else {}),
-    }
+    from byteps_tpu.parallel.partitioner import resolve_specs, rules_from_axes
+    return resolve_specs(moe_logical_specs(mlp),
+                         rules_from_axes(tp_axis=tp_axis, ep_axis=ep_axis))

@@ -1,0 +1,234 @@
+"""ZeRO-3 FSDP for the GPT family: params live as flat f32 segments
+sharded over the slice_/dp axis, all-gathered just-in-time per layer.
+
+The memory story (DeepSpeed ZeRO stage 3, transposed to shard_map):
+
+- **Persistent state** — the master weights AND the optimizer moments —
+  is a handful of flat f32 buffers, each sharded 1/n over the shard
+  axis. Per-chip param+opt memory drops ~n×.
+- **Transient state** — the unsharded weights a layer needs to compute —
+  exists only inside that layer's application: ``all_gather(tiled)``
+  materializes one block's params, the block runs, and (under
+  ``remat=True``) the gathered tree is dropped and re-gathered in the
+  backward pass, so at most one block's full params are live at a time.
+- **Gradients arrive pre-sharded.** The transpose of a tiled
+  ``all_gather`` over the shard axis is ``psum_scatter``: AD itself
+  reduce-scatters the gradient, every device receiving exactly the
+  summed slice matching its param segment. No explicit gradient
+  collective over the shard axis exists in this file — it falls out of
+  differentiating the gather.
+- **The update is elementwise on segments.** ``base_tx`` (adam, sgd,
+  ...) applies to the flat f32 segs directly; params are never gathered
+  for the update. This requires an elementwise transform — the same
+  contract as ZeRO-1's segment update (see DistributedOptimizer).
+
+Axis choice: the shard axis is ``slice_`` when the mesh has one (the
+ISSUE's multi-slice FSDP: params sharded ACROSS slices, the DCN tier
+carrying the gather/scatter), else ``dp``. Any remaining data axes
+(``dp`` under a slice_ shard) replicate the segs and contribute an
+explicit grad psum. Pure FSDP only: tp/sp/pp/ep meshes are rejected —
+those compose on the non-ZeRO-3 paths. Compression is likewise
+rejected: the gather/scatter here moves PARAMS, whose integrity the
+next forward depends on; compressed gradient exchange composes on the
+hybrid hierarchical path (``zero_3=False`` with a slice_ mesh) instead.
+
+Padding: each group's flat concat is zero-padded to ``n*seg``. Pad
+elements never reach the loss (the gather truncates before unflatten),
+so their grads are identically zero and adam on them is a no-op
+(m=v=0 → update 0) — the pad region stays zero forever.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from byteps_tpu.jax.optimizer import _flatten_concat, _unconcat_unflatten
+from byteps_tpu.parallel.partitioner import Partitioner
+from byteps_tpu.parallel.remat import maybe_remat
+from byteps_tpu.parallel.sharding import opt_state_specs
+
+if False:  # pragma: no cover - typing only; models imports at call time
+    from byteps_tpu.models.gpt import GPTConfig  # noqa: F401
+# (models.gpt imports byteps_tpu.parallel submodules at module load, so
+# this package-level module must import models.* lazily inside the
+# functions below — a top-level import is circular.)
+
+
+def _seg_of(total: int, n: int) -> int:
+    return -(-total // n)
+
+
+def _group_meta(params: Dict[str, Any], n_shard: int):
+    """Per-group (templates, sizes, total, padded) for the two group
+    kinds: ``rest`` (every non-block leaf: embeddings, final norm,
+    untied head) and one group per transformer block. Templates are
+    ShapeDtypeStructs — `_unconcat_unflatten` only reads shape/dtype."""
+
+    def meta(tree):
+        leaves = jax.tree.leaves(tree)
+        sizes = [int(l.size) for l in leaves]
+        total = sum(sizes)
+        templates = jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), tree)
+        return templates, sizes, total, n_shard * _seg_of(total, n_shard)
+
+    rest = {k: v for k, v in params.items() if k != "blocks"}
+    return meta(rest), meta(params["blocks"][0])
+
+
+def _to_segs(params: Dict[str, Any], n_shard: int) -> Dict[str, Any]:
+    """Full param tree → {"rest": (padded,), "blocks": [(padded,), ...]}
+    flat f32 global arrays, each zero-padded to a multiple of n_shard."""
+
+    def flat_pad(tree, padded):
+        flat, _ = _flatten_concat(tree)
+        return jnp.pad(flat, (0, padded - flat.shape[0]))
+
+    (_, _, _, rest_pad), (_, _, _, blk_pad) = _group_meta(params, n_shard)
+    return {
+        "rest": flat_pad({k: v for k, v in params.items()
+                          if k != "blocks"}, rest_pad),
+        "blocks": [flat_pad(b, blk_pad) for b in params["blocks"]],
+    }
+
+
+def zero3_gather_params(segs: Dict[str, Any], cfg: GPTConfig,
+                        ) -> Dict[str, Any]:
+    """Materialize the standard :func:`gpt_init` tree from the segment
+    dict (host-side: checkpointing, export, eval on other meshes)."""
+    from byteps_tpu.models.gpt import gpt_init
+
+    shapes = jax.eval_shape(lambda: gpt_init(jax.random.PRNGKey(0), cfg))
+    (r_tpl, r_sizes, r_total, _), (b_tpl, b_sizes, b_total, _) = \
+        _group_meta(shapes, 1)
+    out = _unconcat_unflatten(
+        jnp.asarray(segs["rest"])[:r_total], r_tpl, r_sizes)
+    out["blocks"] = [
+        _unconcat_unflatten(jnp.asarray(s)[:b_total], b_tpl, b_sizes)
+        for s in segs["blocks"]
+    ]
+    return out
+
+
+def make_gpt_zero3_train_step(
+    cfg: GPTConfig,
+    mesh: Mesh,
+    base_tx: optax.GradientTransformation,
+    compression_params: Optional[Dict[str, Any]] = None,
+    partition_bytes: Optional[int] = None,  # noqa: ARG001 - API symmetry
+    remat: bool = False,
+    seq_layout: str = "contiguous",
+    init_params: Optional[Dict[str, Any]] = None,
+    chunked_ce=True,
+):
+    """Returns ``(step, segs, opt_state, batch_sharding)`` —
+    the ``zero_3=True`` backend of
+    :func:`byteps_tpu.models.train.make_gpt_train_step`.
+
+    ``step(segs, opt_state, tokens, targets) -> (loss, segs, opt_state)``;
+    ``segs`` is the flat segment dict (``zero3_gather_params`` rebuilds
+    the gpt tree). Matches the replicated trajectory to f32 roundoff:
+    the only reassociation is the psum_scatter's cross-shard grad sum.
+    """
+    from byteps_tpu.models.gpt import (
+        _embed, _readout_nll, resolve_norm, resolve_rope,
+        transformer_block)
+
+    part = Partitioner.for_config(cfg, mesh)
+    dp, slc = part.dp, part.slice_
+    banned = [n for n in (part.tp, part.sp, part.pp, part.ep)
+              if n is not None]
+    if banned:
+        raise ValueError(
+            f"zero_3 is pure FSDP — mesh axes {banned} are not supported "
+            "(tp/sp/pp/ep compose on the zero_3=False paths)")
+    if compression_params is not None:
+        raise ValueError(
+            "compression_params does not compose with zero_3 (the DCN "
+            "collectives here move params, not grads) — use the hybrid "
+            "compressed-gradient path (zero_3=False on a slice_ mesh)")
+    zaxis = slc if slc is not None else dp
+    if zaxis is None:
+        raise ValueError("zero_3 needs a slice_ or dp mesh axis to shard "
+                         "params over")
+    n_shard = mesh.shape[zaxis]
+    data_axes = tuple(a for a in (slc, dp) if a is not None)
+    other_axes = tuple(a for a in data_axes if a != zaxis)
+    n_workers = 1
+    for a in data_axes:
+        n_workers *= mesh.shape[a]
+
+    from byteps_tpu.models.train import _resolve_init_params
+
+    params = _resolve_init_params(init_params, cfg, part.param_specs(cfg))
+    (r_tpl, r_sizes, r_total, _), (b_tpl, b_sizes, b_total, _) = \
+        _group_meta(params, n_shard)
+    seg_spec = P(zaxis)
+    segs = jax.device_put(
+        _to_segs(params, n_shard),
+        NamedSharding(mesh, seg_spec))
+    del params  # the segs are the master copy now
+    seg_specs = jax.tree.map(lambda _: seg_spec, segs)
+    opt_state = base_tx.init(segs)
+    ospecs = opt_state_specs(opt_state, segs, seg_specs)
+    opt_state = jax.device_put(
+        opt_state, jax.tree.map(lambda s: NamedSharding(mesh, s), ospecs,
+                                is_leaf=lambda x: isinstance(x, P)))
+    batch_spec = part.batch_spec()
+
+    rope_base = resolve_rope(cfg)
+    norm_fn, norm_eps = resolve_norm(cfg)
+
+    def gather(seg, templates, sizes, total):
+        flat = jax.lax.all_gather(seg, zaxis, tiled=True)
+        return _unconcat_unflatten(flat[:total], templates, sizes)
+
+    def loss_from_segs(segs, tokens, targets):
+        rest = gather(segs["rest"], r_tpl, r_sizes, r_total)
+        x = _embed(rest, tokens, cfg, None, seq_layout)
+
+        def apply_block(x, seg):
+            # the just-in-time gather lives INSIDE the (remat'd) block:
+            # backward re-gathers instead of keeping n_layers trees live
+            p = gather(seg, b_tpl, b_sizes, b_total)
+            return transformer_block(
+                x, p, cfg.head_dim, None, None, causal=True,
+                seq_layout=seq_layout, rope_base=rope_base,
+                norm_fn=norm_fn, norm_eps=norm_eps, use_bias=cfg.use_bias)
+
+        apply_block = maybe_remat(apply_block, remat)
+        for seg in segs["blocks"]:
+            x = apply_block(x, seg)
+        nll = _readout_nll(rest, x, targets, norm_fn, norm_eps,
+                           tp_axis=None, chunked=chunked_ce)
+        return nll.mean()
+
+    def per_device_step(segs, opt_state, tokens, targets):
+        # grad of the LOCAL mean loss; the shard-axis sum arrives free
+        # as the all_gather transpose (psum_scatter over zaxis), the
+        # remaining data axes need the explicit psum, and /n_workers
+        # turns the global sum into the global mean
+        loss, grads = jax.value_and_grad(loss_from_segs)(
+            segs, tokens, targets)
+        if other_axes:
+            grads = jax.tree.map(
+                lambda g: jax.lax.psum(g, other_axes), grads)
+        grads = jax.tree.map(lambda g: g / n_workers, grads)
+        updates, opt_state = base_tx.update(grads, opt_state, segs)
+        segs = optax.apply_updates(segs, updates)
+        loss = jax.lax.pmean(loss, data_axes)
+        return loss, segs, opt_state
+
+    sharded = jax.shard_map(
+        per_device_step,
+        mesh=mesh,
+        in_specs=(seg_specs, ospecs, batch_spec, batch_spec),
+        out_specs=(P(), seg_specs, ospecs),
+        check_vma=False,
+    )
+    step = jax.jit(sharded, donate_argnums=(0, 1))
+    return step, segs, opt_state, NamedSharding(mesh, batch_spec)

@@ -1,0 +1,181 @@
+"""Partitioner / mesh-factory unit tests: factor_devices divisors, the
+single-device degenerate mesh, logical-axis spec resolution, and
+opt_state_specs against wrapped optax transforms."""
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from byteps_tpu.models.gpt import GPTConfig
+from byteps_tpu.models.moe_gpt import MoEGPTConfig
+from byteps_tpu.parallel import (
+    MeshAxes,
+    Partitioner,
+    factor_devices,
+    make_mesh,
+)
+from byteps_tpu.parallel.sharding import opt_state_specs
+
+
+# --- factor_devices ---------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "n,kw,expect",
+    [
+        # slices carved first, then ep/tp/sp innermost-first, dp absorbs
+        (8, dict(n_slices=2), dict(slice_=2, tp=2, sp=2, dp=1)),
+        (8, dict(n_slices=4), dict(slice_=4, tp=2, sp=1, dp=1)),
+        (8, dict(n_slices=8), dict(slice_=8, tp=1, sp=1, dp=1)),
+        # awkward divisor: 3 devices per slice — want_tp=2 / want_sp=2
+        # don't divide, so both fall back to 1 and dp takes the 3
+        (6, dict(n_slices=2), dict(slice_=2, tp=1, sp=1, dp=3)),
+        (12, dict(n_slices=3), dict(slice_=3, tp=2, sp=2, dp=1)),
+        # pp / ep requests honoured only when they divide what's left
+        (8, dict(want_pp=2, want_tp=1, want_sp=1),
+         dict(pp=2, dp=4, tp=1, sp=1)),
+        (16, dict(want_ep=2), dict(ep=2, tp=2, sp=2, dp=2)),
+        (8, dict(n_slices=2, want_ep=4, want_tp=1, want_sp=1),
+         dict(slice_=2, ep=4, dp=1)),
+        # a requested factor larger than the remainder falls back to 1
+        (4, dict(want_tp=8), dict(tp=1, sp=2, dp=2)),
+    ],
+)
+def test_factor_devices(n, kw, expect):
+    axes = factor_devices(n, **kw)
+    assert axes.total == n
+    for name, size in expect.items():
+        assert getattr(axes, name) == size, (name, axes)
+
+
+@pytest.mark.parametrize("n,n_slices", [(8, 3), (8, 5), (6, 4), (8, 0)])
+def test_factor_devices_ragged_slices_raise(n, n_slices):
+    with pytest.raises(ValueError):
+        factor_devices(n, n_slices=n_slices)
+
+
+# --- make_mesh --------------------------------------------------------------
+
+def test_make_mesh_single_device_exposes_all_axes():
+    """Regression: the 1-device degenerate mesh must still answer axis
+    lookups (mesh.shape["tp"], axis_names membership) like a real one."""
+    mesh = make_mesh(MeshAxes(), devices=jax.devices()[:1])
+    assert set(mesh.axis_names) == {"slice_", "pp", "dp", "sp", "tp", "ep"}
+    for name in mesh.axis_names:
+        assert mesh.shape[name] == 1
+    # and it is usable: a Partitioner on it answers every accessor (the
+    # axes exist, at size 1 — collectives over them are identities)
+    part = Partitioner(mesh)
+    assert part.dp == "dp" and part.tp == "tp" and part.slice_ == "slice_"
+    assert part.batch_spec() is not None
+
+
+def test_make_mesh_axis_order_and_sizes():
+    mesh = make_mesh(MeshAxes(dp=2, slice_=2, tp=2),
+                     devices=jax.devices()[:8])
+    assert mesh.axis_names == ("slice_", "dp", "tp")  # outermost first
+    assert mesh.shape["slice_"] == 2 and mesh.shape["tp"] == 2
+
+
+def test_make_mesh_device_count_mismatch_raises():
+    with pytest.raises(ValueError):
+        make_mesh(MeshAxes(dp=4), devices=jax.devices()[:2])
+
+
+# --- Partitioner spec resolution -------------------------------------------
+
+def test_partitioner_gpt_param_specs_follow_mesh_axes():
+    cfg = GPTConfig.tiny()
+    mesh = make_mesh(MeshAxes(dp=2, tp=2, sp=2), devices=jax.devices()[:8])
+    part = Partitioner.for_config(cfg, mesh)
+    specs = part.param_specs(cfg)
+    # heads/mlp families shard over tp; vocab/embed stay replicated
+    assert specs["wte"] == P()
+    assert specs["blocks"][0]["wq"] == P(None, "tp")
+    assert specs["blocks"][0]["wo"] == P("tp", None)
+    # batch rides (slice_, dp) — no slice_ here, so dp alone
+    assert part.batch_spec()[0] == "dp"
+
+
+def test_partitioner_batch_spec_multislice():
+    mesh = make_mesh(MeshAxes(dp=4, slice_=2), devices=jax.devices()[:8])
+    part = Partitioner.for_config(GPTConfig.tiny(), mesh)
+    assert part.batch_spec()[0] == ("slice_", "dp")
+    assert part.slice_ == "slice_" and part.dp == "dp"
+
+
+def test_partitioner_moe_batch_includes_ep():
+    mesh = make_mesh(MeshAxes(dp=2, ep=2), devices=jax.devices()[:4])
+    part = Partitioner.for_config(
+        MoEGPTConfig(n_experts=2), mesh)
+    assert part.batch_spec()[0] == ("dp", "ep")
+
+
+# --- opt_state_specs vs wrapped optax transforms ----------------------------
+
+_PARAMS = {"a": jnp.zeros((4, 2)), "b": jnp.zeros((3,))}
+_PSPECS = {"a": P("dp", None), "b": P()}
+
+
+def _mesh_dp():
+    return make_mesh(MeshAxes(dp=4), devices=jax.devices()[:4])
+
+
+def _adam_leaf_specs(specs):
+    """Extract every ScaleByAdamState(mu=..., nu=...) in a spec tree."""
+    found = []
+
+    def walk(node):
+        if isinstance(node, optax.ScaleByAdamState):
+            found.append(node)
+        elif hasattr(node, "_fields"):
+            for f in node._fields:
+                walk(getattr(node, f))
+        elif isinstance(node, (list, tuple)):
+            for c in node:
+                walk(c)
+        elif isinstance(node, dict):
+            for c in node.values():
+                walk(c)
+
+    walk(specs)
+    return found
+
+
+@pytest.mark.parametrize("mk", [
+    lambda: optax.chain(optax.clip_by_global_norm(1.0), optax.adam(1e-3)),
+    lambda: optax.inject_hyperparams(optax.adam)(learning_rate=1e-3),
+], ids=["chain", "inject_hyperparams"])
+def test_opt_state_specs_param_shaped_subtrees(mk):
+    tx = mk()
+    state = tx.init(_PARAMS)
+    specs = opt_state_specs(state, _PARAMS, _PSPECS)
+    adams = _adam_leaf_specs(specs)
+    assert adams, "adam state not found in spec tree"
+    for st in adams:
+        assert st.mu == _PSPECS and st.nu == _PSPECS
+        assert st.count == P()
+    # the real contract: the spec tree device_puts the state
+    mesh = _mesh_dp()
+    placed = jax.device_put(
+        state, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                            is_leaf=lambda x: isinstance(x, P)))
+    assert jax.tree.structure(placed) == jax.tree.structure(state)
+
+
+def test_opt_state_specs_multi_transform_replicates_masked():
+    """multi_transform's masked inner trees do NOT match the params
+    structure (MaskedNode holes), so they take the safe replicated
+    fallback — and the spec tree still device_puts cleanly."""
+    tx = optax.multi_transform(
+        {"x": optax.adam(1e-3), "y": optax.sgd(1e-2)}, {"a": "x", "b": "y"})
+    state = tx.init(_PARAMS)
+    specs = opt_state_specs(state, _PARAMS, _PSPECS)
+    for st in _adam_leaf_specs(specs):
+        assert st.mu["a"] == P()  # replicated fallback, not P("dp", ...)
+    mesh = _mesh_dp()
+    placed = jax.device_put(
+        state, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                            is_leaf=lambda x: isinstance(x, P)))
+    assert jax.tree.structure(placed) == jax.tree.structure(state)
