@@ -2553,6 +2553,12 @@ def bench_chaos(payload_mb: int = 8, rounds: int = 4, reps: int = 3) -> dict:
       membership lease); the survivor completes every round — one round
       stalls ~one lease until the eviction re-targets it, the rest run
       at surviving-membership speed. Graceful degradation, not a cliff.
+    * ``proc_death`` (vs its own ``proc_clean1w`` baseline): the same
+      story across a REAL process boundary — the launcher Supervisor
+      SIGKILLs 1 of 2 ``--child-worker`` OS processes mid-run; the
+      survivor completes every round, the epoch reads exactly one lease
+      eviction while it is still running, and its post-eviction sums
+      are bit-identical to a clean survivor-only run.
 
     Per-config medians of ``reps`` timed blocks (each ``rounds``
     push_pulls of a ``payload_mb`` MB gradient) with [min, max] spreads,
@@ -2778,6 +2784,169 @@ def bench_chaos(payload_mb: int = 8, rounds: int = 4, reps: int = 3) -> dict:
                 clean = results["clean2w"][cname]["sec_per_round_med"]
                 r["goodput_vs_clean"] = round(
                     clean / r["sec_per_round_med"], 3)
+
+    # ---- REAL process-death leg (ISSUE 20) -------------------------------
+    # worker_death above kills a THREAD and emulates the wire drop; this
+    # leg crosses the real boundary: two supervised --child-worker OS
+    # PROCESSES against the server with the lease armed, and the
+    # supervisor SIGKILLs one mid-run. The survivor must complete every
+    # round; its post-eviction sums are pinned BIT-identical to a clean
+    # 1-worker run of the same seeds (round r's payload is
+    # default_rng((seed, wid, r)) — recomputable outside the dead
+    # process), and the server epoch must read exactly ONE eviction
+    # while the survivor is still running (the survivor's own clean
+    # goodbye bumps it again later, so sampling after the run would
+    # conflate the two).
+    import json as _json
+    import shutil
+    import signal as _signal
+    import tempfile
+
+    from byteps_tpu.launcher import Supervisor
+    from byteps_tpu.server.native import load_lib
+
+    pd_rounds = max(10, 2 * rounds)
+    pd_elems = 4096            # membership mechanics, not bandwidth
+    pd_lease_ms = 800
+    pd_delay_ms = 120          # several rounds per lease: stall visible
+    pd_kill_at = pd_rounds // 3
+    pd_reps = 2
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
+
+    def _proc_leg(port, tmp, kill=False):
+        """One supervised run → (sec_per_round, {wid: final json},
+        victim_rounds_at_death, epoch_at_eviction, exit_reasons)."""
+        n_child = 2 if kill else 1
+        start_server(port=port, num_workers=n_child, engine_threads=4,
+                     async_mode=False, lease_ms=pd_lease_ms)
+        # the native epoch counter is process-global (it survives
+        # start/stop cycles), so earlier chaos legs leave a residue —
+        # eviction counting below is in DELTAS from this baseline
+        ep0 = int(load_lib().bps_server_epoch())
+        outs = {w: os.path.join(tmp, f"p{port}_w{w}.json")
+                for w in range(n_child)}
+        sup = Supervisor(base_env={
+            "PYTHONPATH": repo_dir, "JAX_PLATFORMS": "cpu",
+            "BYTEPS_CHILD_SERVERS": f"127.0.0.1:{port}",
+            "BYTEPS_CHILD_ROUNDS": str(pd_rounds),
+            "BYTEPS_CHILD_ELEMS": str(pd_elems),
+            "BYTEPS_CHILD_ROUND_DELAY_MS": str(pd_delay_ms),
+            # heartbeat well under lease_ms: a survivor blocked in pull
+            # on the victim's stalled round makes no other server
+            # contact, and without pings its OWN lease expires too
+            # (double eviction → epoch bumps twice)
+            "BYTEPS_HEALTH_INTERVAL_MS": "100",
+        })
+        k_dead = ep_evict = None
+        try:
+            t0 = time.perf_counter()
+            for w in range(n_child):
+                sup.spawn(w, extra_env={"BYTEPS_CHILD_OUT": outs[w]})
+            if kill:
+                prog = outs[1] + ".progress"
+                deadline = time.time() + 120
+                while time.time() < deadline:
+                    sup.poll()
+                    done = (open(prog).read().splitlines()
+                            if os.path.exists(prog) else [])
+                    if len(done) > pd_kill_at:
+                        break
+                    time.sleep(0.02)
+                else:
+                    raise RuntimeError("victim never reached the kill "
+                                       "round — proc_death leg is stuck")
+                sup.kill(1, _signal.SIGKILL)
+                deadline = time.time() + 60
+                while time.time() < deadline:
+                    sup.poll()
+                    ep = int(load_lib().bps_server_epoch()) - ep0
+                    if ep >= 1:
+                        ep_evict = ep
+                        break
+                    time.sleep(0.02)
+                assert ep_evict == 1, (
+                    f"expected exactly one lease eviction, epoch "
+                    f"bumped {ep_evict}x")
+                assert 0 in sup.live(), (
+                    "survivor finished before the eviction was observed")
+                k_dead = len(open(prog).read().splitlines())
+            survivor_t = None
+            deadline = time.time() + 300
+            while survivor_t is None and time.time() < deadline:
+                for ex in sup.poll():
+                    if ex["wid"] == 0:
+                        assert ex["reason"] == "clean", ex
+                        survivor_t = time.perf_counter() - t0
+                time.sleep(0.02)
+            assert survivor_t is not None, "survivor never completed"
+            assert sup.wait_all(timeout_s=60)
+            reasons = dict(sup.exit_reasons)
+        finally:
+            sup.shutdown()
+            stop_server()
+            config_mod.reset_config()
+        data = {w: _json.load(open(outs[w]))
+                for w in range(n_child) if os.path.exists(outs[w])}
+        return survivor_t / pd_rounds, data, k_dead, ep_evict, reasons
+
+    tmpd = tempfile.mkdtemp(prefix="bps_proc_death_")
+    pd_detail = None
+    clean_t, death_t = [], []
+    try:
+        for _rep in range(pd_reps):
+            p_clean = base_port + run_id * 2
+            run_id += 1
+            t_per, data, _, _, _ = _proc_leg(p_clean, tmpd, kill=False)
+            clean_t.append(t_per)
+            clean_crcs = {r: crc for r, _v, crc in data[0]["rounds"]}
+            assert len(clean_crcs) == pd_rounds
+            p_death = base_port + run_id * 2
+            run_id += 1
+            t_per, data, k_dead, ep, reasons = _proc_leg(
+                p_death, tmpd, kill=True)
+            death_t.append(t_per)
+            assert reasons[1] == ["signal:SIGKILL"], reasons
+            surv_crcs = {r: crc for r, _v, crc in data[0]["rounds"]}
+            assert len(surv_crcs) == pd_rounds, (
+                "survivor did not complete every round")
+            # rounds the victim could have contributed to end at
+            # k_dead + 1 (it dies at most one unpulled round ahead);
+            # everything after MUST be the survivor-only sum, bit for bit
+            post = range(k_dead + 2, pd_rounds)
+            assert post, "no post-eviction rounds to compare"
+            for r in post:
+                assert surv_crcs[r] == clean_crcs[r], (
+                    f"round {r} diverged from the clean survivor-only "
+                    "run after the eviction")
+            pd_detail = {
+                "kill_round": k_dead,
+                "epoch_at_eviction": ep,
+                "post_eviction_rounds_compared": len(post),
+                "exit_reasons": {str(k): v for k, v in reasons.items()},
+            }
+    finally:
+        shutil.rmtree(tmpd, ignore_errors=True)
+    for leg, ts in (("proc_clean1w", clean_t), ("proc_death", death_t)):
+        srt = sorted(ts)
+        results[leg] = {
+            "sec_per_round_med": round(float(np.median(ts)), 4),
+            "sec_spread": [round(srt[0], 4), round(srt[-1], 4)],
+            "rounds": pd_rounds,
+            "payload_kb": pd_elems * 4 // 1024,
+            "round_delay_ms": pd_delay_ms,
+            "reps": pd_reps,
+        }
+    results["proc_death"].update(pd_detail)
+    results["proc_death"]["lease_ms"] = pd_lease_ms
+    proc_death_goodput = round(
+        results["proc_clean1w"]["sec_per_round_med"]
+        / results["proc_death"]["sec_per_round_med"], 3)
+    results["proc_death"]["goodput_vs_clean"] = proc_death_goodput
+    _log(f"chaos   proc_death: "
+         f"{results['proc_death']['sec_per_round_med']*1e3:7.1f} ms/round "
+         f"vs clean {results['proc_clean1w']['sec_per_round_med']*1e3:.1f}"
+         f", goodput {proc_death_goodput:.3f}, kill@{pd_detail['kill_round']}"
+         f", epoch_at_eviction={pd_detail['epoch_at_eviction']}")
 
     # ---- bounded-staleness slow-worker leg (ROADMAP item 3) --------------
     # One deterministic straggler (worker1:slow — every wire attempt of
@@ -3145,7 +3314,11 @@ def bench_chaos(payload_mb: int = 8, rounds: int = 4, reps: int = 3) -> dict:
                    "straggler at {0,2,5}x the median step x "
                    "BYTEPS_STALENESS K in {0,1,4} — and the scale-up "
                    "churn leg: a 2→4→3→5 mid-stream join/leave schedule "
-                   "via the fault grammar's worker<N>:join/kill rules)"),
+                   "via the fault grammar's worker<N>:join/kill rules — "
+                   "and the REAL process-death leg: the supervisor "
+                   "SIGKILLs 1 of 2 child worker processes mid-run, the "
+                   "survivor completes with post-eviction sums "
+                   "bit-identical to a clean survivor-only run)"),
         "value": worst,
         "unit": "x of clean goodput (worst chaos config)",
         "vs_baseline": worst,
@@ -3158,6 +3331,11 @@ def bench_chaos(payload_mb: int = 8, rounds: int = 4, reps: int = 3) -> dict:
         # phases of goodput_phase / (live × per-worker clean goodput));
         # acceptance bar >= 0.7, floor-gated via BENCH_trend.json
         "churn_goodput_tracking": round(churn_tracking, 3),
+        # REAL process death: survivor per-round time vs a clean
+        # 1-worker run after the supervisor SIGKILLs its sibling child
+        # process (the stall is ~one lease amortized over the run);
+        # floor-gated via BENCH_trend.json
+        "proc_death_goodput": proc_death_goodput,
         "payload_mb": payload_mb,
         "rounds_per_rep": rounds,
         "reps": reps,
@@ -3375,6 +3553,10 @@ _TREND_SPECS = (
     ("BENCH_chaos.json", "value"),
     ("BENCH_chaos.json", "straggler_ratio"),
     ("BENCH_chaos.json", "churn_goodput_tracking"),
+    # real process death (launcher supervisor SIGKILLs 1 of 2 child
+    # worker processes; survivor completes, post-eviction sums
+    # bit-identical to a clean survivor-only run) — docs/robustness.md
+    ("BENCH_chaos.json", "proc_death_goodput"),
     ("BENCH_serve.json", "value"),
     ("BENCH_serve.json", "prefix_ttft_p50_speedup"),
     # disaggregated prefill/decode: short-class p99 TTFT at saturation,

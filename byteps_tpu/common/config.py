@@ -219,6 +219,26 @@ class Config:
     # Unit-count bounds the policy will never cross.
     autoscale_min: int = 1
     autoscale_max: int = 16
+    # --- launcher supervisor (byteps_tpu/launcher.py Supervisor) -----------
+    # Max automatic respawns per flapping child before the supervisor
+    # gives up on it (ISSUE 20 bounded restart-with-backoff).
+    supervisor_restart_limit: int = 3
+    # Base respawn delay; doubles per consecutive restart of one child.
+    supervisor_backoff_ms: int = 200
+    # SIGTERM→SIGKILL escalation grace on retire/shutdown.
+    supervisor_grace_ms: int = 2000
+    # Supervisor poll cadence (child reap + proc-fault plan tick).
+    supervisor_poll_ms: int = 50
+    # --- socket NIC (common/socknic.py) ------------------------------------
+    # Per-request recv deadline on SocketNicClient (real wire-death
+    # classification: past this the request raises TimeoutError).
+    socket_timeout_ms: int = 10000
+    # Token-bucket shaping for socket NIC payloads (0 = unshaped). The
+    # PR 1 DcnPacer, now pacing a real link.
+    socket_mbps: float = 0.0
+    # Listen-path port probes through server.any_port (the PR 4
+    # ephemeral-port-squatter sidestep).
+    socket_port_attempts: int = 16
 
     # --- telemetry plane (docs/observability.md) ---------------------------
     # Always-on metrics registry (common/metrics.py): counters, gauges,
@@ -399,6 +419,17 @@ class Config:
             autoscale_sustain=_env_int("BYTEPS_AUTOSCALE_SUSTAIN", 2),
             autoscale_min=_env_int("BYTEPS_AUTOSCALE_MIN", 1),
             autoscale_max=_env_int("BYTEPS_AUTOSCALE_MAX", 16),
+            supervisor_restart_limit=_env_int(
+                "BYTEPS_SUPERVISOR_RESTART_LIMIT", 3),
+            supervisor_backoff_ms=_env_int(
+                "BYTEPS_SUPERVISOR_BACKOFF_MS", 200),
+            supervisor_grace_ms=_env_int(
+                "BYTEPS_SUPERVISOR_GRACE_MS", 2000),
+            supervisor_poll_ms=_env_int("BYTEPS_SUPERVISOR_POLL_MS", 50),
+            socket_timeout_ms=_env_int("BYTEPS_SOCKET_TIMEOUT_MS", 10000),
+            socket_mbps=_env_float("BYTEPS_SOCKET_MBPS", 0.0),
+            socket_port_attempts=_env_int("BYTEPS_SOCKET_PORT_ATTEMPTS",
+                                          16),
             metrics_on=_env_bool("BYTEPS_METRICS_ON", True),
             flight_recorder_steps=_env_int("BYTEPS_FLIGHT_RECORDER_STEPS",
                                            64),

@@ -14,6 +14,7 @@ Spec grammar (semicolon-separated rules)::
     rule   = scope ':' kind ['@' cond (',' cond)*]
     scope  = 'push' | 'pull' | 'init' | 'all' | 'server<N>' | 'worker'
            | 'worker<N>' | 'replica' | 'replica<N>' | 'tenant<T>'
+           | 'proc' | 'proc<N>'
              # push/pull/all match DATA-PLANE ops only ('all' = push+pull);
              # 'init' matches key-init attempts only (kill = the init
              # never reached the server; timeout = applied, ack lost);
@@ -44,9 +45,22 @@ Spec grammar (semicolon-separated rules)::
              # slow|hang only — 'tenant3:slow@ms=40' makes exactly
              # tenant 3's admissions pay 40 ms while its siblings run
              # clean, the deterministic noisy-tenant flood leg
-             # (docs/serving.md §multi-tenant)
+             # (docs/serving.md §multi-tenant); 'proc' / 'proc<N>' are
+             # the LAUNCHER-SUPERVISOR twins (byteps_tpu/launcher.py):
+             # they match only the supervisor's per-child plan tick (op
+             # 'proc', one tick per Supervisor.poll per child), never
+             # wire or serve ops — and unlike every emulated kind the
+             # supervisor executes them as REAL OS signals against real
+             # child processes: kill = SIGKILL the child (its silence
+             # trips the server lease eviction exactly as a real crash
+             # would), restart = SIGKILL + respawn through the bounded
+             # restart-with-backoff path; proc<N> requires the child
+             # plan's worker_id == N, same convention as worker<N>
     kind   = 'timeout' | 'kill' | 'slow' | 'corrupt' | 'down' | 'hang'
-           | 'join'
+           | 'join' | 'restart'
+             # 'restart' (proc/proc<N> scopes only): the supervisor
+             # SIGKILLs the child and immediately respawns it (counted
+             # against the restart budget) — the crash-resume drill
              # 'join' (worker/worker<N> scopes only, deterministic —
              # requires step=, no p=): the worker runs the kJoin
              # mid-stream admission handshake (PSWorker.join: admission
@@ -112,8 +126,10 @@ __all__ = [
     "parse_fault_spec", "rules_to_spec", "plan_from_env", "churn_events",
 ]
 
-KINDS = ("timeout", "kill", "slow", "corrupt", "down", "hang", "join")
-SCOPES = ("push", "pull", "all", "init", "worker", "replica", "tenant")
+KINDS = ("timeout", "kill", "slow", "corrupt", "down", "hang", "join",
+         "restart")
+SCOPES = ("push", "pull", "all", "init", "worker", "replica", "tenant",
+          "proc")
 
 
 class InjectedTimeout(TimeoutError):
@@ -145,9 +161,10 @@ class FaultRule:
     window: Optional[Tuple[int, Optional[int]]] = None  # [a, b] op window
     latency_ms: int = 50       # for kind == 'slow' / 'hang'
     server: Optional[int] = None  # parsed from 'server<N>' scopes
-    # parsed from 'worker<N>' / 'replica<N>' scopes: the rule only
-    # fires on the plan whose worker_id is N (the shared spec string
-    # selects ONE worker/replica); None = the bare scope, every plan
+    # parsed from 'worker<N>' / 'replica<N>' / 'proc<N>' scopes: the
+    # rule only fires on the plan whose worker_id is N (the shared spec
+    # string selects ONE worker/replica/child); None = the bare scope,
+    # every plan
     worker: Optional[int] = None
     # parsed from 'tenant<T>' scopes (serve tier, docs/serving.md
     # §multi-tenant): the rule fires only on tenant-attributed serve
@@ -171,7 +188,7 @@ class FaultRule:
             conds.append(f"ms={self.latency_ms}")
         if self.scope == "tenant":
             head = f"tenant{self.tenant}:{self.kind}"
-        elif (self.scope in ("worker", "replica")
+        elif (self.scope in ("worker", "replica", "proc")
                 and self.worker is not None):
             head = f"{self.scope}{self.worker}:{self.kind}"
         else:
@@ -216,6 +233,18 @@ class FaultRule:
             # the grammar lowercases the whole rule head, so tenant
             # ids match case-insensitively
             if tenant.lower() != self.tenant:
+                return False
+        elif self.scope == "proc":
+            # proc scopes target ONE supervised child process's plan
+            # tick (op 'proc', ticked once per Supervisor.poll) and
+            # nothing else — a spec string shared with PSWorkers/wires
+            # can never make the data plane pay a process kill, and a
+            # child's own in-process plan never sees op 'proc' (the
+            # SUPERVISOR owns these plans: a SIGKILLed process cannot
+            # execute its own death)
+            if op != "proc":
+                return False
+            if self.worker is not None and worker_id != self.worker:
                 return False
         elif self.scope == "init":
             if op != "init":
@@ -309,11 +338,31 @@ def parse_fault_spec(spec: str) -> List[FaultRule]:
                         "(expected replica<N>, e.g. replica1)")
                 worker = int(idx)
                 scope = "replica"
+            elif scope.startswith("proc") and scope not in SCOPES:
+                idx = scope[len("proc"):]
+                if not idx.isdigit():
+                    raise ValueError(
+                        f"bad proc index {idx!r} in scope {scope!r} "
+                        "(expected proc<N>, e.g. proc1)")
+                worker = int(idx)
+                scope = "proc"
             elif scope not in SCOPES:
                 raise ValueError(
                     f"unknown fault scope {scope!r} (expected one of "
-                    f"{'|'.join(SCOPES)}, server<N>, worker<N>, or "
-                    "replica<N>)")
+                    f"{'|'.join(SCOPES)}, server<N>, worker<N>, "
+                    "replica<N>, or proc<N>)")
+            if scope == "proc" and kind not in ("kill", "restart"):
+                raise ValueError(
+                    "proc scopes take only kill|restart — the launcher "
+                    "supervisor executes them as REAL signals against a "
+                    "child process (kill = SIGKILL, restart = SIGKILL + "
+                    "respawn); emulated wire weather belongs to the "
+                    "child's own in-process plan")
+            if kind == "restart" and scope != "proc":
+                raise ValueError(
+                    "'restart' is a supervisor action (SIGKILL + "
+                    "respawn) and only takes the 'proc'/'proc<N>' "
+                    "scopes (proc1:restart@p=0.1)")
             if kind == "hang" and scope not in ("worker", "replica",
                                                 "tenant"):
                 raise ValueError(

@@ -76,7 +76,9 @@ class Router:
                  wire_mbps: Optional[float] = None,
                  wire_credit: Optional[int] = None,
                  prompt_threshold: Optional[int] = None,
-                 migrate_preempt: Optional[bool] = None):
+                 migrate_preempt: Optional[bool] = None,
+                 kv_target_wrap: Optional[Callable[[Scheduler], Any]]
+                 = None):
         """``policy``/``spawn`` arm replica AUTOSCALING: the same
         :class:`~byteps_tpu.common.autoscaler.ScalingPolicy` class that
         drives train-worker admit/evict observes per-replica queue depth
@@ -105,7 +107,17 @@ class Router:
         additionally turns pool-pressure preemption into
         migrate-don't-evict wherever ≥2 decode replicas live: the
         victim's committed blocks MOVE to the roomiest sibling instead
-        of being freed and recomputed."""
+        of being freed and recomputed.
+
+        ``kv_target_wrap`` swaps the migration wire's DELIVERY surface:
+        the wrap maps a resolved decode Scheduler to whatever should
+        receive its ``ingest_block`` calls — e.g. a
+        :class:`~byteps_tpu.serve.kv_socket.SocketKVTarget` so the
+        block bytes cross a real TCP link. Only the resolve callback
+        handed to :class:`~byteps_tpu.serve.kv_wire.KVWire` is wrapped;
+        the router's own adoption bookkeeping (``staged_blocks``/
+        ``pop_staged``/``submit_migrated``) still talks to the local
+        scheduler object."""
         if not replicas:
             raise ValueError("router needs at least one replica")
         if policy is not None:
@@ -158,6 +170,7 @@ class Router:
         self._stream_handles: Dict[Any, Dict[int, Any]] = {}
         self._stream_src: Dict[Any, int] = {}
         self._wires: Dict[int, Any] = {}
+        self._kv_target_wrap = kv_target_wrap
         if self._prefill_ids or (self._migrate_preempt
                                  and len(self.replicas) > 1):
             # every migration-capable replica must share one pool
@@ -475,7 +488,17 @@ class Router:
         if w is None:
             from byteps_tpu.serve.kv_wire import KVWire
 
-            w = KVWire(self.replicas[i].kv_codec, self._resolve_target,
+            resolve = self._resolve_target
+            if self._kv_target_wrap is not None:
+                # wrap ONLY the wire's delivery surface — adoption
+                # bookkeeping elsewhere still needs the local object
+                wrap = self._kv_target_wrap
+
+                def resolve(rid, _r=self._resolve_target, _w=wrap):
+                    t = _r(rid)
+                    return None if t is None else _w(t)
+
+            w = KVWire(self.replicas[i].kv_codec, resolve,
                        mbps=self._wire_mbps, credit=self._wire_credit)
             self._wires[i] = w
         return w

@@ -227,24 +227,41 @@ def stop_server() -> None:
     _INPROC_SERVER_ID = None
 
 
-def start_server_any_port(port: int, attempts: int = 16, stride: int = 1,
-                          **kw) -> int:
-    """``start_server``, sidestepping ephemeral-port squatters: when the
-    OS ip_local_port_range overlaps the chosen port (this image's starts
-    at 16000), any client socket can be sitting on it and the bind fails
-    rc=-2. Probes ``attempts`` ports ``stride`` apart and returns the
-    port actually bound; any other bind error propagates."""
-    last: Optional[RuntimeError] = None
+def any_port(bind, port: int, attempts: int = 16, stride: int = 1):
+    """Probe ``attempts`` ports ``stride`` apart until ``bind(p)``
+    succeeds, sidestepping ephemeral-port squatters: when the OS
+    ip_local_port_range overlaps the chosen port (this image's starts at
+    16000), any client socket can be sitting on it and the bind fails —
+    rc=-2 from the native server, EADDRINUSE from a Python socket.
+    Returns whatever ``bind`` returned for the port that stuck; any
+    OTHER bind error propagates (a squatter is routine, a bad address
+    is a bug). This is the one home of the PR 4 workaround — the native
+    server path and the socket NIC listen path both delegate here."""
+    import errno
+
+    last: Optional[Exception] = None
     for i in range(attempts):
         p = port + i * stride
         try:
-            return start_server(port=p, **kw)
+            return bind(p)
         except RuntimeError as e:
             if "rc=-2" not in str(e):
                 raise
             last = e
+        except OSError as e:
+            if e.errno not in (errno.EADDRINUSE, errno.EACCES):
+                raise
+            last = e
     raise RuntimeError(
         f"no squatter-free port in {attempts} probes from {port}") from last
+
+
+def start_server_any_port(port: int, attempts: int = 16, stride: int = 1,
+                          **kw) -> int:
+    """``start_server`` through the :func:`any_port` squatter sidestep;
+    returns the port actually bound."""
+    return any_port(lambda p: start_server(port=p, **kw), port,
+                    attempts=attempts, stride=stride)
 
 
 def dump_server_trace(path: str) -> int:

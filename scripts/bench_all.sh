@@ -49,7 +49,17 @@ run --mode dcn-profile                   # host component ceilings
 run_trend_leg --mode throttled           # compression race on emulated slow DCN (+BENCH_throttled.json)
 run_trend_leg --mode whatif              # trace-driven what-if simulator: replay one recorded leg, predict the sweep; floor: prediction accuracy (+BENCH_whatif.json)
 run --mode tune                          # joint (partition, credit) auto-tune incl. the sim-proposed race
-run_trend_leg --mode chaos               # goodput vs fault rate incl. the bounded-staleness slow-worker leg (straggler_ratio) AND the scale-up churn leg: 2→4→3→5 mid-stream join/leave schedule (churn_goodput_tracking) (+BENCH_chaos.json)
+run_trend_leg --mode chaos               # goodput vs fault rate incl. the bounded-staleness slow-worker leg (straggler_ratio), the scale-up churn leg: 2→4→3→5 mid-stream join/leave schedule (churn_goodput_tracking), AND the real process-death leg: supervisor SIGKILLs a live worker OS process, survivor pinned bit-identical (proc_death_goodput) (+BENCH_chaos.json)
+
+# Real-process chaos smoke: 1 server + 2 supervised --child-worker OS
+# processes, SIGKILL one mid-run; survivor must complete every round and
+# the supervisor must leak zero children. Cheap (<1 min) and catches
+# launcher/membership regressions the in-process legs can't.
+echo "== proc_smoke ==" >&2
+if ! bash scripts/proc_smoke.sh >&2; then
+  echo "proc_smoke FAILED — real process-death robustness regression" >&2
+  TREND_LEGS_RC=1
+fi
 run_trend_leg --mode hybrid              # sharded-wire hierarchical race (+BENCH_hybrid.json)
 run_trend_leg --mode ici                 # compressed ICI tier race: staged vs ring vs native psum (+BENCH_ici.json)
 
